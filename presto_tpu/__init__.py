@@ -1,0 +1,21 @@
+"""presto_tpu: a TPU-native distributed SQL query engine.
+
+A from-scratch re-design of the capabilities of Presto (reference:
+yen-von/presto, Java) for TPU hardware: columnar batches are device-resident
+struct-of-arrays with static padded shapes, query expressions compile through
+JAX tracing to XLA (the analogue of Presto's runtime bytecode generation,
+reference presto-main/.../sql/gen/), relational operators are sort/segment
+kernels on the VPU/MXU, and distributed execution is SPMD ``shard_map`` over a
+``jax.sharding.Mesh`` with ICI collectives standing in for Presto's HTTP page
+shuffle.
+"""
+import jax
+
+# SQL semantics need real int64/float64 (BIGINT/DOUBLE); enable before any
+# array is created anywhere in the package.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from . import types  # noqa: E402,F401
+from .batch import Batch, Column, Schema, bucket_capacity  # noqa: E402,F401

@@ -1,0 +1,369 @@
+"""Columnar data plane: device-resident batches with static padded shapes.
+
+Conceptual parity with Presto's Page/Block (reference
+presto-spi/src/main/java/io/prestosql/spi/Page.java:39-62 and
+presto-spi/src/main/java/io/prestosql/spi/block/Block.java:23), re-designed
+for XLA:
+
+- A Batch is a struct-of-arrays: one flat jnp array per column, padded to a
+  static *capacity* (power-of-two bucket) so kernels compile once per bucket
+  and never see dynamic shapes.
+- Liveness is a boolean ``row_mask`` (True = live row). Filters produce masks
+  instead of compacting, which keeps everything branch-free on the VPU;
+  explicit ``compact()`` exists for when gathers pay off.
+- Nulls are per-column validity masks (Presto's per-Block isNull arrays).
+- Strings are dictionary codes (int32) + a host-side vocabulary per column
+  (Presto's DictionaryBlock made mandatory for device residency).
+
+Batch and Column are registered as JAX pytrees, so jitted operator kernels
+take and return them directly; the schema/dictionaries ride in the static
+treedef, which is exactly the "compile once per (schema, bucket)" contract of
+Presto's compiled PageProcessor (reference
+presto-main/.../sql/gen/PageFunctionCompiler.java:121-136 cache keys).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import Type, VarcharType, CharType, parse_type
+
+
+def bucket_capacity(n: int, minimum: int = 128) -> int:
+    """Round row count up to a power-of-two bucket (recompile avoidance).
+
+    Mirrors PageProcessor's adaptive batching buckets (reference
+    presto-main/.../operator/project/PageProcessor.java:56 MAX_BATCH_SIZE).
+    """
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    type: Type
+
+
+class Schema:
+    """Ordered, named, typed columns."""
+
+    def __init__(self, fields: Sequence[Tuple[str, Type]]):
+        self.fields: Tuple[Field, ...] = tuple(
+            f if isinstance(f, Field) else Field(f[0], f[1]) for f in fields
+        )
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def types(self) -> List[Type]:
+        return [f.type for f in self.fields]
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def type_of(self, name: str) -> Type:
+        return self.fields[self._index[name]].type
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __hash__(self) -> int:
+        return hash(self.fields)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{f.name} {f.type.display()}" for f in self.fields)
+        return f"Schema({inner})"
+
+    def select(self, names: Sequence[str]) -> "Schema":
+        return Schema([(n, self.type_of(n)) for n in names])
+
+
+class Column:
+    """One device column: data + validity, plus host dictionary for strings."""
+
+    def __init__(
+        self,
+        type: Type,
+        data: jax.Array,
+        validity: jax.Array,
+        dictionary: Optional[Tuple[str, ...]] = None,
+    ):
+        self.type = type
+        self.data = data
+        self.validity = validity
+        self.dictionary = dictionary
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def tree_flatten(self):
+        return (self.data, self.validity), (self.type, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        type_, dictionary = aux
+        data, validity = children
+        return cls(type_, data, validity, dictionary)
+
+    def __repr__(self) -> str:
+        return f"Column({self.type.display()}, cap={self.data.shape})"
+
+
+jax.tree_util.register_pytree_node(
+    Column, Column.tree_flatten, Column.tree_unflatten
+)
+
+
+class Batch:
+    """A horizontal slice of rows: aligned columns + row liveness mask."""
+
+    def __init__(self, schema: Schema, columns: Sequence[Column], row_mask: jax.Array):
+        self.schema = schema
+        self.columns = tuple(columns)
+        self.row_mask = row_mask
+
+    # -- pytree protocol ----------------------------------------------------
+    # Columns are themselves registered pytree nodes; let JAX recurse.
+    def tree_flatten(self):
+        return (self.columns, self.row_mask), self.schema
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        columns, row_mask = children
+        return cls(aux, columns, row_mask)
+
+    # -- basic accessors ----------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.row_mask.shape[0])
+
+    def count(self) -> jax.Array:
+        """Number of live rows (device scalar)."""
+        return jnp.sum(self.row_mask.astype(jnp.int32))
+
+    def host_count(self) -> int:
+        return int(self.count())
+
+    def column(self, name: str) -> Column:
+        return self.columns[self.schema.index_of(name)]
+
+    def with_columns(self, schema: Schema, columns: Sequence[Column]) -> "Batch":
+        return Batch(schema, columns, self.row_mask)
+
+    def select(self, names: Sequence[str]) -> "Batch":
+        cols = [self.column(n) for n in names]
+        return Batch(self.schema.select(names), cols, self.row_mask)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_arrays(
+        schema: Schema,
+        arrays: Sequence[np.ndarray],
+        validity: Optional[Sequence[Optional[np.ndarray]]] = None,
+        dictionaries: Optional[Sequence[Optional[Tuple[str, ...]]]] = None,
+        capacity: Optional[int] = None,
+        num_rows: Optional[int] = None,
+    ) -> "Batch":
+        """Build a device batch from host numpy arrays (already in storage repr)."""
+        n = num_rows if num_rows is not None else (len(arrays[0]) if arrays else 0)
+        cap = capacity or bucket_capacity(max(n, 1))
+        cols = []
+        for i, (f, arr) in enumerate(zip(schema.fields, arrays)):
+            dt = f.type.storage_dtype
+            padded = np.zeros(cap, dtype=np.dtype(dt))
+            padded[:n] = np.asarray(arr[:n]).astype(np.dtype(dt))
+            if validity is not None and validity[i] is not None:
+                v = np.zeros(cap, dtype=bool)
+                v[:n] = validity[i][:n]
+            else:
+                v = np.zeros(cap, dtype=bool)
+                v[:n] = True
+            d = dictionaries[i] if dictionaries is not None else None
+            cols.append(Column(f.type, jnp.asarray(padded), jnp.asarray(v), d))
+        mask = np.zeros(cap, dtype=bool)
+        mask[:n] = True
+        return Batch(schema, cols, jnp.asarray(mask))
+
+    @staticmethod
+    def from_pydict(
+        data: Dict[str, Tuple[Type, Sequence[Any]]], capacity: Optional[int] = None
+    ) -> "Batch":
+        """Build from python values: {name: (type, [values... (None = null)])}."""
+        names = list(data.keys())
+        schema_fields = []
+        arrays: List[np.ndarray] = []
+        validities: List[Optional[np.ndarray]] = []
+        dictionaries: List[Optional[Tuple[str, ...]]] = []
+        n = None
+        for name in names:
+            typ, values = data[name]
+            values = list(values)
+            if n is None:
+                n = len(values)
+            elif len(values) != n:
+                raise ValueError(
+                    f"column {name!r} has {len(values)} values, expected {n}"
+                )
+            schema_fields.append((name, typ))
+            valid = np.array([v is not None for v in values], dtype=bool)
+            if typ.is_string:
+                vocab: List[str] = []
+                lookup: Dict[str, int] = {}
+                codes = np.full(len(values), -1, dtype=np.int32)
+                for i, v in enumerate(values):
+                    if v is None:
+                        continue
+                    if isinstance(typ, CharType):
+                        v = str(v).ljust(typ.length)
+                    code = lookup.get(v)
+                    if code is None:
+                        code = lookup[v] = len(vocab)
+                        vocab.append(v)
+                    codes[i] = code
+                arrays.append(codes)
+                dictionaries.append(tuple(vocab))
+            else:
+                storage = [typ.to_storage(v) if v is not None else typ.null_storage() for v in values]
+                arrays.append(np.asarray(storage))
+                dictionaries.append(None)
+            validities.append(valid)
+        schema = Schema(schema_fields)
+        return Batch.from_arrays(
+            schema, arrays, validities, dictionaries, capacity=capacity, num_rows=n
+        )
+
+    # -- export -------------------------------------------------------------
+    def to_pylist(self) -> List[Tuple]:
+        """Decode live rows to python tuples (for tests / client results)."""
+        mask = np.asarray(self.row_mask)
+        out_cols = []
+        for col in self.columns:
+            data = np.asarray(col.data)[mask]
+            valid = np.asarray(col.validity)[mask]
+            vals: List[Any] = []
+            for d, v in zip(data, valid):
+                if not v:
+                    vals.append(None)
+                elif col.type.is_string:
+                    code = int(d)
+                    vals.append(col.dictionary[code] if col.dictionary and 0 <= code < len(col.dictionary) else None)
+                else:
+                    vals.append(col.type.from_storage(d))
+            out_cols.append(vals)
+        return [tuple(r) for r in zip(*out_cols)] if out_cols else []
+
+    # -- transforms ---------------------------------------------------------
+    def compact(self, capacity: Optional[int] = None) -> "Batch":
+        """Gather live rows to the front (device-side, static output shape).
+
+        ``capacity`` smaller than the live-row count would silently drop rows;
+        callers shrinking buckets must check ``host_count()`` first, so guard.
+        """
+        cap = capacity or self.capacity
+        if capacity is not None and capacity < self.capacity:
+            live = self.host_count()
+            if live > capacity:
+                raise ValueError(
+                    f"compact capacity {capacity} < live rows {live}"
+                )
+        idx = jnp.nonzero(self.row_mask, size=cap, fill_value=self.capacity - 1)[0]
+        n = self.count()
+        new_mask = jnp.arange(cap) < n
+        cols = []
+        for c in self.columns:
+            cols.append(
+                Column(
+                    c.type,
+                    jnp.take(c.data, idx, axis=0),
+                    jnp.take(c.validity, idx, axis=0) & new_mask,
+                    c.dictionary,
+                )
+            )
+        return Batch(self.schema, cols, new_mask)
+
+    def __repr__(self) -> str:
+        return f"Batch({self.schema!r}, capacity={self.capacity})"
+
+
+jax.tree_util.register_pytree_node(
+    Batch, Batch.tree_flatten, Batch.tree_unflatten
+)
+
+
+def unify_dictionaries(columns: Sequence[Column]) -> Tuple[Tuple[str, ...], List[np.ndarray]]:
+    """Merge per-column vocabularies; return (vocab, remap arrays per column).
+
+    remap[i] maps old codes of columns[i] to codes in the unified vocab; -1
+    stays -1 via the sentinel slot appended at the end.
+    """
+    vocab: List[str] = []
+    lookup: Dict[str, int] = {}
+    remaps: List[np.ndarray] = []
+    for col in columns:
+        src = col.dictionary or ()
+        remap = np.full(len(src) + 1, -1, dtype=np.int32)  # last slot: -1 sentinel
+        for old_code, s in enumerate(src):
+            code = lookup.get(s)
+            if code is None:
+                code = lookup[s] = len(vocab)
+                vocab.append(s)
+            remap[old_code] = code
+        remaps.append(remap)
+    return tuple(vocab), remaps
+
+
+def remap_codes(col: Column, remap: np.ndarray, vocab: Tuple[str, ...]) -> Column:
+    """Apply a dictionary remap on device (gather)."""
+    table = jnp.asarray(remap)
+    # codes may be -1 (null padding): index the appended sentinel slot
+    idx = jnp.where(col.data >= 0, col.data, len(remap) - 1)
+    return Column(col.type, jnp.take(table, idx, axis=0), col.validity, vocab)
+
+
+def concat_batches(batches: Sequence[Batch], capacity: Optional[int] = None) -> Batch:
+    """Concatenate batches of identical schema (host orchestration op)."""
+    assert batches, "concat of zero batches"
+    schema = batches[0].schema
+    total_cap = sum(b.capacity for b in batches)
+    cap = capacity or bucket_capacity(total_cap)
+    ncols = len(schema)
+    out_cols = []
+    for i in range(ncols):
+        cols = [b.columns[i] for b in batches]
+        typ = cols[0].type
+        if typ.is_string:
+            vocab, remaps = unify_dictionaries(cols)
+            cols = [remap_codes(c, r, vocab) for c, r in zip(cols, remaps)]
+            dictionary = vocab
+        else:
+            dictionary = None
+        data = jnp.concatenate([c.data for c in cols])
+        validity = jnp.concatenate([c.validity for c in cols])
+        pad = cap - data.shape[0]
+        if pad > 0:
+            data = jnp.pad(data, (0, pad))
+            validity = jnp.pad(validity, (0, pad))
+        elif pad < 0:
+            raise ValueError("concat capacity too small")
+        out_cols.append(Column(typ, data, validity, dictionary))
+    mask = jnp.concatenate([b.row_mask for b in batches])
+    if cap - mask.shape[0] > 0:
+        mask = jnp.pad(mask, (0, cap - mask.shape[0]))
+    return Batch(schema, out_cols, mask)

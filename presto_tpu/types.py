@@ -1,0 +1,411 @@
+"""SQL type system for the TPU-native engine.
+
+Conceptual parity with Presto's type layer (reference:
+presto-spi/src/main/java/io/prestosql/spi/type/ and
+presto-main/src/main/java/io/prestosql/type/InternalTypeManager.java), but
+designed around XLA storage: every SQL type maps to a fixed-width device dtype
+so columns are flat jnp arrays that tile onto the VPU/MXU.
+
+Storage mapping (TPU-first):
+  BOOLEAN     -> bool_
+  TINYINT     -> int8   (stored as int32 on device for VPU friendliness)
+  SMALLINT    -> int16  (stored int32)
+  INTEGER     -> int32
+  BIGINT      -> int64
+  DOUBLE      -> float64 (jax x64 enabled by the package __init__)
+  REAL        -> float32
+  DECIMAL(p<=18, s) -> int64 scaled by 10**s  (Presto's "short decimal",
+                       reference spi/type/DecimalType.java)
+  DATE        -> int32 days since epoch
+  TIMESTAMP   -> int64 microseconds since epoch
+  VARCHAR/CHAR -> int32 dictionary codes + host-side vocabulary
+                  (strings never live on device as bytes; mirrors
+                  DictionaryBlock, reference spi/block/DictionaryBlock.java)
+
+Null handling is out-of-band: a per-column boolean validity mask (see
+batch.Column), like Presto's per-Block isNull arrays.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """Base class for SQL types."""
+
+    #: canonical lowercase SQL name, e.g. "bigint"
+    name: ClassVar[str] = "unknown"
+
+    @property
+    def storage_dtype(self):
+        raise NotImplementedError
+
+    @property
+    def is_string(self) -> bool:
+        return False
+
+    @property
+    def is_orderable(self) -> bool:
+        return True
+
+    @property
+    def is_comparable(self) -> bool:
+        return True
+
+    def display(self) -> str:
+        return self.name
+
+    def __str__(self) -> str:  # pragma: no cover - debugging aid
+        return self.display()
+
+    # -- value conversion ---------------------------------------------------
+    def to_storage(self, value: Any):
+        """Convert a python literal to its device storage representation."""
+        return value
+
+    def from_storage(self, value: Any):
+        """Convert a device storage value back to a python value."""
+        return value
+
+    def null_storage(self):
+        """Padding value used in storage slots whose validity bit is off."""
+        return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanType(Type):
+    name: ClassVar[str] = "boolean"
+
+    @property
+    def storage_dtype(self):
+        return jnp.bool_
+
+    def null_storage(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerLikeType(Type):
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class TinyintType(IntegerLikeType):
+    name: ClassVar[str] = "tinyint"
+
+
+@dataclasses.dataclass(frozen=True)
+class SmallintType(IntegerLikeType):
+    name: ClassVar[str] = "smallint"
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerType(IntegerLikeType):
+    name: ClassVar[str] = "integer"
+
+
+@dataclasses.dataclass(frozen=True)
+class BigintType(Type):
+    name: ClassVar[str] = "bigint"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleType(Type):
+    """IEEE double. On TPU, f64 is double-double emulation: full f64
+    precision but only f32 exponent range (|x| <~ 3.4e38 on device)."""
+
+    name: ClassVar[str] = "double"
+
+    @property
+    def storage_dtype(self):
+        return jnp.float64
+
+    def null_storage(self):
+        return 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RealType(Type):
+    name: ClassVar[str] = "real"
+
+    @property
+    def storage_dtype(self):
+        return jnp.float32
+
+    def null_storage(self):
+        return 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(Type):
+    """Short decimal: int64 storage scaled by 10**scale.
+
+    Presto supports precision up to 38 via Int128; we support p<=18 for now
+    (covers all of TPC-H/TPC-DS). Reference: spi/type/DecimalType.java.
+    """
+
+    precision: int = 18
+    scale: int = 0
+    name: ClassVar[str] = "decimal"
+
+    def __post_init__(self):
+        if not (1 <= self.precision <= 18):
+            raise ValueError(f"unsupported decimal precision {self.precision}")
+        if not (0 <= self.scale <= self.precision):
+            raise ValueError(f"bad decimal scale {self.scale}")
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+    def display(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def to_storage(self, value: Any) -> int:
+        # round-half-up like Presto's Decimals.encodeScaledValue
+        from decimal import Decimal, ROUND_HALF_UP
+
+        d = Decimal(str(value)).quantize(
+            Decimal(1).scaleb(-self.scale), rounding=ROUND_HALF_UP
+        )
+        unscaled = int(d.scaleb(self.scale))
+        if abs(unscaled) >= 10 ** self.precision:
+            raise ValueError(
+                f"value {value!r} out of range for {self.display()}"
+            )
+        return unscaled
+
+    def from_storage(self, value: Any):
+        from decimal import Decimal
+
+        return Decimal(int(value)).scaleb(-self.scale)
+
+
+@dataclasses.dataclass(frozen=True)
+class DateType(Type):
+    """Days since 1970-01-01 (matches Presto DateType semantics)."""
+
+    name: ClassVar[str] = "date"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+    def to_storage(self, value: Any) -> int:
+        import datetime
+
+        if isinstance(value, (int, np.integer)):
+            return int(value)
+        if isinstance(value, str):
+            value = datetime.date.fromisoformat(value)
+        if isinstance(value, datetime.date):
+            return (value - datetime.date(1970, 1, 1)).days
+        raise TypeError(f"cannot convert {value!r} to date")
+
+    def from_storage(self, value: Any):
+        import datetime
+
+        return datetime.date(1970, 1, 1) + datetime.timedelta(days=int(value))
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampType(Type):
+    """Microseconds since epoch."""
+
+    name: ClassVar[str] = "timestamp"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(Type):
+    """Dictionary-encoded string: int32 codes into a host-side vocabulary."""
+
+    length: Optional[int] = None  # None = unbounded
+    name: ClassVar[str] = "varchar"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+    @property
+    def is_string(self) -> bool:
+        return True
+
+    def display(self) -> str:
+        return "varchar" if self.length is None else f"varchar({self.length})"
+
+    def null_storage(self):
+        return -1
+
+
+@dataclasses.dataclass(frozen=True)
+class CharType(Type):
+    length: int = 1
+    name: ClassVar[str] = "char"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+    @property
+    def is_string(self) -> bool:
+        return True
+
+    def display(self) -> str:
+        return f"char({self.length})"
+
+    def null_storage(self):
+        return -1
+
+
+@dataclasses.dataclass(frozen=True)
+class UnknownType(Type):
+    """Type of a bare NULL literal."""
+
+    name: ClassVar[str] = "unknown"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+
+# Singletons (Presto style: BIGINT, DOUBLE, ... constants)
+BOOLEAN = BooleanType()
+TINYINT = TinyintType()
+SMALLINT = SmallintType()
+INTEGER = IntegerType()
+BIGINT = BigintType()
+DOUBLE = DoubleType()
+REAL = RealType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+VARCHAR = VarcharType()
+UNKNOWN = UnknownType()
+
+
+def decimal(precision: int, scale: int) -> DecimalType:
+    return DecimalType(precision, scale)
+
+
+def varchar(length: Optional[int] = None) -> VarcharType:
+    return VarcharType(length)
+
+
+def char(length: int) -> CharType:
+    return CharType(length)
+
+
+_NUMERIC = (TinyintType, SmallintType, IntegerType, BigintType, RealType,
+            DoubleType, DecimalType)
+_INTEGRAL = (TinyintType, SmallintType, IntegerType, BigintType)
+
+
+def is_numeric(t: Type) -> bool:
+    return isinstance(t, _NUMERIC)
+
+
+def is_integral(t: Type) -> bool:
+    return isinstance(t, _INTEGRAL)
+
+
+def is_floating(t: Type) -> bool:
+    return isinstance(t, (RealType, DoubleType))
+
+
+def is_string_type(t: Type) -> bool:
+    return t.is_string
+
+
+_INTEGRAL_RANK = {"tinyint": 0, "smallint": 1, "integer": 2, "bigint": 3}
+
+
+def common_super_type(a: Type, b: Type) -> Optional[Type]:
+    """Least-common supertype for implicit coercion.
+
+    Mirrors the coercion lattice in Presto's TypeCoercion/FunctionRegistry
+    (reference presto-main/.../type/TypeCoercion.java concept): integral
+    widening, integral->decimal->double, varchar/char unification.
+    """
+    if a == b:
+        return a
+    if isinstance(a, UnknownType):
+        return b
+    if isinstance(b, UnknownType):
+        return a
+    if is_integral(a) and is_integral(b):
+        return a if _INTEGRAL_RANK[a.name] >= _INTEGRAL_RANK[b.name] else b
+    if is_numeric(a) and is_numeric(b):
+        if isinstance(a, DoubleType) or isinstance(b, DoubleType):
+            return DOUBLE
+        if isinstance(a, RealType) or isinstance(b, RealType):
+            # decimal + real -> real in Presto
+            return REAL
+        if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+            scale = max(a.scale, b.scale)
+            int_digits = max(a.precision - a.scale, b.precision - b.scale)
+            if int_digits + scale > 18:
+                # Presto widens to long decimal (Int128); we cap at short
+                # decimal and refuse rather than silently losing digits.
+                return None
+            return DecimalType(int_digits + scale, scale)
+        if isinstance(a, DecimalType) and is_integral(b):
+            # bigint needs 19 integer digits, beyond short-decimal range;
+            # coerce bigint+decimal via decimal(18,0) only when it fits.
+            int_digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 18}[b.name]
+            return common_super_type(a, DecimalType(int_digits, 0))
+        if isinstance(b, DecimalType) and is_integral(a):
+            return common_super_type(b, a)
+    if a.is_string and b.is_string:
+        return VARCHAR
+    if isinstance(a, DateType) and isinstance(b, TimestampType):
+        return TIMESTAMP
+    if isinstance(b, DateType) and isinstance(a, TimestampType):
+        return TIMESTAMP
+    return None
+
+
+def parse_type(text: str) -> Type:
+    """Parse a SQL type name like 'decimal(12,2)' or 'varchar(25)'."""
+    s = text.strip().lower()
+    if "(" in s:
+        base, _, rest = s.partition("(")
+        args = [int(x) for x in rest.rstrip(")").split(",")]
+        base = base.strip()
+        if base == "decimal":
+            return DecimalType(*args)
+        if base == "varchar":
+            return VarcharType(args[0])
+        if base == "char":
+            return CharType(args[0])
+        raise ValueError(f"unknown parametric type {text!r}")
+    simple = {
+        "boolean": BOOLEAN,
+        "tinyint": TINYINT,
+        "smallint": SMALLINT,
+        "integer": INTEGER,
+        "int": INTEGER,
+        "bigint": BIGINT,
+        "double": DOUBLE,
+        "real": REAL,
+        "date": DATE,
+        "timestamp": TIMESTAMP,
+        "varchar": VARCHAR,
+        "unknown": UNKNOWN,
+    }
+    if s in simple:
+        return simple[s]
+    raise ValueError(f"unknown type {text!r}")

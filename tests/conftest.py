@@ -1,0 +1,24 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors Presto's ring-3 testing strategy (DistributedQueryRunner boots N
+in-process servers, reference presto-tests/.../DistributedQueryRunner.java:76):
+we get N devices in one process via XLA's host platform device count.
+
+Note: this environment's sitecustomize registers a tunneled TPU backend and
+sets jax_platforms directly in jax config (overriding the JAX_PLATFORMS env
+var), so we must win the same way — config.update after importing jax, before
+any backend is initialized. Tests must never touch the single-chip TPU
+tunnel: it is slow, serialized, and not multi-device.
+"""
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,96 @@
+import datetime
+from decimal import Decimal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, Schema, bucket_capacity, concat_batches
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(1) == 128
+    assert bucket_capacity(128) == 128
+    assert bucket_capacity(129) == 256
+    assert bucket_capacity(100_000) == 131072
+
+
+def test_type_parse_roundtrip():
+    for s in ["bigint", "integer", "double", "boolean", "date",
+              "decimal(12,2)", "varchar(25)", "char(1)", "varchar"]:
+        t = T.parse_type(s)
+        assert T.parse_type(t.display()) == t
+
+
+def test_decimal_storage():
+    d = T.decimal(12, 2)
+    assert d.to_storage("1.005") == 101  # round half up
+    assert d.to_storage(3) == 300
+    assert d.from_storage(12345) == Decimal("123.45")
+
+
+def test_date_storage():
+    assert T.DATE.to_storage("1970-01-02") == 1
+    assert T.DATE.from_storage(0) == datetime.date(1970, 1, 1)
+    assert T.DATE.to_storage(datetime.date(1994, 1, 1)) == 8766
+
+
+def test_batch_pydict_roundtrip():
+    b = Batch.from_pydict({
+        "a": (T.BIGINT, [1, 2, None, 4]),
+        "b": (T.DOUBLE, [1.5, None, 3.5, 4.5]),
+        "s": (T.varchar(10), ["x", "y", "x", None]),
+        "d": (T.DATE, ["1994-01-01", None, "1995-06-15", "1992-02-02"]),
+    })
+    assert b.capacity == 128
+    assert b.host_count() == 4
+    rows = b.to_pylist()
+    assert rows[0] == (1, 1.5, "x", datetime.date(1994, 1, 1))
+    assert rows[1][1] is None
+    assert rows[2][2] == "x"
+    assert rows[3][2] is None
+
+
+def test_batch_is_pytree():
+    b = Batch.from_pydict({"a": (T.BIGINT, [1, 2, 3])})
+
+    @jax.jit
+    def double(batch):
+        col = batch.column("a")
+        new = type(col)(col.type, col.data * 2, col.validity, col.dictionary)
+        return batch.with_columns(batch.schema, [new])
+
+    out = double(b)
+    assert [r[0] for r in out.to_pylist()] == [2, 4, 6]
+
+
+def test_compact():
+    b = Batch.from_pydict({"a": (T.BIGINT, [10, 20, 30, 40, 50])})
+    # kill rows 1 and 3
+    mask = np.asarray(b.row_mask).copy()
+    mask[1] = False
+    mask[3] = False
+    b2 = Batch(b.schema, b.columns, jnp.asarray(mask))
+    c = b2.compact()
+    assert c.host_count() == 3
+    assert [r[0] for r in c.to_pylist()] == [10, 30, 50]
+
+
+def test_concat_unifies_dictionaries():
+    b1 = Batch.from_pydict({"s": (T.VARCHAR, ["a", "b"])}, capacity=128)
+    b2 = Batch.from_pydict({"s": (T.VARCHAR, ["b", "c", None])}, capacity=128)
+    out = concat_batches([b1, b2])
+    vals = [r[0] for r in out.to_pylist()]
+    assert vals == ["a", "b", "b", "c", None]
+    assert out.column("s").dictionary == ("a", "b", "c")
+
+
+def test_select():
+    b = Batch.from_pydict({
+        "a": (T.BIGINT, [1]), "b": (T.DOUBLE, [2.0]), "c": (T.INTEGER, [3]),
+    })
+    s = b.select(["c", "a"])
+    assert s.schema.names == ["c", "a"]
+    assert s.to_pylist() == [(3, 1)]
