@@ -1,0 +1,5 @@
+from .ir import (  # noqa: F401
+    Expr, InputRef, Literal, Call, Cast, SpecialForm, Form,
+    input_ref, lit, call, cast,
+)
+from .compiler import compile_projection, compile_filter, ExprCompiler  # noqa: F401

@@ -1,0 +1,594 @@
+"""Scalar function implementations over (data, validity) column pairs.
+
+The analogue of Presto's FunctionRegistry + operator/scalar/* (reference
+presto-main/.../metadata/FunctionRegistry.java:350 and operator/scalar/): each
+function is a pure jnp transform over storage arrays plus explicit SQL
+three-valued-logic validity handling. String functions operate on dictionary
+codes with host-side vocabulary precomputation at trace time — the vocab is
+static under jit, so LIKE/substr/comparison tables bake into the compiled
+kernel as constants (the TPU answer to Presto's per-invocation Joni regex).
+
+Division/modulus by zero currently yields NULL rather than a query error;
+device-side error flags are TODO (Presto raises DIVISION_BY_ZERO).
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import math
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..types import Type
+
+
+@dataclasses.dataclass
+class Val:
+    """Evaluation-time column value: storage data + validity (+ vocab)."""
+
+    data: jnp.ndarray
+    valid: jnp.ndarray
+    type: Type
+    dictionary: Optional[Tuple[str, ...]] = None
+
+    @staticmethod
+    def constant(value, typ: Type, n: int) -> "Val":
+        if value is None:
+            return Val(
+                jnp.full(n, typ.null_storage(), dtype=typ.storage_dtype),
+                jnp.zeros(n, dtype=bool), typ,
+            )
+        if typ.is_string:
+            s = value
+            if isinstance(typ, T.CharType):
+                s = str(s).ljust(typ.length)
+            return Val(
+                jnp.zeros(n, dtype=jnp.int32),
+                jnp.ones(n, dtype=bool), typ, dictionary=(s,),
+            )
+        storage = typ.to_storage(value)
+        return Val(
+            jnp.full(n, storage, dtype=typ.storage_dtype),
+            jnp.ones(n, dtype=bool), typ,
+        )
+
+
+def _all_valid(args: Sequence[Val]) -> jnp.ndarray:
+    v = args[0].valid
+    for a in args[1:]:
+        v = v & a.valid
+    return v
+
+
+# -- decimal helpers ---------------------------------------------------------
+
+def rescale_decimal(data: jnp.ndarray, from_scale: int, to_scale: int) -> jnp.ndarray:
+    """Rescale int64 decimal storage, rounding half-up away from zero."""
+    if to_scale == from_scale:
+        return data
+    if to_scale > from_scale:
+        return data * (10 ** (to_scale - from_scale))
+    div = 10 ** (from_scale - to_scale)
+    half = div // 2
+    sign = jnp.sign(data)
+    return sign * ((jnp.abs(data) + half) // div)
+
+
+def _unify_numeric(a: Val, b: Val) -> Tuple[Val, Val, Type]:
+    """Coerce two numeric Vals to a common type (planner usually pre-casts;
+    this is the defensive fallback)."""
+    t = T.common_super_type(a.type, b.type)
+    if t is None:
+        raise TypeError(f"cannot unify {a.type} and {b.type}")
+    return cast_val(a, t), cast_val(b, t), t
+
+
+def cast_val(v: Val, to: Type) -> Val:
+    """CAST implementation (reference operator/scalar casts per type)."""
+    f = v.type
+    if f == to:
+        return v
+    data = v.data
+    if isinstance(f, T.DecimalType) and isinstance(to, T.DecimalType):
+        return Val(rescale_decimal(data, f.scale, to.scale), v.valid, to)
+    if isinstance(to, T.DoubleType) or isinstance(to, T.RealType):
+        if isinstance(f, T.DecimalType):
+            out = data.astype(to.storage_dtype) / (10.0 ** f.scale)
+        else:
+            out = data.astype(to.storage_dtype)
+        return Val(out, v.valid, to)
+    if isinstance(to, T.DecimalType):
+        if T.is_integral(f):
+            return Val(data.astype(jnp.int64) * (10 ** to.scale), v.valid, to)
+        if T.is_floating(f):
+            scaled = data * (10.0 ** to.scale)
+            out = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+            return Val(out.astype(jnp.int64), v.valid, to)
+    if T.is_integral(to) or isinstance(to, T.BigintType):
+        if T.is_floating(f):
+            # Presto DoubleOperators.castToLong: Math.round = half-up
+            out = jnp.floor(data + 0.5).astype(to.storage_dtype)
+            return Val(out, v.valid, to)
+        if isinstance(f, T.DecimalType):
+            return Val(
+                rescale_decimal(data, f.scale, 0).astype(to.storage_dtype),
+                v.valid, to,
+            )
+        if T.is_integral(f) or isinstance(f, T.BooleanType):
+            return Val(data.astype(to.storage_dtype), v.valid, to)
+    if isinstance(to, T.BooleanType) and T.is_numeric(f):
+        return Val(data != 0, v.valid, to)
+    if isinstance(to, T.VarcharType) and f.is_string:
+        return Val(data, v.valid, to, v.dictionary)
+    if isinstance(to, T.TimestampType) and isinstance(f, T.DateType):
+        return Val(data.astype(jnp.int64) * 86_400_000_000, v.valid, to)
+    if isinstance(to, T.DateType) and isinstance(f, T.TimestampType):
+        return Val((data // 86_400_000_000).astype(jnp.int32), v.valid, to)
+    raise NotImplementedError(f"cast {f.display()} -> {to.display()}")
+
+
+# -- date math (branch-free civil calendar, VPU-friendly) --------------------
+
+def _civil_from_days(days: jnp.ndarray):
+    """days since 1970-01-01 -> (year, month, day). Howard Hinnant's
+    branch-free algorithm, exact for the whole int32 range."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097                                # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)       # [0, 365]
+    mp = (5 * doy + 2) // 153                             # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                     # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)                # [1, 12]
+    year = jnp.where(m <= 2, y + 1, y)
+    return year, m, d
+
+
+def _days_from_civil(y: jnp.ndarray, m: jnp.ndarray, d: jnp.ndarray):
+    y = y.astype(jnp.int64)
+    yy = jnp.where(m <= 2, y - 1, y)
+    era = jnp.floor_divide(yy, 400)
+    yoe = yy - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = 365 * yoe + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+# -- string helpers (host-side over static vocab) ----------------------------
+
+def _like_to_regex(pattern: str, escape: Optional[str] = None) -> str:
+    out = []
+    i = 0
+    esc = escape
+    while i < len(pattern):
+        c = pattern[i]
+        if esc is not None and c == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "".join(out)
+
+
+def vocab_table(vocab: Tuple[str, ...], fn: Callable[[str], object], dtype) -> jnp.ndarray:
+    """Evaluate a host predicate/transform over the vocab -> device table.
+    Appends a slot for the -1 (null) code at the end."""
+    vals = [fn(s) for s in vocab]
+    vals.append(fn("") if dtype != np.bool_ else False)
+    return jnp.asarray(np.asarray(vals, dtype=dtype))
+
+
+def _code_gather(table: jnp.ndarray, codes: jnp.ndarray) -> jnp.ndarray:
+    idx = jnp.where(codes >= 0, codes, table.shape[0] - 1)
+    return jnp.take(table, idx, axis=0)
+
+
+def _string_literal_of(v: Val) -> Optional[str]:
+    if v.dictionary is not None and len(v.dictionary) == 1 and v.data.ndim >= 1:
+        # constant produced by Val.constant
+        return v.dictionary[0]
+    return None
+
+
+def _str_padded(v: Val, s: str) -> str:
+    return s.ljust(v.type.length) if isinstance(v.type, T.CharType) else s
+
+
+def _string_compare(a: Val, b: Val, op: str) -> Val:
+    """Comparison on dictionary-coded strings."""
+    lit_b = _string_literal_of(b)
+    lit_a = _string_literal_of(a)
+    valid = a.valid & b.valid
+    if a.dictionary is not None and lit_b is not None:
+        target = _str_padded(a, lit_b)
+        if op in ("eq", "ne"):
+            code = a.dictionary.index(target) if target in a.dictionary else -2
+            d = a.data == code
+            return Val(d if op == "eq" else ~d, valid, T.BOOLEAN)
+        table = vocab_table(
+            a.dictionary,
+            {"lt": lambda s: s < target, "le": lambda s: s <= target,
+             "gt": lambda s: s > target, "ge": lambda s: s >= target}[op],
+            np.bool_,
+        )
+        return Val(_code_gather(table, a.data), valid, T.BOOLEAN)
+    if lit_a is not None and b.dictionary is not None:
+        flipped = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                   "eq": "eq", "ne": "ne"}[op]
+        return _string_compare(b, a, flipped)
+    if a.dictionary is not None and b.dictionary is not None:
+        if a.dictionary == b.dictionary:
+            if op in ("eq", "ne"):
+                d = a.data == b.data
+                return Val(d if op == "eq" else ~d, valid, T.BOOLEAN)
+            rank = vocab_table(
+                a.dictionary,
+                lambda s, order=sorted(a.dictionary): order.index(s),
+                np.int32,
+            )
+            ra, rb = _code_gather(rank, a.data), _code_gather(rank, b.data)
+            d = {"lt": ra < rb, "le": ra <= rb, "gt": ra > rb, "ge": ra >= rb}[op]
+            return Val(d, valid, T.BOOLEAN)
+        # different vocabularies: build a shared ordering at trace time
+        merged = sorted(set(a.dictionary) | set(b.dictionary))
+        order = {s: i for i, s in enumerate(merged)}
+        ta = vocab_table(a.dictionary, lambda s: order[s], np.int64)
+        tb = vocab_table(b.dictionary, lambda s: order[s], np.int64)
+        ra, rb = _code_gather(ta, a.data), _code_gather(tb, b.data)
+        d = {"eq": ra == rb, "ne": ra != rb, "lt": ra < rb,
+             "le": ra <= rb, "gt": ra > rb, "ge": ra >= rb}[op]
+        return Val(d, valid, T.BOOLEAN)
+    raise NotImplementedError("string comparison without dictionaries")
+
+
+# -- function registry -------------------------------------------------------
+
+FunctionImpl = Callable[[List[Val], Type], Val]
+_REGISTRY: Dict[str, FunctionImpl] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def lookup(name: str) -> FunctionImpl:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown function {name!r}")
+    return _REGISTRY[name]
+
+
+def _arith(op):
+    def impl(args: List[Val], out: Type) -> Val:
+        a, b = args
+        valid = a.valid & b.valid
+        if isinstance(out, T.DecimalType):
+            s_out = out.scale
+            sa = a.type.scale if isinstance(a.type, T.DecimalType) else 0
+            sb = b.type.scale if isinstance(b.type, T.DecimalType) else 0
+            da = a.data.astype(jnp.int64)
+            db = b.data.astype(jnp.int64)
+            if op == "mul":
+                data = rescale_decimal(da * db, sa + sb, s_out)
+            elif op == "div":
+                # scale numerator to s_out + sb, integer divide, round half-up
+                num = rescale_decimal(da, sa, s_out + sb)
+                den = jnp.where(db == 0, 1, db)
+                q = num / den
+                data = (jnp.sign(q) * jnp.floor(jnp.abs(num) / jnp.abs(den) + 0.5)).astype(jnp.int64)
+                valid = valid & (db != 0)
+            elif op == "mod":
+                sc = max(sa, sb)
+                da2, db2 = rescale_decimal(da, sa, sc), rescale_decimal(db, sb, sc)
+                den = jnp.where(db2 == 0, 1, db2)
+                data = jnp.sign(da2) * (jnp.abs(da2) % jnp.abs(den))
+                valid = valid & (db2 != 0)
+            else:
+                sc = s_out
+                da2, db2 = rescale_decimal(da, sa, sc), rescale_decimal(db, sb, sc)
+                data = da2 + db2 if op == "add" else da2 - db2
+            return Val(data, valid, out)
+        a2, b2 = cast_val(a, out), cast_val(b, out)
+        da, db = a2.data, b2.data
+        if op == "add":
+            data = da + db
+        elif op == "sub":
+            data = da - db
+        elif op == "mul":
+            data = da * db
+        elif op == "div":
+            if T.is_integral(out):
+                den = jnp.where(db == 0, 1, db)
+                # SQL integer division truncates toward zero
+                data = (jnp.sign(da) * jnp.sign(den)) * (jnp.abs(da) // jnp.abs(den))
+                valid = valid & (db != 0)
+            else:
+                den = jnp.where(db == 0.0, 1.0, db)
+                data = da / den
+                valid = valid & (db != 0.0)
+        elif op == "mod":
+            if T.is_integral(out):
+                den = jnp.where(db == 0, 1, db)
+                data = jnp.sign(da) * (jnp.abs(da) % jnp.abs(den))
+                valid = valid & (db != 0)
+            else:
+                den = jnp.where(db == 0.0, 1.0, db)
+                data = jnp.sign(da) * (jnp.abs(da) % jnp.abs(den))
+                valid = valid & (db != 0.0)
+        else:
+            raise AssertionError(op)
+        return Val(data, valid, out)
+    return impl
+
+
+for _name, _op in [("add", "add"), ("subtract", "sub"), ("multiply", "mul"),
+                   ("divide", "div"), ("modulus", "mod")]:
+    register(_name)(_arith(_op))
+
+
+@register("negate")
+def _negate(args, out):
+    (a,) = args
+    return Val(-a.data, a.valid, out)
+
+
+def _cmp(op):
+    def impl(args: List[Val], out: Type) -> Val:
+        a, b = args
+        if a.type.is_string or b.type.is_string:
+            return _string_compare(a, b, op)
+        if a.type != b.type:
+            a, b, _ = _unify_numeric(a, b)
+        valid = a.valid & b.valid
+        da, db = a.data, b.data
+        data = {"eq": da == db, "ne": da != db, "lt": da < db,
+                "le": da <= db, "gt": da > db, "ge": da >= db}[op]
+        return Val(data, valid, T.BOOLEAN)
+    return impl
+
+
+for _name in ["eq", "ne", "lt", "le", "gt", "ge"]:
+    register(_name)(_cmp(_name))
+
+
+@register("not")
+def _not(args, out):
+    (a,) = args
+    return Val(~a.data, a.valid, T.BOOLEAN)
+
+
+@register("abs")
+def _abs(args, out):
+    (a,) = args
+    return Val(jnp.abs(a.data), a.valid, out)
+
+
+def _dbl_fn(fn):
+    def impl(args, out):
+        (a,) = args
+        a = cast_val(a, T.DOUBLE)
+        return Val(fn(a.data), a.valid, out)
+    return impl
+
+
+register("sqrt")(_dbl_fn(jnp.sqrt))
+register("ln")(_dbl_fn(jnp.log))
+register("exp")(_dbl_fn(jnp.exp))
+
+
+@register("floor")
+def _floor(args, out):
+    (a,) = args
+    if isinstance(a.type, T.DecimalType):
+        div = 10 ** a.type.scale
+        return Val(jnp.floor_divide(a.data, div) * div, a.valid, out)
+    if T.is_integral(a.type):
+        return Val(a.data, a.valid, out)
+    return Val(jnp.floor(a.data), a.valid, out)
+
+
+@register("ceil")
+def _ceil(args, out):
+    (a,) = args
+    if isinstance(a.type, T.DecimalType):
+        div = 10 ** a.type.scale
+        return Val(-(jnp.floor_divide(-a.data, div)) * div, a.valid, out)
+    if T.is_integral(a.type):
+        return Val(a.data, a.valid, out)
+    return Val(jnp.ceil(a.data), a.valid, out)
+
+
+@register("round")
+def _round(args, out):
+    a = args[0]
+    digits = 0
+    if len(args) > 1:
+        # digits must be a compile-time constant (Literal-backed)
+        try:
+            digits = int(np.asarray(args[1].data)[0])
+        except Exception as e:
+            raise NotImplementedError("round() with non-constant digits") from e
+    if isinstance(a.type, T.DecimalType):
+        data = rescale_decimal(a.data, a.type.scale, digits)
+        data = rescale_decimal(data, digits, a.type.scale)
+        return Val(data, a.valid, out)
+    scale = 10.0 ** digits
+    x = a.data * scale
+    data = jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5) / scale
+    return Val(data, a.valid, out)
+
+
+@register("power")
+def _power(args, out):
+    a, b = (cast_val(x, T.DOUBLE) for x in args)
+    return Val(jnp.power(a.data, b.data), a.valid & b.valid, out)
+
+
+# -- datetime ----------------------------------------------------------------
+
+def _date_part(part):
+    def impl(args, out):
+        (a,) = args
+        days = a.data if isinstance(a.type, T.DateType) else a.data // 86_400_000_000
+        y, m, d = _civil_from_days(days)
+        val = {"year": y, "month": m, "day": d, "quarter": (m + 2) // 3}[part]
+        return Val(val.astype(jnp.int64), a.valid, out)
+    return impl
+
+
+for _p in ["year", "month", "day", "quarter"]:
+    register(_p)(_date_part(_p))
+
+
+@register("date_add_days")
+def _date_add_days(args, out):
+    a, n = args
+    return Val(a.data + n.data.astype(a.data.dtype), a.valid & n.valid, out)
+
+
+@register("date_add_months")
+def _date_add_months(args, out):
+    a, n = args
+    y, m, d = _civil_from_days(a.data)
+    months = y * 12 + (m - 1) + n.data.astype(jnp.int64)
+    ny, nm = jnp.floor_divide(months, 12), months % 12 + 1
+    # clamp day to end of target month
+    dim_table = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+    leap = ((ny % 4 == 0) & (ny % 100 != 0)) | (ny % 400 == 0)
+    dim = jnp.take(dim_table, nm - 1) + jnp.where(leap & (nm == 2), 1, 0)
+    nd = jnp.minimum(d, dim)
+    return Val(_days_from_civil(ny, nm, nd).astype(a.data.dtype), a.valid & n.valid, out)
+
+
+@register("date_add_years")
+def _date_add_years(args, out):
+    a, n = args
+    months = Val(n.data * 12, n.valid, n.type)
+    return _date_add_months([a, months], out)
+
+
+# -- strings -----------------------------------------------------------------
+
+@register("like")
+def _like(args, out):
+    a, pat = args[0], args[1]
+    pattern = _string_literal_of(pat)
+    if pattern is None:
+        raise NotImplementedError("LIKE with non-constant pattern")
+    escape = None
+    if len(args) > 2:
+        escape = _string_literal_of(args[2])
+    if a.dictionary is None:
+        raise NotImplementedError("LIKE on non-dictionary column")
+    rx = re.compile(_like_to_regex(pattern, escape), re.DOTALL)
+    table = vocab_table(a.dictionary, lambda s: rx.fullmatch(s) is not None, np.bool_)
+    return Val(_code_gather(table, a.data), a.valid, T.BOOLEAN)
+
+
+def _vocab_transform(fn):
+    """String->string function: transform the vocab, keep the codes."""
+    def impl(args, out):
+        a = args[0]
+        if a.dictionary is None:
+            raise NotImplementedError("string fn on non-dictionary column")
+        extra = [_string_literal_of(x) if x.type.is_string
+                 else int(np.asarray(x.data)[0]) for x in args[1:]]
+        new_vocab = tuple(fn(s, *extra) for s in a.dictionary)
+        return Val(a.data, a.valid, out, dictionary=new_vocab)
+    return impl
+
+
+register("lower")(_vocab_transform(lambda s: s.lower()))
+register("upper")(_vocab_transform(lambda s: s.upper()))
+register("trim")(_vocab_transform(lambda s: s.strip()))
+# SQL substr is 1-based
+register("substr")(_vocab_transform(
+    lambda s, start, length=None: s[start - 1: start - 1 + length]
+    if length is not None else s[start - 1:]))
+
+
+@register("length")
+def _length(args, out):
+    (a,) = args
+    if a.dictionary is None:
+        raise NotImplementedError("length on non-dictionary column")
+    table = vocab_table(a.dictionary, len, np.int64)
+    return Val(_code_gather(table, a.data), a.valid, out)
+
+
+@register("concat")
+def _concat(args, out):
+    lits = [_string_literal_of(v) for v in args]
+    dyn = [i for i, l in enumerate(lits) if l is None]
+    if len(dyn) == 0:
+        return Val.constant("".join(lits), out, args[0].data.shape[0])
+    if len(dyn) == 1:
+        i = dyn[0]
+        a = args[i]
+        if a.dictionary is None:
+            raise NotImplementedError("concat on non-dictionary column")
+        prefix = "".join(lits[:i])
+        suffix = "".join(lits[i + 1:])
+        vocab = tuple(prefix + s + suffix for s in a.dictionary)
+        return Val(a.data, jnp.stack([v.valid for v in args]).all(0), out, vocab)
+    raise NotImplementedError("concat of multiple non-constant strings")
+
+
+def infer_call_type(name: str, arg_types: List[Type]) -> Type:
+    """Return type inference for scalar calls (used by the analyzer).
+
+    Mirrors the signature-resolution role of FunctionRegistry.resolveFunction
+    (reference metadata/FunctionRegistry.java) for the engine's builtins.
+    """
+    if name in ("eq", "ne", "lt", "le", "gt", "ge", "not", "like"):
+        return T.BOOLEAN
+    if name in ("add", "subtract", "multiply", "divide", "modulus"):
+        a, b = arg_types
+        if isinstance(a, T.DecimalType) or isinstance(b, T.DecimalType):
+            sa = a.scale if isinstance(a, T.DecimalType) else 0
+            pa = a.precision if isinstance(a, T.DecimalType) else 18
+            sb = b.scale if isinstance(b, T.DecimalType) else 0
+            pb = b.precision if isinstance(b, T.DecimalType) else 18
+            if T.is_floating(a) or T.is_floating(b):
+                return T.DOUBLE
+            if name == "multiply":
+                return T.DecimalType(min(18, pa + pb), min(18, sa + sb))
+            if name == "divide":
+                # Presto: scale = max(s1 + p2 - s2, ...) — simplified:
+                return T.DecimalType(18, max(sa, sb, 6))
+            s = max(sa, sb)
+            p = min(18, max(pa - sa, pb - sb) + s + 1)
+            return T.DecimalType(p, s)
+        t = T.common_super_type(a, b)
+        if t is None:
+            raise TypeError(f"{name}({a.display()}, {b.display()})")
+        return t
+    if name == "negate" or name == "abs":
+        return arg_types[0]
+    if name in ("sqrt", "ln", "exp", "power"):
+        return T.DOUBLE
+    if name in ("floor", "ceil", "round"):
+        return arg_types[0]
+    if name in ("year", "month", "day", "quarter"):
+        return T.BIGINT
+    if name in ("date_add_days", "date_add_months", "date_add_years"):
+        return arg_types[0]
+    if name in ("lower", "upper", "trim", "substr", "concat"):
+        return T.VARCHAR
+    if name == "length":
+        return T.BIGINT
+    raise KeyError(f"unknown function {name!r}")

@@ -1,0 +1,123 @@
+"""Typed scalar expression IR.
+
+Conceptual parity with Presto's RowExpression IR (reference
+presto-main/src/main/java/io/prestosql/sql/relational/RowExpression.java and
+subclasses CallExpression, ConstantExpression, InputReferenceExpression,
+SpecialForm) — the planner lowers analyzed AST expressions into this IR and
+the kernel compiler (compiler.py) traces it into XLA, playing the role of
+Presto's bytecode generator (sql/gen/PageFunctionCompiler.java).
+
+Expressions are immutable and hashable: the hash is the compile-cache key.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Tuple
+
+from ..types import Type
+
+
+class Form(enum.Enum):
+    """Special forms with non-default null/short-circuit semantics
+    (reference sql/relational/SpecialForm.java Form enum)."""
+
+    AND = "and"
+    OR = "or"
+    IF = "if"                # IF(cond, then, else)
+    COALESCE = "coalesce"
+    IS_NULL = "is_null"
+    IN = "in"                # IN(value, c1, c2, ...)
+    BETWEEN = "between"      # BETWEEN(v, lo, hi)
+    NULL_IF = "null_if"
+    SWITCH = "switch"        # SWITCH(cond1, val1, cond2, val2, ..., default)
+
+
+@dataclasses.dataclass(frozen=True)
+class Expr:
+    type: Type
+
+    def children(self) -> Tuple["Expr", ...]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class InputRef(Expr):
+    """Reference to input column by position (InputReferenceExpression)."""
+
+    index: int = 0
+
+    def __repr__(self) -> str:
+        return f"#{self.index}:{self.type.display()}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expr):
+    """Constant. value is the python-domain value (None = NULL).
+
+    Hashability: python scalars and strings only — arrays never appear here.
+    """
+
+    value: Any = None
+
+    def __repr__(self) -> str:
+        return f"lit({self.value!r}:{self.type.display()})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(Expr):
+    """Scalar function call, including operators (name like 'add', 'eq')."""
+
+    name: str = ""
+    args: Tuple[Expr, ...] = ()
+
+    def children(self) -> Tuple[Expr, ...]:
+        return self.args
+
+    def __repr__(self) -> str:
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expr):
+    arg: Optional[Expr] = None
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.arg,)
+
+    def __repr__(self) -> str:
+        return f"cast({self.arg!r} as {self.type.display()})"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecialForm(Expr):
+    form: Form = Form.AND
+    args: Tuple[Expr, ...] = ()
+
+    def children(self) -> Tuple[Expr, ...]:
+        return self.args
+
+    def __repr__(self) -> str:
+        return f"{self.form.value}({', '.join(map(repr, self.args))})"
+
+
+# -- convenience constructors ------------------------------------------------
+
+def input_ref(index: int, type: Type) -> InputRef:
+    return InputRef(type=type, index=index)
+
+
+def lit(value: Any, type: Type) -> Literal:
+    return Literal(type=type, value=value)
+
+
+def call(name: str, type: Type, *args: Expr) -> Call:
+    return Call(type=type, name=name, args=tuple(args))
+
+
+def cast(arg: Expr, to_type: Type) -> Cast:
+    return Cast(type=to_type, arg=arg)
+
+
+def special(form: Form, type: Type, *args: Expr) -> SpecialForm:
+    return SpecialForm(type=type, form=form, args=tuple(args))

@@ -1,0 +1,3 @@
+from .aggregation import AggSpec, grouped_aggregate, global_aggregate  # noqa: F401
+from .sort import SortKey, sort_batch, top_n, limit  # noqa: F401
+from .join import lookup_join, semi_join_mask  # noqa: F401

@@ -1,0 +1,352 @@
+"""Aggregation kernels: sort + segment-reduce group-by.
+
+The TPU-native replacement for Presto's hash aggregation stack (reference
+presto-main/.../operator/HashAggregationOperator.java:48,
+MultiChannelGroupByHash.java, aggregation/builder/
+InMemoryHashAggregationBuilder.java): instead of an open-addressing hash
+table over channels, we sort rows by their group keys (lexicographic
+``lax.sort``), detect segment boundaries, assign dense group ids by prefix
+sum, and run ``jax.ops.segment_*`` reductions — everything static-shape and
+branch-free on the VPU. NULL is a group key value like any other (SQL GROUP
+BY semantics), encoded as a leading null-rank sort operand.
+
+Two-phase execution mirrors Presto's PARTIAL/FINAL split (reference
+AggregationNode.Step): partial emits state columns (sum+count, min+count...),
+final re-aggregates states after an exchange. States are ordinary columns, so
+the exchange layer needs no special serialization.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..batch import Batch, Column, Schema
+from ..types import Type
+
+_SUPPORTED = ("sum", "count", "count_star", "min", "max", "avg")
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: fn over an input column (None for count(*))."""
+
+    fn: str
+    input: Optional[int]          # column index in the input batch
+    output_type: Type
+    name: str = ""                # output column name
+
+    def __post_init__(self):
+        assert self.fn in _SUPPORTED, self.fn
+
+    # state layout produced by partial mode / consumed by final mode
+    def state_types(self) -> List[Tuple[str, Type]]:
+        base = self.name or self.fn
+        if self.fn in ("count", "count_star"):
+            return [(f"{base}$cnt", T.BIGINT)]
+        if self.fn == "avg":
+            return [(f"{base}$sum", self._sum_type()), (f"{base}$cnt", T.BIGINT)]
+        return [(f"{base}$val", self._sum_type() if self.fn == "sum" else self.output_type),
+                (f"{base}$cnt", T.BIGINT)]
+
+    def _sum_type(self) -> Type:
+        if self.fn == "avg":
+            # avg accumulates in the input/widened domain
+            return self.output_type if not isinstance(self.output_type, T.DecimalType) \
+                else T.DecimalType(18, self.output_type.scale)
+        return self.output_type
+
+
+def _group_sort(batch: Batch, group_indices: Sequence[int]):
+    """Sort rows by group keys; return (key_operands, permuted batch arrays).
+
+    Returns (sorted_cols, sorted_validity, sorted_mask, boundary, group_id,
+    num_groups) where boundary marks the first live row of each group.
+    """
+    dead_rank = jnp.where(batch.row_mask, 0, 1).astype(jnp.int32)
+    key_ops: List[jnp.ndarray] = [dead_rank]
+    for gi in group_indices:
+        c = batch.columns[gi]
+        data = c.data
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+        key_ops.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))  # nulls last
+        key_ops.append(data)
+    payload: List[jnp.ndarray] = [batch.row_mask]
+    for c in batch.columns:
+        payload.append(c.data)
+        payload.append(c.validity)
+    out = jax.lax.sort(key_ops + payload, num_keys=len(key_ops), is_stable=True)
+    s_keys = out[1:len(key_ops)]          # sorted key operands (minus dead rank)
+    s_mask = out[len(key_ops)]
+    s_data = out[len(key_ops) + 1::2]
+    s_valid = out[len(key_ops) + 2::2]
+
+    # boundary: live row whose keys differ from the previous row (or row 0)
+    diff = jnp.zeros_like(s_mask)
+    for op in s_keys:
+        prev = jnp.roll(op, 1)
+        diff = diff | (op != prev)
+    first = jnp.zeros_like(s_mask).at[0].set(True)
+    boundary = s_mask & (diff | first)
+    group_id = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    group_id = jnp.maximum(group_id, 0)
+    num_groups = jnp.sum(boundary.astype(jnp.int64))
+    return s_data, s_valid, s_mask, boundary, group_id, num_groups
+
+
+def _segment_aggs(
+    aggs: Sequence[AggSpec],
+    col_data: Sequence[jnp.ndarray],
+    col_valid: Sequence[jnp.ndarray],
+    mask: jnp.ndarray,
+    group_id: jnp.ndarray,
+    cap: int,
+    from_states: bool,
+) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Per-aggregate (value_arrays...) segment reductions.
+
+    Returns, per agg, a list of (data, counts-ish) arrays matching its state
+    layout when ``from_states`` is False, or merged states when True.
+    """
+    results = []
+    state_cursor = 0
+    for agg in aggs:
+        if from_states:
+            # inputs are state columns in layout order
+            n_state = len(agg.state_types())
+            s_cols = list(range(state_cursor, state_cursor + n_state))
+            state_cursor += n_state
+            if agg.fn in ("count", "count_star"):
+                cnt_in = jnp.where(mask, col_data[s_cols[0]], 0)
+                cnt = jax.ops.segment_sum(cnt_in, group_id, num_segments=cap)
+                results.append((cnt,))
+                continue
+            val_in = col_data[s_cols[0]]
+            cnt_raw = col_data[s_cols[1]]
+            cnt_in = jnp.where(mask, cnt_raw, 0)
+            cnt = jax.ops.segment_sum(cnt_in, group_id, num_segments=cap)
+            live = mask & (cnt_raw > 0)
+            if agg.fn in ("sum", "avg"):
+                contrib = jnp.where(live, val_in, jnp.zeros_like(val_in))
+                val = jax.ops.segment_sum(contrib, group_id, num_segments=cap)
+            elif agg.fn == "min":
+                sent = _max_sentinel(val_in.dtype)
+                contrib = jnp.where(live, val_in, sent)
+                val = jax.ops.segment_min(contrib, group_id, num_segments=cap)
+            else:  # max
+                sent = _min_sentinel(val_in.dtype)
+                contrib = jnp.where(live, val_in, sent)
+                val = jax.ops.segment_max(contrib, group_id, num_segments=cap)
+            results.append((val, cnt))
+            continue
+        # raw-input mode
+        if agg.fn == "count_star":
+            cnt = jax.ops.segment_sum(
+                mask.astype(jnp.int64), group_id, num_segments=cap)
+            results.append((cnt,))
+            continue
+        data = col_data[agg.input]
+        valid = col_valid[agg.input] & mask
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), group_id, num_segments=cap)
+        if agg.fn == "count":
+            results.append((cnt,))
+            continue
+        acc_t = agg.state_types()[0][1]
+        acc_dtype = acc_t.storage_dtype
+        x = data.astype(acc_dtype)
+        if agg.fn in ("sum", "avg"):
+            if isinstance(acc_t, T.DecimalType) and isinstance(agg.output_type, T.DecimalType):
+                pass  # same scale accumulate
+            contrib = jnp.where(valid, x, jnp.zeros_like(x))
+            val = jax.ops.segment_sum(contrib, group_id, num_segments=cap)
+        elif agg.fn == "min":
+            contrib = jnp.where(valid, x, _max_sentinel(acc_dtype))
+            val = jax.ops.segment_min(contrib, group_id, num_segments=cap)
+        else:
+            contrib = jnp.where(valid, x, _min_sentinel(acc_dtype))
+            val = jax.ops.segment_max(contrib, group_id, num_segments=cap)
+        results.append((val, cnt))
+    return results
+
+
+def _max_sentinel(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype=dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype=dtype)
+
+
+def _min_sentinel(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype=dtype)
+    return jnp.asarray(jnp.iinfo(dtype).min, dtype=dtype)
+
+
+def _finalize(agg: AggSpec, parts: Tuple[jnp.ndarray, ...]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """state -> (output data, output validity)."""
+    if agg.fn in ("count", "count_star"):
+        return parts[0], jnp.ones_like(parts[0], dtype=bool)
+    val, cnt = parts
+    valid = cnt > 0
+    if agg.fn == "avg":
+        if isinstance(agg.output_type, T.DecimalType):
+            den = jnp.maximum(cnt, 1)
+            q = val / den
+            out = (jnp.sign(q) * jnp.floor(jnp.abs(val) / den + 0.5)).astype(jnp.int64)
+            return out, valid
+        den = jnp.maximum(cnt, 1).astype(val.dtype)
+        return val / den, valid
+    out = val.astype(agg.output_type.storage_dtype)
+    return out, valid
+
+
+def grouped_aggregate(
+    batch: Batch,
+    group_indices: Sequence[int],
+    aggs: Sequence[AggSpec],
+    mode: str = "single",
+    output_capacity: Optional[int] = None,
+) -> Batch:
+    """GROUP BY aggregation. mode: 'single' | 'partial' | 'final'.
+
+    In 'final' mode the input batch layout must be
+    [group key columns..., state columns in agg order...] — i.e. the output
+    layout of 'partial' mode (possibly concatenated/exchanged in between).
+    """
+    assert mode in ("single", "partial", "final")
+    cap = output_capacity or batch.capacity
+    s_data, s_valid, s_mask, boundary, group_id, num_groups = _group_sort(
+        batch, group_indices)
+
+    # group key output: gather the first row of each segment
+    bidx = jnp.nonzero(boundary, size=cap, fill_value=batch.capacity - 1)[0]
+    out_mask = jnp.arange(cap) < num_groups
+    key_cols = []
+    for gi in group_indices:
+        c = batch.columns[gi]
+        key_cols.append(Column(
+            c.type,
+            jnp.take(s_data[gi], bidx, axis=0),
+            jnp.take(s_valid[gi], bidx, axis=0) & out_mask,
+            c.dictionary,
+        ))
+
+    from_states = (mode == "final")
+    if from_states:
+        n_keys = len(group_indices)
+        state_data = s_data[n_keys:]
+        seg = _segment_aggs(aggs, state_data, s_valid[n_keys:], s_mask,
+                            group_id, cap, from_states=True)
+    else:
+        seg = _segment_aggs(aggs, s_data, s_valid, s_mask, group_id, cap,
+                            from_states=False)
+
+    out_cols: List[Column] = list(key_cols)
+    out_fields: List[Tuple[str, Type]] = [
+        (batch.schema.names[gi], batch.schema.types[gi]) for gi in group_indices
+    ]
+    if mode in ("partial",):
+        for agg, parts in zip(aggs, seg):
+            for (fname, ftype), arr in zip(agg.state_types(), parts):
+                out_fields.append((fname, ftype))
+                out_cols.append(Column(
+                    ftype, arr.astype(ftype.storage_dtype), out_mask, None))
+    else:
+        for agg, parts in zip(aggs, seg):
+            data, valid = _finalize(agg, parts)
+            name = agg.name or agg.fn
+            out_fields.append((name, agg.output_type))
+            out_cols.append(Column(
+                agg.output_type, data.astype(agg.output_type.storage_dtype),
+                valid & out_mask, None))
+    return Batch(Schema(out_fields), out_cols, out_mask)
+
+
+def global_aggregate(
+    batch: Batch, aggs: Sequence[AggSpec], mode: str = "single"
+) -> Batch:
+    """Aggregation without GROUP BY: one output row, even over empty input
+    (reference AggregationOperator.java global aggregation semantics)."""
+    assert mode in ("single", "partial", "final")
+    cap = 128  # minimum bucket; one live row
+    mask = batch.row_mask
+    out_fields: List[Tuple[str, Type]] = []
+    out_cols: List[Column] = []
+    out_mask = jnp.arange(cap) < 1
+
+    def pad(scalar, dtype):
+        return jnp.zeros(cap, dtype=dtype).at[0].set(scalar.astype(dtype))
+
+    state_cursor = 0
+    for agg in aggs:
+        if mode == "final":
+            n_state = len(agg.state_types())
+            cols = batch.columns[state_cursor:state_cursor + n_state]
+            state_cursor += n_state
+            if agg.fn in ("count", "count_star"):
+                cnt = jnp.sum(jnp.where(mask, cols[0].data, 0))
+                parts: Tuple[jnp.ndarray, ...] = (cnt,)
+            else:
+                cnt_raw = cols[1].data
+                live = mask & (cnt_raw > 0)
+                cnt = jnp.sum(jnp.where(mask, cnt_raw, 0))
+                v = cols[0].data
+                if agg.fn in ("sum", "avg"):
+                    val = jnp.sum(jnp.where(live, v, jnp.zeros_like(v)))
+                elif agg.fn == "min":
+                    val = jnp.min(jnp.where(live, v, _max_sentinel(v.dtype)))
+                else:
+                    val = jnp.max(jnp.where(live, v, _min_sentinel(v.dtype)))
+                parts = (val, cnt)
+        else:
+            if agg.fn == "count_star":
+                parts = (jnp.sum(mask.astype(jnp.int64)),)
+            else:
+                c = batch.columns[agg.input]
+                valid = c.validity & mask
+                cnt = jnp.sum(valid.astype(jnp.int64))
+                if agg.fn == "count":
+                    parts = (cnt,)
+                else:
+                    acc_dtype = agg.state_types()[0][1].storage_dtype
+                    x = c.data.astype(acc_dtype)
+                    if agg.fn in ("sum", "avg"):
+                        val = jnp.sum(jnp.where(valid, x, jnp.zeros_like(x)))
+                    elif agg.fn == "min":
+                        val = jnp.min(jnp.where(valid, x, _max_sentinel(acc_dtype)))
+                    else:
+                        val = jnp.max(jnp.where(valid, x, _min_sentinel(acc_dtype)))
+                    parts = (val, cnt)
+        if mode == "partial":
+            for (fname, ftype), arr in zip(agg.state_types(), parts):
+                out_fields.append((fname, ftype))
+                out_cols.append(Column(ftype, pad(arr, ftype.storage_dtype),
+                                       out_mask, None))
+        else:
+            if agg.fn in ("count", "count_star"):
+                data, valid = parts[0], jnp.asarray(True)
+            else:
+                data, valid = _finalize_scalar(agg, parts)
+            name = agg.name or agg.fn
+            out_fields.append((name, agg.output_type))
+            dt = agg.output_type.storage_dtype
+            out_cols.append(Column(
+                agg.output_type, pad(data, dt),
+                jnp.zeros(cap, dtype=bool).at[0].set(valid), None))
+    return Batch(Schema(out_fields), out_cols, out_mask)
+
+
+def _finalize_scalar(agg: AggSpec, parts):
+    val, cnt = parts
+    valid = cnt > 0
+    if agg.fn == "avg":
+        if isinstance(agg.output_type, T.DecimalType):
+            den = jnp.maximum(cnt, 1)
+            out = (jnp.sign(val) * jnp.floor(jnp.abs(val) / den + 0.5)).astype(jnp.int64)
+            return out, valid
+        return val / jnp.maximum(cnt, 1).astype(val.dtype), valid
+    return val, valid

@@ -1,0 +1,198 @@
+import datetime
+from decimal import Decimal
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch
+from presto_tpu.expr import (
+    Form, cast, compile_filter, compile_projection, input_ref, lit, call,
+)
+from presto_tpu.expr.ir import special
+
+
+def _batch():
+    return Batch.from_pydict({
+        "a": (T.BIGINT, [1, 2, 3, None, 5]),
+        "b": (T.DOUBLE, [10.0, 20.0, None, 40.0, 50.0]),
+        "s": (T.VARCHAR, ["MAIL", "SHIP", "AIR", "MAIL", None]),
+        "d": (T.DATE, ["1994-01-01", "1994-06-15", "1995-01-01", "1993-12-31", "1994-02-28"]),
+        "p": (T.decimal(12, 2), ["1.00", "2.50", "3.75", "4.00", None]),
+    })
+
+
+def test_arith_projection():
+    b = _batch()
+    a = input_ref(0, T.BIGINT)
+    bb = input_ref(1, T.DOUBLE)
+    exprs = [
+        call("add", T.BIGINT, a, lit(10, T.BIGINT)),
+        call("multiply", T.DOUBLE, bb, lit(2.0, T.DOUBLE)),
+    ]
+    fn = compile_projection(exprs, ["x", "y"], b.schema)
+    out = fn(b)
+    rows = out.to_pylist()
+    assert [r[0] for r in rows] == [11, 12, 13, None, 15]
+    assert [r[1] for r in rows] == [20.0, 40.0, None, 80.0, 100.0]
+
+
+def test_decimal_arith():
+    b = _batch()
+    p = input_ref(4, T.decimal(12, 2))
+    # p * 2.5 (decimal) -> scale 3
+    e = call("multiply", T.decimal(15, 3), p, lit("2.5", T.decimal(3, 1)))
+    out = compile_projection([e], ["x"], b.schema)(b)
+    vals = [r[0] for r in out.to_pylist()]
+    assert vals[0] == Decimal("2.500")
+    assert vals[2] == Decimal("9.375")
+    assert vals[4] is None
+
+
+def test_filter_three_valued_logic():
+    b = _batch()
+    a = input_ref(0, T.BIGINT)
+    # WHERE a > 1 AND b < 45  -- row2 has b NULL -> dropped
+    pred = special(
+        Form.AND, T.BOOLEAN,
+        call("gt", T.BOOLEAN, a, lit(1, T.BIGINT)),
+        call("lt", T.BOOLEAN, input_ref(1, T.DOUBLE), lit(45.0, T.DOUBLE)),
+    )
+    out = compile_filter(pred, b.schema)(b)
+    rows = out.to_pylist()
+    assert [r[0] for r in rows] == [2]
+
+
+def test_or_null_semantics():
+    b = Batch.from_pydict({"x": (T.BOOLEAN, [True, False, None])})
+    pred = special(
+        Form.OR, T.BOOLEAN,
+        input_ref(0, T.BOOLEAN),
+        lit(None, T.BOOLEAN),
+    )
+    out = compile_filter(pred, b.schema)(b)
+    # TRUE OR NULL = TRUE; FALSE OR NULL = NULL; NULL OR NULL = NULL
+    assert len(out.to_pylist()) == 1
+
+
+def test_string_predicates():
+    b = _batch()
+    s = input_ref(2, T.VARCHAR)
+    in_pred = special(
+        Form.IN, T.BOOLEAN, s,
+        lit("MAIL", T.VARCHAR), lit("SHIP", T.VARCHAR),
+    )
+    out = compile_filter(in_pred, b.schema)(b)
+    assert [r[0] for r in out.to_pylist()] == [1, 2, None]
+
+    like = call("like", T.BOOLEAN, s, lit("%AI%", T.VARCHAR))
+    out2 = compile_filter(like, b.schema)(b)
+    assert sorted(r[2] for r in out2.to_pylist()) == ["AIR", "MAIL", "MAIL"]
+
+
+def test_string_transform_and_compare():
+    b = _batch()
+    s = input_ref(2, T.VARCHAR)
+    lower = call("lower", T.VARCHAR, s)
+    out = compile_projection([lower], ["l"], b.schema)(b)
+    assert [r[0] for r in out.to_pylist()] == ["mail", "ship", "air", "mail", None]
+
+    ltp = call("lt", T.BOOLEAN, s, lit("MAIL", T.VARCHAR))
+    out2 = compile_filter(ltp, b.schema)(b)
+    assert [r[2] for r in out2.to_pylist()] == ["AIR"]
+
+
+def test_date_functions():
+    b = _batch()
+    d = input_ref(3, T.DATE)
+    y = call("year", T.BIGINT, d)
+    m = call("month", T.BIGINT, d)
+    out = compile_projection([y, m], ["y", "m"], b.schema)(b)
+    rows = out.to_pylist()
+    assert [r[0] for r in rows] == [1994, 1994, 1995, 1993, 1994]
+    assert [r[1] for r in rows] == [1, 6, 1, 12, 2]
+
+
+def test_date_between():
+    b = _batch()
+    d = input_ref(3, T.DATE)
+    pred = special(
+        Form.BETWEEN, T.BOOLEAN, d,
+        lit("1994-01-01", T.DATE), lit("1994-12-31", T.DATE),
+    )
+    out = compile_filter(pred, b.schema)(b)
+    assert len(out.to_pylist()) == 3
+
+
+def test_date_add_months_clamps():
+    b = Batch.from_pydict({"d": (T.DATE, ["2000-01-31", "2000-02-29"])})
+    e = call("date_add_months", T.DATE, input_ref(0, T.DATE), lit(1, T.INTEGER))
+    out = compile_projection([e], ["d2"], b.schema)(b)
+    assert [r[0] for r in out.to_pylist()] == [
+        datetime.date(2000, 2, 29), datetime.date(2000, 3, 29)]
+
+
+def test_case_switch():
+    b = _batch()
+    s = input_ref(2, T.VARCHAR)
+    e = special(
+        Form.SWITCH, T.BIGINT,
+        call("eq", T.BOOLEAN, s, lit("MAIL", T.VARCHAR)), lit(1, T.BIGINT),
+        call("eq", T.BOOLEAN, s, lit("SHIP", T.VARCHAR)), lit(2, T.BIGINT),
+        lit(0, T.BIGINT),
+    )
+    out = compile_projection([e], ["c"], b.schema)(b)
+    assert [r[0] for r in out.to_pylist()] == [1, 2, 0, 1, 0]
+
+
+def test_coalesce_and_is_null():
+    b = _batch()
+    a = input_ref(0, T.BIGINT)
+    e = special(Form.COALESCE, T.BIGINT, a, lit(-1, T.BIGINT))
+    out = compile_projection([e], ["c"], b.schema)(b)
+    assert [r[0] for r in out.to_pylist()] == [1, 2, 3, -1, 5]
+
+    isn = special(Form.IS_NULL, T.BOOLEAN, a)
+    out2 = compile_projection([isn], ["n"], b.schema)(b)
+    assert [r[0] for r in out2.to_pylist()] == [False, False, False, True, False]
+
+
+def test_cast_decimal_double():
+    b = _batch()
+    p = input_ref(4, T.decimal(12, 2))
+    e = cast(p, T.DOUBLE)
+    out = compile_projection([e], ["x"], b.schema)(b)
+    assert [r[0] for r in out.to_pylist()] == [1.0, 2.5, 3.75, 4.0, None]
+
+    e2 = cast(input_ref(1, T.DOUBLE), T.BIGINT)
+    out2 = compile_projection([e2], ["x"], b.schema)(b)
+    assert [r[0] for r in out2.to_pylist()] == [10, 20, None, 40, 50]
+
+
+def test_division_by_zero_is_null():
+    b = Batch.from_pydict({
+        "x": (T.BIGINT, [10, 7]),
+        "y": (T.BIGINT, [0, 2]),
+    })
+    e = call("divide", T.BIGINT, input_ref(0, T.BIGINT), input_ref(1, T.BIGINT))
+    out = compile_projection([e], ["q"], b.schema)(b)
+    assert [r[0] for r in out.to_pylist()] == [None, 3]
+
+
+def test_q6_style_predicate():
+    """TPC-H Q6 shape: date range + discount between + quantity bound."""
+    b = Batch.from_pydict({
+        "shipdate": (T.DATE, ["1994-03-01", "1993-05-05", "1994-11-30"]),
+        "discount": (T.DOUBLE, [0.06, 0.06, 0.01]),
+        "quantity": (T.DOUBLE, [10.0, 10.0, 30.0]),
+        "extendedprice": (T.DOUBLE, [100.0, 200.0, 300.0]),
+    })
+    pred = special(
+        Form.AND, T.BOOLEAN,
+        call("ge", T.BOOLEAN, input_ref(0, T.DATE), lit("1994-01-01", T.DATE)),
+        call("lt", T.BOOLEAN, input_ref(0, T.DATE), lit("1995-01-01", T.DATE)),
+        special(Form.BETWEEN, T.BOOLEAN, input_ref(1, T.DOUBLE),
+                lit(0.05, T.DOUBLE), lit(0.07, T.DOUBLE)),
+        call("lt", T.BOOLEAN, input_ref(2, T.DOUBLE), lit(24.0, T.DOUBLE)),
+    )
+    out = compile_filter(pred, b.schema)(b)
+    assert [r[3] for r in out.to_pylist()] == [100.0]

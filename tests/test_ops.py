@@ -1,0 +1,161 @@
+from decimal import Decimal
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch, concat_batches
+from presto_tpu.ops import (
+    AggSpec, SortKey, global_aggregate, grouped_aggregate, limit,
+    lookup_join, semi_join_mask, sort_batch, top_n,
+)
+
+
+def test_grouped_sum_count():
+    b = Batch.from_pydict({
+        "k": (T.VARCHAR, ["a", "b", "a", "b", "a", None]),
+        "v": (T.BIGINT, [1, 2, 3, None, 5, 7]),
+    })
+    out = grouped_aggregate(
+        b, [0],
+        [AggSpec("sum", 1, T.BIGINT, "s"),
+         AggSpec("count", 1, T.BIGINT, "c"),
+         AggSpec("count_star", None, T.BIGINT, "cs")],
+    )
+    rows = sorted(out.to_pylist(), key=lambda r: (r[0] is None, r[0]))
+    assert rows == [("a", 9, 3, 3), ("b", 2, 1, 2), (None, 7, 1, 1)]
+
+
+def test_grouped_min_max_avg():
+    b = Batch.from_pydict({
+        "k": (T.BIGINT, [1, 1, 2, 2, 2]),
+        "v": (T.DOUBLE, [4.0, 2.0, 10.0, None, 20.0]),
+    })
+    out = grouped_aggregate(
+        b, [0],
+        [AggSpec("min", 1, T.DOUBLE, "mn"),
+         AggSpec("max", 1, T.DOUBLE, "mx"),
+         AggSpec("avg", 1, T.DOUBLE, "av")],
+    )
+    rows = sorted(out.to_pylist())
+    assert rows == [(1, 2.0, 4.0, 3.0), (2, 10.0, 20.0, 15.0)]
+
+
+def test_partial_final_equals_single():
+    b1 = Batch.from_pydict({
+        "k": (T.BIGINT, [1, 2, 1]),
+        "v": (T.BIGINT, [10, 20, 30]),
+    })
+    b2 = Batch.from_pydict({
+        "k": (T.BIGINT, [2, 3]),
+        "v": (T.BIGINT, [40, None]),
+    })
+    aggs = [AggSpec("sum", 1, T.BIGINT, "s"), AggSpec("avg", 1, T.DOUBLE, "a")]
+    p1 = grouped_aggregate(b1, [0], aggs, mode="partial")
+    p2 = grouped_aggregate(b2, [0], aggs, mode="partial")
+    merged = concat_batches([p1, p2])
+    out = grouped_aggregate(merged, [0], aggs, mode="final")
+    rows = sorted(out.to_pylist(), key=lambda r: r[0])
+    assert rows == [(1, 40, 20.0), (2, 60, 30.0), (3, None, None)]
+
+    single = grouped_aggregate(concat_batches([b1, b2]), [0], aggs)
+    assert sorted(single.to_pylist(), key=lambda r: r[0]) == rows
+
+
+def test_global_aggregate():
+    b = Batch.from_pydict({"v": (T.BIGINT, [5, None, 7])})
+    out = global_aggregate(b, [
+        AggSpec("sum", 0, T.BIGINT, "s"),
+        AggSpec("count", 0, T.BIGINT, "c"),
+        AggSpec("min", 0, T.BIGINT, "mn"),
+    ])
+    assert out.to_pylist() == [(12, 2, 5)]
+
+
+def test_global_aggregate_empty_input():
+    b = Batch.from_pydict({"v": (T.BIGINT, [])})
+    out = global_aggregate(b, [
+        AggSpec("sum", 0, T.BIGINT, "s"),
+        AggSpec("count", 0, T.BIGINT, "c"),
+    ])
+    # SQL: sum over empty = NULL, count = 0
+    assert out.to_pylist() == [(None, 0)]
+
+
+def test_grouped_decimal_sum_avg():
+    b = Batch.from_pydict({
+        "k": (T.BIGINT, [1, 1, 1]),
+        "v": (T.decimal(10, 2), ["1.00", "2.00", "2.01"]),
+    })
+    out = grouped_aggregate(
+        b, [0],
+        [AggSpec("sum", 1, T.decimal(18, 2), "s"),
+         AggSpec("avg", 1, T.decimal(10, 2), "a")],
+    )
+    assert out.to_pylist() == [(1, Decimal("5.01"), Decimal("1.67"))]
+
+
+def test_sort_multi_key_null_ordering():
+    b = Batch.from_pydict({
+        "a": (T.BIGINT, [2, 1, 2, None, 1]),
+        "b": (T.DOUBLE, [1.0, 9.0, 0.5, 3.0, None]),
+    })
+    out = sort_batch(b, [SortKey(0, ascending=True), SortKey(1, ascending=False)])
+    rows = out.to_pylist()
+    # a asc nulls last; within a, b desc nulls first
+    assert rows == [(1, None), (1, 9.0), (2, 1.0), (2, 0.5), (None, 3.0)]
+
+
+def test_sort_string_key():
+    b = Batch.from_pydict({"s": (T.VARCHAR, ["pear", "apple", "fig"])})
+    out = sort_batch(b, [SortKey(0)])
+    assert [r[0] for r in out.to_pylist()] == ["apple", "fig", "pear"]
+
+
+def test_top_n_and_limit():
+    b = Batch.from_pydict({"v": (T.BIGINT, [5, 3, 9, 1, 7])})
+    out = top_n(b, [SortKey(0, ascending=False)], 2)
+    assert [r[0] for r in out.to_pylist()] == [9, 7]
+    out2 = limit(b, 3)
+    assert [r[0] for r in out2.to_pylist()] == [5, 3, 9]
+
+
+def test_lookup_join_inner_left():
+    orders = Batch.from_pydict({
+        "okey": (T.BIGINT, [10, 20, 30]),
+        "cust": (T.VARCHAR, ["alice", "bob", "carol"]),
+    })
+    lineitem = Batch.from_pydict({
+        "okey": (T.BIGINT, [20, 10, 99, 20, None]),
+        "qty": (T.BIGINT, [1, 2, 3, 4, 5]),
+    })
+    out = lookup_join(lineitem, orders, [0], [0], [1], ["cust"], "inner")
+    rows = out.to_pylist()
+    assert rows == [(20, 1, "bob"), (10, 2, "alice"), (20, 4, "bob")]
+
+    out2 = lookup_join(lineitem, orders, [0], [0], [1], ["cust"], "left")
+    rows2 = out2.to_pylist()
+    assert rows2 == [
+        (20, 1, "bob"), (10, 2, "alice"), (99, 3, None), (20, 4, "bob"),
+        (None, 5, None),
+    ]
+
+
+def test_two_column_join_key():
+    build = Batch.from_pydict({
+        "a": (T.INTEGER, [1, 1, 2]),
+        "b": (T.INTEGER, [10, 20, 10]),
+        "val": (T.BIGINT, [100, 200, 300]),
+    })
+    probe = Batch.from_pydict({
+        "a": (T.INTEGER, [1, 2, 1]),
+        "b": (T.INTEGER, [20, 10, 99]),
+    })
+    out = lookup_join(probe, build, [0, 1], [0, 1], [2], ["val"], "inner")
+    assert out.to_pylist() == [(1, 20, 200), (2, 10, 300)]
+
+
+def test_semi_join_mask():
+    probe = Batch.from_pydict({"k": (T.BIGINT, [1, 2, 3, None])})
+    build = Batch.from_pydict({"k": (T.BIGINT, [2, 3])})
+    mask = semi_join_mask(probe, build, [0], [0])
+    assert list(np.asarray(mask))[:4] == [False, True, True, False]
