@@ -1,0 +1,4 @@
+from .spi import (  # noqa: F401
+    Connector, ConnectorMetadata, ConnectorSplitManager, PageSource, Split,
+    TableHandle, ColumnStats, TableStats, CatalogManager,
+)

@@ -1,0 +1,126 @@
+"""Connector SPI: the plugin boundary between the engine and data sources.
+
+Conceptual parity with Presto's SPI (reference presto-spi/src/main/java/io/
+prestosql/spi/connector/: ConnectorMetadata, ConnectorSplitManager,
+ConnectorPageSource(Provider), and spi/Plugin.java:33-78), reshaped for the
+TPU engine: a PageSource yields device Batches (struct-of-arrays) instead of
+Pages, declares which string columns have *stable dictionaries* (safe to
+compile against), and accepts column pruning + conjunctive predicate
+pushdown at split-source creation (the LazyBlock + TupleDomain roles).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from ..batch import Batch, Schema
+from ..types import Type
+
+
+@dataclasses.dataclass(frozen=True)
+class TableHandle:
+    catalog: str
+    schema: str
+    table: str
+
+    def __str__(self) -> str:
+        return f"{self.catalog}.{self.schema}.{self.table}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnStats:
+    """Per-column statistics for the cost-based optimizer (reference
+    presto-spi/.../statistics/ColumnStatistics.java)."""
+
+    distinct_count: Optional[float] = None
+    null_fraction: float = 0.0
+    min_value: Optional[Any] = None
+    max_value: Optional[Any] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TableStats:
+    row_count: Optional[float] = None
+    columns: Dict[str, ColumnStats] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """A unit of scan parallelism (reference spi/connector/ConnectorSplit).
+    ``info`` is connector-opaque."""
+
+    table: TableHandle
+    info: Tuple = ()
+
+
+class PageSource:
+    """Produces device batches for one split (reference
+    spi/connector/ConnectorPageSource.java)."""
+
+    def batches(self) -> Iterator[Batch]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class ConnectorMetadata:
+    """Catalog surface (reference spi/connector/ConnectorMetadata.java)."""
+
+    def list_tables(self, schema: Optional[str] = None) -> List[str]:
+        raise NotImplementedError
+
+    def table_schema(self, table: TableHandle) -> Schema:
+        raise NotImplementedError
+
+    def table_stats(self, table: TableHandle) -> TableStats:
+        return TableStats()
+
+
+class ConnectorSplitManager:
+    """Split enumeration (reference spi/connector/ConnectorSplitManager)."""
+
+    def splits(self, table: TableHandle, desired: int = 1) -> List[Split]:
+        raise NotImplementedError
+
+
+class Connector:
+    """One mounted catalog (reference spi/connector/Connector.java)."""
+
+    name: str = "connector"
+
+    @property
+    def metadata(self) -> ConnectorMetadata:
+        raise NotImplementedError
+
+    @property
+    def split_manager(self) -> ConnectorSplitManager:
+        raise NotImplementedError
+
+    def page_source(
+        self,
+        split: Split,
+        columns: Sequence[str],
+        pushdown: Optional[object] = None,
+        rows_per_batch: int = 1 << 17,
+    ) -> PageSource:
+        raise NotImplementedError
+
+
+class CatalogManager:
+    """catalog name -> Connector registry (reference
+    presto-main/.../metadata/CatalogManager.java + ConnectorManager)."""
+
+    def __init__(self):
+        self._catalogs: Dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._catalogs[name] = connector
+
+    def get(self, name: str) -> Connector:
+        if name not in self._catalogs:
+            raise KeyError(f"unknown catalog {name!r}")
+        return self._catalogs[name]
+
+    def names(self) -> List[str]:
+        return sorted(self._catalogs)

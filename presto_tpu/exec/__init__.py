@@ -1,0 +1,6 @@
+from .operators import (  # noqa: F401
+    Operator, TableScanOperator, FilterProjectOperator, AggregationOperator,
+    OrderByOperator, TopNOperator, LimitOperator, HashBuildOperator,
+    LookupJoinOperator, ValuesOperator,
+)
+from .driver import Driver, Pipeline, run_pipeline  # noqa: F401

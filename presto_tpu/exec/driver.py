@@ -1,0 +1,89 @@
+"""Driver: the batch-moving hot loop.
+
+Conceptual parity with Presto's Driver (reference
+presto-main/.../operator/Driver.java:262 processFor / :347 processInternal,
+page-move loop :367-400): repeatedly move output batches between adjacent
+operators, propagate finish() upstream-to-downstream, and yield after a time
+quantum so a task scheduler can interleave drivers (reference
+execution/executor/PrioritizedSplitRunner.java SPLIT_RUN_QUANTA).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from ..batch import Batch
+from .operators import Operator
+
+
+class Pipeline:
+    """A linear chain of operators, source first (reference DriverFactory)."""
+
+    def __init__(self, operators: Sequence[Operator]):
+        assert operators, "empty pipeline"
+        self.operators = list(operators)
+
+
+class Driver:
+    """Executes one pipeline instance (one 'driver' per split in Presto)."""
+
+    def __init__(self, pipeline: Pipeline, sink):
+        self.ops = pipeline.operators
+        self.sink = sink  # callable(batch)
+        self._finish_sent = [False] * len(self.ops)
+        self._done = False
+
+    def is_finished(self) -> bool:
+        return self._done
+
+    def process_for(self, quantum_seconds: float = 1.0) -> None:
+        """Run until the quantum expires or the pipeline finishes
+        (reference Driver.processFor:262)."""
+        deadline = time.monotonic() + quantum_seconds
+        while not self._done and time.monotonic() < deadline:
+            if not self._step():
+                break
+
+    def run_to_completion(self) -> None:
+        while not self._done:
+            if not self._step():
+                # no progress and not done: pipeline is stuck
+                if not self._done:
+                    raise RuntimeError("pipeline made no progress")
+
+    def _step(self) -> bool:
+        """One pass over adjacent operator pairs; returns progress."""
+        ops = self.ops
+        progress = False
+        for i in range(len(ops) - 1):
+            cur, nxt = ops[i], ops[i + 1]
+            while nxt.needs_input():
+                out = cur.get_output()
+                if out is None:
+                    break
+                nxt.add_input(out)
+                progress = True
+            if cur.is_finished() and not self._finish_sent[i + 1]:
+                nxt.finish()
+                self._finish_sent[i + 1] = True
+                progress = True
+        # drain the last operator into the sink
+        last = ops[-1]
+        while True:
+            out = last.get_output()
+            if out is None:
+                break
+            self.sink(out)
+            progress = True
+        if last.is_finished():
+            self._done = True
+        return progress
+
+
+def run_pipeline(operators: Sequence[Operator]) -> List[Batch]:
+    """Convenience: run a pipeline to completion, collecting output batches."""
+    results: List[Batch] = []
+    d = Driver(Pipeline(operators), results.append)
+    # sources need their finish() too when they self-report finished
+    d.run_to_completion()
+    return results

@@ -1,0 +1,359 @@
+"""Pull-based batch operators.
+
+Conceptual parity with Presto's operator framework (reference
+presto-main/.../operator/Operator.java:20-92: needsInput/addInput/getOutput/
+finish/isFinished), with device batches instead of Pages. Each operator owns
+its jitted kernels; the Driver moves batches between adjacent operators
+(reference operator/Driver.java:367-400).
+
+Blocking is synchronous in v1 (single-host pipelines); the exchange layer
+introduces real async sources.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import Batch, Column, Schema, bucket_capacity, concat_batches
+from ..connectors.spi import Connector, PageSource, Split
+from ..expr import compile_filter, compile_projection
+from ..expr.ir import Expr
+from ..ops.aggregation import AggSpec, global_aggregate, grouped_aggregate
+from ..ops.join import lookup_join
+from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
+
+
+class Operator:
+    """Base operator (reference operator/Operator.java:20)."""
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        raise NotImplementedError
+
+    def get_output(self) -> Optional[Batch]:
+        return None
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        raise NotImplementedError
+
+    def __init__(self):
+        self._finishing = False
+
+
+class TableScanOperator(Operator):
+    """Source operator over a connector PageSource (reference
+    operator/TableScanOperator.java)."""
+
+    def __init__(self, connector: Connector, split: Split,
+                 columns: Sequence[str], rows_per_batch: int = 1 << 17):
+        super().__init__()
+        self._iter = connector.page_source(
+            split, columns, rows_per_batch=rows_per_batch).batches()
+        self._done = False
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[Batch]:
+        if self._done:
+            return None
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._done = True
+            return None
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class ValuesOperator(Operator):
+    """Emits pre-built batches (reference operator/ValuesOperator.java)."""
+
+    def __init__(self, batches: Sequence[Batch]):
+        super().__init__()
+        self._batches = list(batches)
+        self._pos = 0
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[Batch]:
+        if self._pos < len(self._batches):
+            b = self._batches[self._pos]
+            self._pos += 1
+            return b
+        return None
+
+    def is_finished(self) -> bool:
+        return self._pos >= len(self._batches)
+
+
+class FilterProjectOperator(Operator):
+    """Fused filter + projection via compiled expressions (reference
+    operator/FilterAndProjectOperator.java + project/PageProcessor.java)."""
+
+    def __init__(self, input_schema: Schema,
+                 predicate: Optional[Expr],
+                 projections: Optional[Sequence[Expr]] = None,
+                 output_names: Optional[Sequence[str]] = None):
+        super().__init__()
+        self._filter = compile_filter(predicate, input_schema) if predicate is not None else None
+        self._project = (
+            compile_projection(list(projections), list(output_names), input_schema)
+            if projections is not None else None
+        )
+        self._pending: Optional[Batch] = None
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        if self._filter is not None:
+            batch = self._filter(batch)
+        if self._project is not None:
+            batch = self._project(batch)
+        self._pending = batch
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None
+
+
+class AggregationOperator(Operator):
+    """Grouped / global aggregation with incremental partial merging
+    (reference operator/HashAggregationOperator.java:48 and
+    AggregationOperator.java). step: 'single' | 'partial' | 'final'.
+
+    Strategy: aggregate each input batch to partial states; eagerly merge
+    into the running state while it stays small (Q1-style low cardinality),
+    otherwise buffer partials and do a hierarchical merge at finish
+    (Q3-style high cardinality) — the duality Presto gets from
+    InMemoryHashAggregationBuilder vs MergingHashAggregationBuilder.
+    """
+
+    def __init__(self, input_schema: Schema, group_indices: Sequence[int],
+                 aggs: Sequence[AggSpec], step: str = "single"):
+        super().__init__()
+        self._input_schema = input_schema
+        self._group = list(group_indices)
+        self._aggs = list(aggs)
+        self._step = step
+        self._state: Optional[Batch] = None
+        self._buffered: List[Batch] = []
+        self._emitted = False
+
+    def add_input(self, batch: Batch) -> None:
+        if not self._group:
+            mode = "merge" if self._step == "final" else "partial"
+            partial = global_aggregate(batch, self._aggs, mode=mode)
+            self._buffered.append(partial)
+            if len(self._buffered) >= 64:
+                merged = concat_batches(self._buffered)
+                self._buffered = [
+                    global_aggregate(merged, self._aggs, mode="merge")]
+            return
+        if self._step == "final":
+            partial = batch  # inputs are states already
+        else:
+            partial = grouped_aggregate(batch, self._group, self._aggs,
+                                        mode="partial")
+        if self._state is None:
+            self._state = partial
+        elif self._state.capacity <= 4 * partial.capacity:
+            # low-cardinality fast path: fold into the running state
+            merged = concat_batches([self._state, partial])
+            self._state = grouped_aggregate(
+                merged, list(range(len(self._group))), self._aggs, mode="merge")
+        else:
+            self._buffered.append(partial)
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        if not self._group:
+            if self._buffered:
+                states = (concat_batches(self._buffered)
+                          if len(self._buffered) > 1 else self._buffered[0])
+            else:
+                # SQL: global aggregate over empty input still emits one row
+                empty = Batch.from_arrays(self._input_schema,
+                                          [[] for _ in self._input_schema.fields])
+                if self._step == "final":
+                    return None
+                states = global_aggregate(empty, self._aggs, mode="partial")
+            if self._step == "partial":
+                return global_aggregate(states, self._aggs, mode="merge")
+            return global_aggregate(states, self._aggs, mode="final")
+        parts = ([self._state] if self._state is not None else []) + self._buffered
+        if not parts:
+            return None
+        states = concat_batches(parts) if len(parts) > 1 else parts[0]
+        key_idx = list(range(len(self._group)))
+        if self._step == "partial":
+            return (grouped_aggregate(states, key_idx, self._aggs, mode="merge")
+                    if len(parts) > 1 else states)
+        return grouped_aggregate(states, key_idx, self._aggs, mode="final")
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class OrderByOperator(Operator):
+    """Full sort: buffer all input, sort at finish (reference
+    operator/OrderByOperator.java)."""
+
+    def __init__(self, keys: Sequence[SortKey]):
+        super().__init__()
+        self._keys = list(keys)
+        self._buffered: List[Batch] = []
+        self._emitted = False
+
+    def add_input(self, batch: Batch) -> None:
+        self._buffered.append(batch)
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        if not self._buffered:
+            return None
+        merged = concat_batches(self._buffered) if len(self._buffered) > 1 else self._buffered[0]
+        return sort_batch(merged, self._keys)
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class TopNOperator(Operator):
+    """Memory-bounded top-N: fold each batch into the running top-N
+    (reference operator/TopNOperator.java)."""
+
+    def __init__(self, keys: Sequence[SortKey], n: int):
+        super().__init__()
+        self._keys = list(keys)
+        self._n = n
+        self._state: Optional[Batch] = None
+        self._emitted = False
+
+    def add_input(self, batch: Batch) -> None:
+        candidate = top_n(batch, self._keys, self._n).compact(
+            bucket_capacity(self._n))
+        if self._state is None:
+            self._state = candidate
+        else:
+            merged = concat_batches([self._state, candidate])
+            self._state = top_n(merged, self._keys, self._n).compact(
+                bucket_capacity(self._n))
+
+    def get_output(self) -> Optional[Batch]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        return self._state
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._emitted
+
+
+class LimitOperator(Operator):
+    """Streaming LIMIT (reference operator/LimitOperator.java)."""
+
+    def __init__(self, n: int):
+        super().__init__()
+        self._remaining = n
+        self._pending: Optional[Batch] = None
+
+    def needs_input(self) -> bool:
+        return self._pending is None and self._remaining > 0 and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        out = limit_kernel(batch, self._remaining)
+        self._remaining -= out.host_count()
+        self._pending = out
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return out
+
+    def is_finished(self) -> bool:
+        return (self._finishing or self._remaining <= 0) and self._pending is None
+
+
+class HashBuildOperator(Operator):
+    """Join build side: buffers and prepares the lookup structure (reference
+    operator/HashBuilderOperator.java:51). The 'hash table' is a sorted key
+    array probed by binary search."""
+
+    def __init__(self):
+        super().__init__()
+        self._buffered: List[Batch] = []
+        self.build_batch: Optional[Batch] = None
+
+    def add_input(self, batch: Batch) -> None:
+        self._buffered.append(batch)
+
+    def finish(self) -> None:
+        super().finish()
+        if self.build_batch is None and self._buffered:
+            self.build_batch = (
+                concat_batches(self._buffered)
+                if len(self._buffered) > 1 else self._buffered[0]
+            )
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class LookupJoinOperator(Operator):
+    """Probe side of the join (reference operator/LookupJoinOperator.java).
+    Streams probe batches against the finished build side."""
+
+    def __init__(self, build: HashBuildOperator,
+                 probe_keys: Sequence[int], build_keys: Sequence[int],
+                 payload: Sequence[int], payload_names: Sequence[str],
+                 join_type: str = "inner"):
+        super().__init__()
+        self._build_op = build
+        self._probe_keys = list(probe_keys)
+        self._build_keys = list(build_keys)
+        self._payload = list(payload)
+        self._payload_names = list(payload_names)
+        self._join_type = join_type
+        self._pending: Optional[Batch] = None
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, batch: Batch) -> None:
+        build = self._build_op.build_batch
+        if build is None:
+            # empty build side: inner join -> nothing; left join -> nulls
+            if self._join_type == "inner":
+                self._pending = Batch(batch.schema, batch.columns,
+                                      jnp.zeros_like(batch.row_mask))
+                return
+            raise NotImplementedError("left join with empty build side")
+        self._pending = lookup_join(
+            batch, build, self._probe_keys, self._build_keys,
+            self._payload, self._payload_names, self._join_type)
+
+    def get_output(self) -> Optional[Batch]:
+        out, self._pending = self._pending, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._pending is None

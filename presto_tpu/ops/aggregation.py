@@ -211,13 +211,15 @@ def grouped_aggregate(
     mode: str = "single",
     output_capacity: Optional[int] = None,
 ) -> Batch:
-    """GROUP BY aggregation. mode: 'single' | 'partial' | 'final'.
+    """GROUP BY aggregation. mode: 'single' | 'partial' | 'final' | 'merge'.
 
-    In 'final' mode the input batch layout must be
+    In 'final' and 'merge' modes the input batch layout must be
     [group key columns..., state columns in agg order...] — i.e. the output
     layout of 'partial' mode (possibly concatenated/exchanged in between).
+    'merge' re-combines state rows sharing a key but keeps the state layout
+    (Presto's intermediate combine step), enabling hierarchical merging.
     """
-    assert mode in ("single", "partial", "final")
+    assert mode in ("single", "partial", "final", "merge")
     cap = output_capacity or batch.capacity
     s_data, s_valid, s_mask, boundary, group_id, num_groups = _group_sort(
         batch, group_indices)
@@ -235,7 +237,7 @@ def grouped_aggregate(
             c.dictionary,
         ))
 
-    from_states = (mode == "final")
+    from_states = mode in ("final", "merge")
     if from_states:
         n_keys = len(group_indices)
         state_data = s_data[n_keys:]
@@ -249,7 +251,7 @@ def grouped_aggregate(
     out_fields: List[Tuple[str, Type]] = [
         (batch.schema.names[gi], batch.schema.types[gi]) for gi in group_indices
     ]
-    if mode in ("partial",):
+    if mode in ("partial", "merge"):
         for agg, parts in zip(aggs, seg):
             for (fname, ftype), arr in zip(agg.state_types(), parts):
                 out_fields.append((fname, ftype))
@@ -270,8 +272,9 @@ def global_aggregate(
     batch: Batch, aggs: Sequence[AggSpec], mode: str = "single"
 ) -> Batch:
     """Aggregation without GROUP BY: one output row, even over empty input
-    (reference AggregationOperator.java global aggregation semantics)."""
-    assert mode in ("single", "partial", "final")
+    (reference AggregationOperator.java global aggregation semantics).
+    'merge' consumes state columns and emits merged state columns."""
+    assert mode in ("single", "partial", "final", "merge")
     cap = 128  # minimum bucket; one live row
     mask = batch.row_mask
     out_fields: List[Tuple[str, Type]] = []
@@ -283,7 +286,7 @@ def global_aggregate(
 
     state_cursor = 0
     for agg in aggs:
-        if mode == "final":
+        if mode in ("final", "merge"):
             n_state = len(agg.state_types())
             cols = batch.columns[state_cursor:state_cursor + n_state]
             state_cursor += n_state
@@ -321,7 +324,7 @@ def global_aggregate(
                     else:
                         val = jnp.max(jnp.where(valid, x, _min_sentinel(acc_dtype)))
                     parts = (val, cnt)
-        if mode == "partial":
+        if mode in ("partial", "merge"):
             for (fname, ftype), arr in zip(agg.state_types(), parts):
                 out_fields.append((fname, ftype))
                 out_cols.append(Column(ftype, pad(arr, ftype.storage_dtype),

@@ -1,0 +1,218 @@
+"""Hand-built TPC-H pipelines (Q6/Q1/Q3 shapes) vs a pandas oracle.
+
+The functional spec for these pipelines is Presto's hand-built benchmark
+pipelines (reference presto-benchmark/.../HandTpchQuery6.java,
+HandTpchQuery1.java) — scan -> filter -> project -> aggregate (->join/topN).
+"""
+import datetime
+
+import pandas as pd
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.connectors.tpch import TpchConnector
+from presto_tpu.exec import (
+    AggregationOperator, FilterProjectOperator, HashBuildOperator,
+    LimitOperator, LookupJoinOperator, OrderByOperator, TableScanOperator,
+    TopNOperator, ValuesOperator, run_pipeline,
+)
+from presto_tpu.expr import Form, call, input_ref, lit
+from presto_tpu.expr.ir import special
+from presto_tpu.ops import AggSpec, SortKey
+from presto_tpu.connectors.tpch import tpch_schema
+
+SF = 0.005
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(sf=SF)
+
+
+def _df(conn, table, columns):
+    th = TableHandle("tpch", "t", table)
+    rows = []
+    for split in conn.split_manager.splits(th, 1):
+        for b in conn.page_source(split, columns).batches():
+            rows.extend(b.to_pylist())
+    return pd.DataFrame(rows, columns=columns)
+
+
+def _scan_ops(conn, table, columns, rows_per_batch=1 << 14):
+    th = TableHandle("tpch", "t", table)
+    splits = conn.split_manager.splits(th, 1)
+    return TableScanOperator(conn, splits[0], columns, rows_per_batch)
+
+
+def test_q6(conn):
+    cols = ["l_shipdate", "l_discount", "l_quantity", "l_extendedprice"]
+    schema = tpch_schema("lineitem").select(cols)
+    pred = special(
+        Form.AND, T.BOOLEAN,
+        call("ge", T.BOOLEAN, input_ref(0, T.DATE), lit("1994-01-01", T.DATE)),
+        call("lt", T.BOOLEAN, input_ref(0, T.DATE), lit("1995-01-01", T.DATE)),
+        special(Form.BETWEEN, T.BOOLEAN, input_ref(1, T.DOUBLE),
+                lit(0.05, T.DOUBLE), lit(0.07, T.DOUBLE)),
+        call("lt", T.BOOLEAN, input_ref(2, T.DOUBLE), lit(24.0, T.DOUBLE)),
+    )
+    proj = [call("multiply", T.DOUBLE, input_ref(3, T.DOUBLE), input_ref(1, T.DOUBLE))]
+    from presto_tpu.batch import Schema
+    out = run_pipeline([
+        _scan_ops(conn, "lineitem", cols),
+        FilterProjectOperator(schema, pred, proj, ["rev"]),
+        AggregationOperator(Schema([("rev", T.DOUBLE)]), [],
+                            [AggSpec("sum", 0, T.DOUBLE, "revenue")]),
+    ])
+    assert len(out) == 1
+    got = out[0].to_pylist()[0][0]
+
+    df = _df(conn, "lineitem", cols)
+    d0, d1 = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    m = ((df.l_shipdate >= d0) & (df.l_shipdate < d1)
+         & (df.l_discount >= 0.05) & (df.l_discount <= 0.07)
+         & (df.l_quantity < 24))
+    want = (df.l_extendedprice[m] * df.l_discount[m]).sum()
+    assert got == pytest.approx(want, rel=1e-12)
+
+
+def test_q1(conn):
+    cols = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+            "l_discount", "l_tax", "l_shipdate"]
+    schema = tpch_schema("lineitem").select(cols)
+    cutoff = "1998-09-02"
+    pred = call("le", T.BOOLEAN, input_ref(6, T.DATE), lit(cutoff, T.DATE))
+    one = lit(1.0, T.DOUBLE)
+    disc_price = call("multiply", T.DOUBLE, input_ref(3, T.DOUBLE),
+                      call("subtract", T.DOUBLE, one, input_ref(4, T.DOUBLE)))
+    charge = call("multiply", T.DOUBLE, disc_price,
+                  call("add", T.DOUBLE, one, input_ref(5, T.DOUBLE)))
+    proj = [input_ref(0, T.varchar(1)), input_ref(1, T.varchar(1)),
+            input_ref(2, T.DOUBLE), input_ref(3, T.DOUBLE), disc_price, charge,
+            input_ref(4, T.DOUBLE)]
+    names = ["rf", "ls", "qty", "price", "disc_price", "charge", "disc"]
+    from presto_tpu.batch import Schema
+    mid = Schema([(n, T.varchar(1)) if i < 2 else (n, T.DOUBLE)
+                  for i, n in enumerate(names)])
+    aggs = [
+        AggSpec("sum", 2, T.DOUBLE, "sum_qty"),
+        AggSpec("sum", 3, T.DOUBLE, "sum_base"),
+        AggSpec("sum", 4, T.DOUBLE, "sum_disc_price"),
+        AggSpec("sum", 5, T.DOUBLE, "sum_charge"),
+        AggSpec("avg", 2, T.DOUBLE, "avg_qty"),
+        AggSpec("avg", 3, T.DOUBLE, "avg_price"),
+        AggSpec("avg", 6, T.DOUBLE, "avg_disc"),
+        AggSpec("count_star", None, T.BIGINT, "count_order"),
+    ]
+    out = run_pipeline([
+        _scan_ops(conn, "lineitem", cols, rows_per_batch=1 << 13),
+        FilterProjectOperator(schema, pred, proj, names),
+        AggregationOperator(mid, [0, 1], aggs),
+        OrderByOperator([SortKey(0), SortKey(1)]),
+    ])
+    rows = [r for b in out for r in b.to_pylist()]
+
+    df = _df(conn, "lineitem", cols)
+    df = df[df.l_shipdate <= datetime.date(1998, 9, 2)].copy()
+    df["disc_price"] = df.l_extendedprice * (1 - df.l_discount)
+    df["charge"] = df.disc_price * (1 + df.l_tax)
+    g = df.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "size"),
+    ).reset_index().sort_values(["l_returnflag", "l_linestatus"])
+    want = [tuple(r) for r in g.itertuples(index=False)]
+    assert len(rows) == len(want)
+    for got_r, want_r in zip(rows, want):
+        assert got_r[0] == want_r[0] and got_r[1] == want_r[1]
+        for a, b in zip(got_r[2:], want_r[2:]):
+            assert a == pytest.approx(b, rel=1e-9)
+
+
+def test_q3(conn):
+    cutoff = "1995-03-15"
+    # stage 1: customers in BUILDING segment -> build (custkey)
+    ccols = ["c_custkey", "c_mktsegment"]
+    cschema = tpch_schema("customer").select(ccols)
+    cust_out = run_pipeline([
+        _scan_ops(conn, "customer", ccols),
+        FilterProjectOperator(
+            cschema,
+            call("eq", T.BOOLEAN, input_ref(1, T.varchar(10)),
+                 lit("BUILDING", T.varchar(10)))),
+    ])
+    cust_build = HashBuildOperator()
+    for b in cust_out:
+        cust_build.add_input(b)
+    cust_build.finish()
+
+    # stage 2: orders before cutoff, semi-joined to customers -> build
+    ocols = ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]
+    oschema = tpch_schema("orders").select(ocols)
+    orders_out = run_pipeline([
+        _scan_ops(conn, "orders", ocols),
+        FilterProjectOperator(
+            oschema,
+            call("lt", T.BOOLEAN, input_ref(2, T.DATE), lit(cutoff, T.DATE))),
+        LookupJoinOperator(cust_build, [1], [0], [], [], "inner"),
+    ])
+    orders_build = HashBuildOperator()
+    for b in orders_out:
+        orders_build.add_input(b)
+    orders_build.finish()
+
+    # stage 3: lineitem after cutoff -> join orders -> agg -> topN
+    lcols = ["l_orderkey", "l_shipdate", "l_extendedprice", "l_discount"]
+    lschema = tpch_schema("lineitem").select(lcols)
+    from presto_tpu.batch import Schema
+    joined_schema = Schema([
+        ("l_orderkey", T.BIGINT), ("rev", T.DOUBLE),
+        ("o_orderdate", T.DATE), ("o_shippriority", T.INTEGER),
+    ])
+    rev = call("multiply", T.DOUBLE, input_ref(2, T.DOUBLE),
+               call("subtract", T.DOUBLE, lit(1.0, T.DOUBLE),
+                    input_ref(3, T.DOUBLE)))
+    out = run_pipeline([
+        _scan_ops(conn, "lineitem", lcols, rows_per_batch=1 << 13),
+        FilterProjectOperator(
+            lschema,
+            call("gt", T.BOOLEAN, input_ref(1, T.DATE), lit(cutoff, T.DATE))),
+        LookupJoinOperator(orders_build, [0], [0], [2, 3],
+                           ["o_orderdate", "o_shippriority"], "inner"),
+        FilterProjectOperator(
+            Schema(list(zip(lschema.names, lschema.types))
+                   + [("o_orderdate", T.DATE), ("o_shippriority", T.INTEGER)]),
+            None,
+            [input_ref(0, T.BIGINT), rev, input_ref(4, T.DATE),
+             input_ref(5, T.INTEGER)],
+            ["l_orderkey", "rev", "o_orderdate", "o_shippriority"]),
+        AggregationOperator(joined_schema, [0, 2, 3],
+                            [AggSpec("sum", 1, T.DOUBLE, "revenue")]),
+        TopNOperator([SortKey(3, ascending=False), SortKey(1)], 10),
+    ])
+    rows = [r for b in out for r in b.to_pylist()]
+    # agg output layout: [l_orderkey, o_orderdate, o_shippriority, revenue]
+
+    # oracle
+    cust = _df(conn, "customer", ccols)
+    orders = _df(conn, "orders", ocols)
+    li = _df(conn, "lineitem", lcols)
+    cutoff_d = datetime.date(1995, 3, 15)
+    cust = cust[cust.c_mktsegment == "BUILDING"]
+    orders = orders[(orders.o_orderdate < cutoff_d)
+                    & orders.o_custkey.isin(cust.c_custkey)]
+    li = li[li.l_shipdate > cutoff_d]
+    j = li.merge(orders, left_on="l_orderkey", right_on="o_orderkey")
+    j["rev"] = j.l_extendedprice * (1 - j.l_discount)
+    g = (j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["rev"]
+         .sum().reset_index())
+    g = g.sort_values(["rev", "o_orderdate"], ascending=[False, True]).head(10)
+    want = [(int(r.l_orderkey), r.o_orderdate, int(r.o_shippriority),
+             pytest.approx(r.rev, rel=1e-9)) for r in g.itertuples(index=False)]
+    assert rows == want
