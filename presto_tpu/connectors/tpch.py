@@ -26,7 +26,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import types as T
-from ..batch import Batch, Schema, bucket_capacity
+from ..batch import Batch, Schema
 from .spi import (
     ColumnStats, Connector, ConnectorMetadata, ConnectorSplitManager,
     PageSource, Split, TableHandle, TableStats,
@@ -60,13 +60,9 @@ def _randint(key, tag, lo, hi) -> np.ndarray:
     """Uniform integers in [lo, hi] as int64."""
     h = _h(key, tag)
     span = _U64(hi - lo + 1)
-    return (lo + (h % span)).astype(np.int64)
-
-
-def _uniform(key, tag, lo, hi) -> np.ndarray:
-    h = _h(key, tag)
-    u = (h >> _U64(11)).astype(np.float64) * (2.0 ** -53)
-    return lo + u * (hi - lo)
+    # add in int64: NumPy 2 (NEP 50) raises OverflowError mixing a negative
+    # python int with a uint64 array
+    return np.int64(lo) + (h % span).astype(np.int64)
 
 
 def _money(key, tag, lo, hi) -> np.ndarray:

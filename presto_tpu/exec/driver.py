@@ -52,17 +52,21 @@ class Driver:
                     raise RuntimeError("pipeline made no progress")
 
     def _step(self) -> bool:
-        """One pass over adjacent operator pairs; returns progress."""
+        """One pass over adjacent operator pairs; returns progress.
+
+        Moves at most ONE batch per pair per pass (like processInternal's
+        page-move loop) so process_for's quantum stays meaningful — a
+        greedy drain here would run a whole scan before the deadline check.
+        """
         ops = self.ops
         progress = False
         for i in range(len(ops) - 1):
             cur, nxt = ops[i], ops[i + 1]
-            while nxt.needs_input():
+            if nxt.needs_input():
                 out = cur.get_output()
-                if out is None:
-                    break
-                nxt.add_input(out)
-                progress = True
+                if out is not None:
+                    nxt.add_input(out)
+                    progress = True
             if cur.is_finished() and not self._finish_sent[i + 1]:
                 nxt.finish()
                 self._finish_sent[i + 1] = True
@@ -77,6 +81,8 @@ class Driver:
             progress = True
         if last.is_finished():
             self._done = True
+            for op in ops:
+                op.close()
         return progress
 
 

@@ -11,13 +11,10 @@ introduces real async sources.
 """
 from __future__ import annotations
 
-import dataclasses
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 
-from .. import types as T
 from ..batch import Batch, Column, Schema, bucket_capacity, concat_batches
 from ..connectors.spi import Connector, PageSource, Split
 from ..expr import compile_filter, compile_projection
@@ -45,6 +42,10 @@ class Operator:
     def is_finished(self) -> bool:
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release held resources; called by the Driver when the pipeline
+        ends, including early termination (reference Operator.close())."""
+
     def __init__(self):
         self._finishing = False
 
@@ -56,8 +57,9 @@ class TableScanOperator(Operator):
     def __init__(self, connector: Connector, split: Split,
                  columns: Sequence[str], rows_per_batch: int = 1 << 17):
         super().__init__()
-        self._iter = connector.page_source(
-            split, columns, rows_per_batch=rows_per_batch).batches()
+        self._source = connector.page_source(
+            split, columns, rows_per_batch=rows_per_batch)
+        self._iter = self._source.batches()
         self._done = False
 
     def needs_input(self) -> bool:
@@ -70,10 +72,14 @@ class TableScanOperator(Operator):
             return next(self._iter)
         except StopIteration:
             self._done = True
+            self._source.close()
             return None
 
     def is_finished(self) -> bool:
         return self._done
+
+    def close(self) -> None:
+        self._source.close()
 
 
 class ValuesOperator(Operator):
@@ -175,8 +181,15 @@ class AggregationOperator(Operator):
         elif self._state.capacity <= 4 * partial.capacity:
             # low-cardinality fast path: fold into the running state
             merged = concat_batches([self._state, partial])
-            self._state = grouped_aggregate(
+            state = grouped_aggregate(
                 merged, list(range(len(self._group))), self._aggs, mode="merge")
+            if state.capacity > 4 * partial.capacity:
+                # merge output keeps its input's (concatenated) capacity, so
+                # the state grows each fold; periodically compact back down
+                # to the live group count (one host sync), and if it really
+                # is high-cardinality, stop eager merging for good.
+                state = state.compact(bucket_capacity(state.host_count()))
+            self._state = state
         else:
             self._buffered.append(partial)
 
@@ -325,7 +338,8 @@ class LookupJoinOperator(Operator):
     def __init__(self, build: HashBuildOperator,
                  probe_keys: Sequence[int], build_keys: Sequence[int],
                  payload: Sequence[int], payload_names: Sequence[str],
-                 join_type: str = "inner"):
+                 join_type: str = "inner",
+                 build_schema: Optional[Schema] = None):
         super().__init__()
         self._build_op = build
         self._probe_keys = list(probe_keys)
@@ -333,20 +347,37 @@ class LookupJoinOperator(Operator):
         self._payload = list(payload)
         self._payload_names = list(payload_names)
         self._join_type = join_type
+        self._build_schema = build_schema
         self._pending: Optional[Batch] = None
 
     def needs_input(self) -> bool:
         return self._pending is None and not self._finishing
 
+    def _empty_build_output(self, batch: Batch) -> Batch:
+        """Empty build side: keep the joined schema contract — probe columns
+        plus (all-null) payload columns; inner join masks every row out."""
+        if self._build_schema is None:
+            raise ValueError(
+                "join build side produced no rows and no build_schema was "
+                "given to emit the joined schema")
+        fields = list(zip(batch.schema.names, batch.schema.types))
+        cols = list(batch.columns)
+        no_valid = jnp.zeros_like(batch.row_mask)
+        for ci, name in zip(self._payload, self._payload_names):
+            typ = self._build_schema.types[ci]
+            fields.append((name, typ))
+            cols.append(Column(
+                typ, jnp.zeros(batch.capacity, dtype=typ.storage_dtype),
+                no_valid, () if typ.is_string else None))
+        mask = (jnp.zeros_like(batch.row_mask) if self._join_type == "inner"
+                else batch.row_mask)
+        return Batch(Schema(fields), cols, mask)
+
     def add_input(self, batch: Batch) -> None:
         build = self._build_op.build_batch
         if build is None:
-            # empty build side: inner join -> nothing; left join -> nulls
-            if self._join_type == "inner":
-                self._pending = Batch(batch.schema, batch.columns,
-                                      jnp.zeros_like(batch.row_mask))
-                return
-            raise NotImplementedError("left join with empty build side")
+            self._pending = self._empty_build_output(batch)
+            return
         self._pending = lookup_join(
             batch, build, self._probe_keys, self._build_keys,
             self._payload, self._payload_names, self._join_type)

@@ -1,0 +1,5 @@
+from .mesh import make_mesh, default_axis  # noqa: F401
+from .exchange import (  # noqa: F401
+    hash_partition_ids, repartition_by_hash, broadcast_batch, shard_batch,
+    local_shard,
+)

@@ -1,0 +1,32 @@
+"""Device mesh construction for distributed query execution.
+
+The TPU-native replacement for Presto's worker-node topology (reference
+presto-main/.../metadata/DiscoveryNodeManager.java:68 tracks workers;
+execution/scheduler/NodeScheduler.java places splits on them): a stage's
+"tasks" become shards of one SPMD program laid over a jax.sharding.Mesh
+axis, so the hash-exchange between stages rides ICI collectives instead of
+HTTP page transfers.
+
+One flat data-parallel axis ("dp") is the default — Presto's
+FIXED_HASH_DISTRIBUTION over N workers maps to shard_map over dp with an
+all-to-all per exchange. Multi-axis meshes (dp × within-host) are layered
+on later by the stage scheduler.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+default_axis = "dp"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = default_axis) -> jax.sharding.Mesh:
+    """A 1-D mesh over the first n devices (all by default)."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, have {len(devices)}")
+    return jax.sharding.Mesh(np.array(devices[:n]), (axis,))

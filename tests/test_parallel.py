@@ -1,0 +1,117 @@
+"""Exchange collectives on the virtual 8-device CPU mesh.
+
+Ring-3 analogue of Presto's multi-node-in-one-JVM tests (reference
+presto-tests/.../DistributedQueryRunner.java:76): N shards in one process,
+real collectives, results checked against the single-device path.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from presto_tpu import types as T
+from presto_tpu.batch import Batch
+from presto_tpu.ops.aggregation import AggSpec, grouped_aggregate
+from presto_tpu.parallel import (
+    broadcast_batch, hash_partition_ids, make_mesh, repartition_by_hash,
+    shard_batch,
+)
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(N)
+
+
+def _batch(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    keys = rng.randint(0, 13, size=n).astype(np.int64)
+    vals = rng.uniform(0, 100, size=n)
+    return Batch.from_pydict({
+        "k": (T.BIGINT, list(keys)),
+        "v": (T.DOUBLE, list(vals)),
+    })
+
+
+def test_repartition_preserves_rows(mesh):
+    b = _batch()
+    sharded = shard_batch(b, mesh, "dp")
+
+    def step(local):
+        return repartition_by_hash(local, [0], "dp", N)
+
+    out = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp"), check_vma=False))(sharded)
+    # every input row lands on exactly one shard
+    assert int(jnp.sum(out.row_mask)) == b.host_count()
+    got = sorted(out.to_pylist())
+    want = sorted(b.to_pylist())
+    assert got == want
+
+
+def test_repartition_colocates_keys(mesh):
+    b = _batch()
+    sharded = shard_batch(b, mesh, "dp")
+
+    def step(local):
+        ex = repartition_by_hash(local, [0], "dp", N)
+        # tag each live row with this shard's index
+        me = jax.lax.axis_index("dp")
+        tag = jnp.where(ex.row_mask, me, -1)
+        return ex, tag
+
+    ex, tags = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"),
+                                 out_specs=P("dp"), check_vma=False))(sharded)
+    rows = ex.to_pylist()
+    live = np.asarray(ex.row_mask)
+    shard_of = np.asarray(tags)[live]
+    key_shard = {}
+    for (k, _v), s in zip(rows, shard_of):
+        assert key_shard.setdefault(k, s) == s, f"key {k} split across shards"
+
+
+def test_broadcast(mesh):
+    b = _batch(64)
+    sharded = shard_batch(b, mesh, "dp")
+
+    def step(local):
+        return broadcast_batch(local, "dp")
+
+    out = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp"), check_vma=False))(sharded)
+    # each shard holds a full copy: N copies total
+    assert int(jnp.sum(out.row_mask)) == N * b.host_count()
+
+
+def test_distributed_grouped_agg_matches_local(mesh):
+    b = _batch(512, seed=3)
+    aggs = [AggSpec("sum", 1, T.DOUBLE, "s"),
+            AggSpec("count_star", None, T.BIGINT, "c")]
+    local_out = grouped_aggregate(b, [0], aggs, mode="single")
+    want = sorted(local_out.to_pylist())
+
+    sharded = shard_batch(b, mesh, "dp")
+
+    def step(local):
+        partial = grouped_aggregate(local, [0], aggs, mode="partial")
+        ex = repartition_by_hash(partial, [0], "dp", N)
+        return grouped_aggregate(ex, [0], aggs, mode="final")
+
+    out = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P("dp"), check_vma=False))(sharded)
+    got = sorted(out.to_pylist())
+    assert len(got) == len(want)
+    for (gk, gs, gc), (wk, ws, wc) in zip(got, want):
+        assert gk == wk and gc == wc
+        assert gs == pytest.approx(ws, rel=1e-12)
+
+
+def test_partition_ids_in_range():
+    b = _batch(128)
+    pid = hash_partition_ids(b, [0], N)
+    arr = np.asarray(pid)
+    assert arr.min() >= 0 and arr.max() < N
