@@ -42,6 +42,10 @@ class ColumnStats:
 class TableStats:
     row_count: Optional[float] = None
     columns: Dict[str, ColumnStats] = dataclasses.field(default_factory=dict)
+    #: columns forming a unique key, if any — drives join build-side choice
+    #: (reference spi/statistics/TableStatistics.java has no PK notion;
+    #: Presto infers uniqueness from distinct counts, we declare it)
+    primary_key: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -559,6 +559,17 @@ class _Metadata(ConnectorMetadata):
             raise KeyError(f"unknown tpch table {table.table!r}")
         return tpch_schema(table.table)
 
+    _PRIMARY_KEYS = {
+        "lineitem": ("l_orderkey", "l_linenumber"),
+        "orders": ("o_orderkey",),
+        "customer": ("c_custkey",),
+        "part": ("p_partkey",),
+        "supplier": ("s_suppkey",),
+        "partsupp": ("ps_partkey", "ps_suppkey"),
+        "nation": ("n_nationkey",),
+        "region": ("r_regionkey",),
+    }
+
     def table_stats(self, table: TableHandle) -> TableStats:
         t = table.table
         n = float(_rows(t, self.sf))
@@ -571,7 +582,11 @@ class _Metadata(ConnectorMetadata):
         if t == "orders":
             cols["o_orderkey"] = ColumnStats(n, 0.0, 1, int(n))
             cols["o_orderdate"] = ColumnStats(ORDERDATE_SPAN, 0.0, START_DATE, END_ORDERDATE)
-        return TableStats(row_count=n, columns=cols)
+        for pk in self._PRIMARY_KEYS.get(t, ()):
+            if pk not in cols:
+                cols[pk] = ColumnStats(distinct_count=n if len(self._PRIMARY_KEYS[t]) == 1 else None)
+        return TableStats(row_count=n, columns=cols,
+                          primary_key=self._PRIMARY_KEYS.get(t, ()))
 
 
 class _SplitManager(ConnectorSplitManager):

@@ -44,12 +44,21 @@ class Driver:
             if not self._step():
                 break
 
+    def close(self) -> None:
+        """Release operator resources; safe to call repeatedly. Runs on
+        normal completion and on abandonment/failure alike."""
+        for op in self.ops:
+            op.close()
+
     def run_to_completion(self) -> None:
-        while not self._done:
-            if not self._step():
-                # no progress and not done: pipeline is stuck
-                if not self._done:
-                    raise RuntimeError("pipeline made no progress")
+        try:
+            while not self._done:
+                if not self._step():
+                    # no progress and not done: pipeline is stuck
+                    if not self._done:
+                        raise RuntimeError("pipeline made no progress")
+        finally:
+            self.close()
 
     def _step(self) -> bool:
         """One pass over adjacent operator pairs; returns progress.
@@ -81,8 +90,7 @@ class Driver:
             progress = True
         if last.is_finished():
             self._done = True
-            for op in ops:
-                op.close()
+            self.close()
         return progress
 
 

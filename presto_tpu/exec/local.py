@@ -1,0 +1,293 @@
+"""Local plan executor: logical plan -> streaming batch iterators.
+
+Conceptual parity with the reference's LocalExecutionPlanner + Driver
+pipelines (reference presto-main/.../sql/planner/LocalExecutionPlanner.java:357
+visitTableScan/visitAggregation/visitJoin and operator/Driver.java): each
+plan node becomes a generator over device batches, so scan->filter->project
+->partial-agg chains stream without materializing, join build sides and
+sorts drain their input exactly like HashBuilderOperator / OrderByOperator,
+and expression compilation happens once per (expr, schema) via the kernel
+compiler's cache.
+
+Init plans (uncorrelated scalar subqueries) run before the main plan and
+their scalar results substitute into expressions — the reference's
+ExchangeClient-fed init semantics without a network hop.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import Batch, Column, Schema, bucket_capacity, concat_batches
+from ..expr import ir
+from ..expr.compiler import compile_filter, compile_projection
+from ..expr.rewrite import rewrite as ir_rewrite
+from ..ops.aggregation import AggSpec, global_aggregate, grouped_aggregate
+from ..ops.join import lookup_join, semi_join_mask
+from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
+from ..planner.plan import (
+    AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
+    OutputNode, PlanNode, ProjectNode, SemiJoinNode, SortNode,
+    TableScanNode, TopNNode, UnionNode, ValuesNode,
+)
+from ..planner.planner import InitPlanRef, LogicalPlan, Session
+
+
+@dataclasses.dataclass
+class QueryResult:
+    names: List[str]
+    types: List[T.Type]
+    rows: List[tuple]
+
+
+def execute_plan(plan: LogicalPlan, session: Session,
+                 rows_per_batch: int = 1 << 17) -> QueryResult:
+    ex = _Executor(session, rows_per_batch)
+    # run init plans first, extract their scalar results
+    init_values: List[object] = []
+    for p in plan.init_plans:
+        batches = list(ex.run(p))
+        rows = [r for b in batches for r in b.to_pylist()]
+        if len(rows) > 1:
+            raise ValueError("scalar subquery returned more than one row")
+        init_values.append(rows[0][0] if rows else None)
+    ex.init_values = init_values
+    root = plan.root
+    out_batches = list(ex.run(root.child))
+    rows = [r for b in out_batches for r in b.to_pylist()]
+    return QueryResult(names=[f.name for f in root.fields],
+                       types=[f.type for f in root.fields], rows=rows)
+
+
+def _plan_schema(node: PlanNode) -> Schema:
+    return Schema([(f.name, f.type) for f in node.fields])
+
+
+class _Executor:
+    def __init__(self, session: Session, rows_per_batch: int):
+        self.session = session
+        self.rows_per_batch = rows_per_batch
+        self.init_values: List[object] = []
+
+    # -- expression preparation ---------------------------------------------
+    def _resolve(self, e: ir.Expr) -> ir.Expr:
+        def fn(n: ir.Expr) -> ir.Expr:
+            if isinstance(n, ir.Literal) and isinstance(n.value, InitPlanRef):
+                return ir.Literal(type=n.type,
+                                  value=self.init_values[n.value.index])
+            return n
+        return ir_rewrite(e, fn)
+
+    # -- dispatch -------------------------------------------------------------
+    def run(self, node: PlanNode) -> Iterator[Batch]:
+        m = getattr(self, "_" + type(node).__name__)
+        return m(node)
+
+    def _OutputNode(self, node: OutputNode) -> Iterator[Batch]:
+        return self.run(node.child)
+
+    # -- leaves ---------------------------------------------------------------
+    def _TableScanNode(self, node: TableScanNode) -> Iterator[Batch]:
+        conn = self.session.catalogs.get(node.catalog)
+        for split in conn.split_manager.splits(node.table, 1):
+            src = conn.page_source(split, list(node.columns),
+                                   rows_per_batch=self.rows_per_batch)
+            yield from src.batches()
+
+    def _ValuesNode(self, node: ValuesNode) -> Iterator[Batch]:
+        data = {
+            f.name: (f.type, [r[i] for r in node.rows])
+            for i, f in enumerate(node.fields)
+        }
+        if node.fields:
+            yield Batch.from_pydict(data)
+            return
+        # zero-column values (SELECT without FROM): a 1-row dummy column
+        n = len(node.rows)
+        mask = jnp.arange(bucket_capacity(max(n, 1))) < n
+        yield Batch(Schema([]), [], mask)
+
+    # -- streaming nodes ------------------------------------------------------
+    def _FilterNode(self, node: FilterNode) -> Iterator[Batch]:
+        pred = self._resolve(node.predicate)
+        fn = compile_filter(pred, _plan_schema(node.child))
+        for b in self.run(node.child):
+            yield fn(b)
+
+    def _ProjectNode(self, node: ProjectNode) -> Iterator[Batch]:
+        exprs = [self._resolve(e) for e in node.exprs]
+        fn = compile_projection(exprs, [f.name for f in node.fields],
+                                _plan_schema(node.child))
+        for b in self.run(node.child):
+            yield fn(b)
+
+    def _LimitNode(self, node: LimitNode) -> Iterator[Batch]:
+        remaining = node.count
+        for b in self.run(node.child):
+            if remaining <= 0:
+                return
+            out = limit_kernel(b, remaining)
+            remaining -= out.host_count()
+            yield out
+
+    def _UnionNode(self, node: UnionNode) -> Iterator[Batch]:
+        for c in node.children:
+            yield from self.run(c)
+
+    # -- blocking nodes -------------------------------------------------------
+    def _drain(self, node: PlanNode) -> Optional[Batch]:
+        batches = list(self.run(node))
+        if not batches:
+            return None
+        return batches[0] if len(batches) == 1 else concat_batches(batches)
+
+    def _SortNode(self, node: SortNode) -> Iterator[Batch]:
+        b = self._drain(node.child)
+        if b is not None:
+            yield sort_batch(b, [SortKey(k.index, k.ascending, k.nulls_first)
+                                 for k in node.keys])
+
+    def _TopNNode(self, node: TopNNode) -> Iterator[Batch]:
+        keys = [SortKey(k.index, k.ascending, k.nulls_first)
+                for k in node.keys]
+        state: Optional[Batch] = None
+        for b in self.run(node.child):
+            cand = top_n(b, keys, node.count).compact(
+                bucket_capacity(node.count))
+            state = cand if state is None else top_n(
+                concat_batches([state, cand]), keys, node.count).compact(
+                    bucket_capacity(node.count))
+        if state is not None:
+            yield sort_batch(state, keys)
+
+    def _DistinctNode(self, node: DistinctNode) -> Iterator[Batch]:
+        b = self._drain(node.child)
+        if b is None:
+            return
+        yield grouped_aggregate(b, list(range(len(node.fields))), [],
+                                mode="single")
+
+    def _AggregationNode(self, node: AggregationNode) -> Iterator[Batch]:
+        aggs = [
+            AggSpec(a.fn, a.arg, a.output_type, a.name)
+            for a in node.aggs
+        ]
+        for a in node.aggs:
+            if a.distinct:
+                raise NotImplementedError(
+                    "DISTINCT aggregates are not supported yet")
+        group = list(node.group_indices)
+        if not group:
+            parts: List[Batch] = []
+            for b in self.run(node.child):
+                parts.append(global_aggregate(b, aggs, mode="partial"))
+                if len(parts) >= 64:
+                    parts = [global_aggregate(concat_batches(parts), aggs,
+                                              mode="merge")]
+            if not parts:
+                empty = Batch.from_arrays(
+                    _plan_schema(node.child),
+                    [[] for _ in node.child.fields], num_rows=0)
+                parts = [global_aggregate(empty, aggs, mode="partial")]
+            states = (concat_batches(parts) if len(parts) > 1 else parts[0])
+            yield global_aggregate(states, aggs, mode="final")
+            return
+        # grouped: partial per input batch, hierarchical merge, final
+        parts = []
+        for b in self.run(node.child):
+            parts.append(grouped_aggregate(b, group, aggs, mode="partial"))
+            if len(parts) >= 16:
+                merged = concat_batches(parts)
+                key_idx = list(range(len(group)))
+                state = grouped_aggregate(merged, key_idx, aggs, mode="merge")
+                parts = [state.compact(bucket_capacity(state.host_count()))]
+        if not parts:
+            return
+        states = concat_batches(parts) if len(parts) > 1 else parts[0]
+        key_idx = list(range(len(group)))
+        yield grouped_aggregate(states, key_idx, aggs, mode="final")
+
+    def _JoinNode(self, node: JoinNode) -> Iterator[Batch]:
+        build = self._drain(node.right)
+        schema_names = [f.name for f in node.fields]
+        n_left = len(node.left.fields)
+        payload = list(range(len(node.right.fields)))
+        payload_names = [f"$b{i}" for i in payload]
+        if node.join_type == "cross":
+            yield from self._cross_join(node, build)
+            return
+        residual = (self._resolve(node.residual)
+                    if node.residual is not None else None)
+        residual_fn = None
+        if residual is not None:
+            residual_fn = compile_filter(residual, _plan_schema(node))
+        for probe in self.run(node.left):
+            if build is None:
+                if node.join_type == "inner":
+                    continue
+                out = self._null_extend(probe, node)
+            else:
+                out = lookup_join(
+                    probe, build, list(node.left_keys),
+                    list(node.right_keys), payload, payload_names,
+                    node.join_type)
+                out = Batch(_plan_schema(node), out.columns, out.row_mask)
+            if residual_fn is not None:
+                if node.join_type == "left":
+                    # residual on a left join only filters matched rows'
+                    # payload, not probe rows — approximate by filtering
+                    # (correct for inner; left-join residuals are rare)
+                    raise NotImplementedError(
+                        "residual predicate on LEFT JOIN")
+                out = residual_fn(out)
+            yield out
+
+    def _null_extend(self, probe: Batch, node: JoinNode) -> Batch:
+        cols = list(probe.columns)
+        novalid = jnp.zeros_like(probe.row_mask)
+        for f in node.fields[len(node.left.fields):]:
+            cols.append(Column(
+                f.type, jnp.zeros(probe.capacity, dtype=f.type.storage_dtype),
+                novalid, () if f.type.is_string else None))
+        return Batch(_plan_schema(node), cols, probe.row_mask)
+
+    def _cross_join(self, node: JoinNode, build: Optional[Batch]
+                    ) -> Iterator[Batch]:
+        """Cross join where one side is tiny (scalar subqueries, VALUES)."""
+        if build is None:
+            return
+        build = build.compact()
+        nb = build.host_count()
+        if nb == 0:
+            return
+        for probe in self.run(node.left):
+            cap = probe.capacity
+            reps: List[Batch] = []
+            for k in range(nb):
+                cols = list(probe.columns)
+                for c in build.columns:
+                    val = c.data[k]
+                    valid_k = c.validity[k]
+                    cols.append(Column(
+                        c.type, jnp.broadcast_to(val, (cap,)),
+                        jnp.broadcast_to(valid_k, (cap,)) & probe.row_mask,
+                        c.dictionary))
+                reps.append(Batch(_plan_schema(node), cols, probe.row_mask))
+            yield concat_batches(reps) if len(reps) > 1 else reps[0]
+
+    def _SemiJoinNode(self, node: SemiJoinNode) -> Iterator[Batch]:
+        build = self._drain(node.filtering)
+        for b in self.run(node.source):
+            if build is None:
+                if node.negated:
+                    yield b
+                else:
+                    yield Batch(b.schema, b.columns,
+                                jnp.zeros_like(b.row_mask))
+                continue
+            mask = semi_join_mask(b, build, [node.source_key],
+                                  [node.filtering_key], negated=node.negated)
+            yield Batch(b.schema, b.columns, mask)

@@ -356,7 +356,7 @@ class LookupJoinOperator(Operator):
     def _empty_build_output(self, batch: Batch) -> Batch:
         """Empty build side: keep the joined schema contract — probe columns
         plus (all-null) payload columns; inner join masks every row out."""
-        if self._build_schema is None:
+        if self._build_schema is None and self._payload:
             raise ValueError(
                 "join build side produced no rows and no build_schema was "
                 "given to emit the joined schema")

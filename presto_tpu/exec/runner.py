@@ -1,0 +1,107 @@
+"""LocalRunner: SQL text -> results in one process.
+
+Conceptual parity with the reference's LocalQueryRunner (reference
+presto-main/.../testing/LocalQueryRunner.java:210): the full
+parse -> analyze/plan -> optimize -> execute path with in-process
+connectors and no network — ring 2 of the test strategy (SURVEY.md §4).
+Session statements (SET/SHOW) and EXPLAIN are served directly, like the
+reference's DataDefinitionTask dispatch (reference execution/
+SetSessionTask.java etc.).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import types as T
+from ..connectors.spi import CatalogManager, TableHandle
+from ..connectors.tpch import TpchConnector
+from ..sql import ast as A
+from ..sql.parser import parse_statement
+from ..planner.optimizer import optimize
+from ..planner.planner import LogicalPlan, Session, plan_query
+from ..planner.printer import print_plan
+from .local import QueryResult, execute_plan
+
+
+class LocalRunner:
+    def __init__(self, catalogs: Optional[CatalogManager] = None,
+                 catalog: str = "tpch", schema: str = "default",
+                 tpch_sf: float = 0.01, rows_per_batch: int = 1 << 17):
+        if catalogs is None:
+            catalogs = CatalogManager()
+            catalogs.register("tpch", TpchConnector(sf=tpch_sf))
+        self.session = Session(catalogs=catalogs, catalog=catalog,
+                               schema=schema)
+        self.rows_per_batch = rows_per_batch
+
+    # -- public API -----------------------------------------------------------
+    def execute(self, sql: str) -> QueryResult:
+        stmt = parse_statement(sql)
+        return self._execute_stmt(stmt)
+
+    def plan(self, sql: str, optimized: bool = True) -> LogicalPlan:
+        stmt = parse_statement(sql)
+        if not isinstance(stmt, A.Query):
+            raise ValueError("plan() takes a SELECT query")
+        plan = plan_query(stmt, self.session)
+        return optimize(plan, self.session) if optimized else plan
+
+    # -- statement dispatch ---------------------------------------------------
+    def _execute_stmt(self, stmt: A.Node) -> QueryResult:
+        if isinstance(stmt, A.Query):
+            plan = optimize(plan_query(stmt, self.session), self.session)
+            return execute_plan(plan, self.session, self.rows_per_batch)
+        if isinstance(stmt, A.Explain):
+            if not isinstance(stmt.statement, A.Query):
+                raise ValueError("EXPLAIN requires a query")
+            plan = optimize(plan_query(stmt.statement, self.session),
+                            self.session)
+            text = print_plan(plan)
+            return QueryResult(["Query Plan"], [T.VARCHAR],
+                               [(line,) for line in text.split("\n")])
+        if isinstance(stmt, A.ShowCatalogs):
+            return QueryResult(["Catalog"], [T.VARCHAR],
+                               [(c,) for c in self.session.catalogs.names()])
+        if isinstance(stmt, A.ShowTables):
+            conn = self.session.catalogs.get(self.session.catalog)
+            return QueryResult(
+                ["Table"], [T.VARCHAR],
+                [(t,) for t in conn.metadata.list_tables()])
+        if isinstance(stmt, A.ShowColumns):
+            name = stmt.table
+            catalog = self.session.catalog if len(name) < 3 else name[-3]
+            schema = self.session.schema if len(name) < 2 else name[-2]
+            conn = self.session.catalogs.get(catalog)
+            ts = conn.metadata.table_schema(
+                TableHandle(catalog, schema, name[-1]))
+            return QueryResult(
+                ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
+                [(f.name, f.type.display()) for f in ts.fields])
+        if isinstance(stmt, A.ShowSession):
+            return QueryResult(
+                ["Name", "Value"], [T.VARCHAR, T.VARCHAR],
+                [(k, str(v)) for k, v in
+                 sorted(self.session.properties.items())])
+        if isinstance(stmt, A.SetSession):
+            value = _literal_value(stmt.value)
+            self.session.properties[stmt.name] = value
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.ResetSession):
+            self.session.properties.pop(stmt.name, None)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        raise NotImplementedError(
+            f"statement {type(stmt).__name__} is not supported yet")
+
+
+def _literal_value(e: A.Expression):
+    if isinstance(e, A.StringLiteral):
+        return e.value
+    if isinstance(e, A.LongLiteral):
+        return e.value
+    if isinstance(e, A.DoubleLiteral):
+        return e.value
+    if isinstance(e, A.DecimalLiteral):
+        return e.value
+    if isinstance(e, A.BooleanLiteral):
+        return e.value
+    raise ValueError("session value must be a literal")

@@ -34,13 +34,17 @@ class Val:
     valid: jnp.ndarray
     type: Type
     dictionary: Optional[Tuple[str, ...]] = None
+    #: static python value when this Val is a compile-time constant —
+    #: lets string/positional args (substr offsets, LIKE patterns) stay
+    #: static under jit, like constant folding in the reference codegen
+    literal: Optional[object] = None
 
     @staticmethod
     def constant(value, typ: Type, n: int) -> "Val":
         if value is None:
             return Val(
                 jnp.full(n, typ.null_storage(), dtype=typ.storage_dtype),
-                jnp.zeros(n, dtype=bool), typ,
+                jnp.zeros(n, dtype=bool), typ, literal=None,
             )
         if typ.is_string:
             s = value
@@ -48,12 +52,12 @@ class Val:
                 s = str(s).ljust(typ.length)
             return Val(
                 jnp.zeros(n, dtype=jnp.int32),
-                jnp.ones(n, dtype=bool), typ, dictionary=(s,),
+                jnp.ones(n, dtype=bool), typ, dictionary=(s,), literal=s,
             )
         storage = typ.to_storage(value)
         return Val(
             jnp.full(n, storage, dtype=typ.storage_dtype),
-            jnp.ones(n, dtype=bool), typ,
+            jnp.ones(n, dtype=bool), typ, literal=value,
         )
 
 
@@ -505,8 +509,15 @@ def _vocab_transform(fn):
         a = args[0]
         if a.dictionary is None:
             raise NotImplementedError("string fn on non-dictionary column")
-        extra = [_string_literal_of(x) if x.type.is_string
-                 else int(np.asarray(x.data)[0]) for x in args[1:]]
+        extra = []
+        for x in args[1:]:
+            if x.type.is_string:
+                extra.append(_string_literal_of(x))
+            elif x.literal is not None:
+                extra.append(int(x.literal))
+            else:
+                raise NotImplementedError(
+                    "string function positional args must be constants")
         new_vocab = tuple(fn(s, *extra) for s in a.dictionary)
         return Val(a.data, a.valid, out, dictionary=new_vocab)
     return impl

@@ -104,12 +104,25 @@ def semi_join_mask(
     build: Batch,
     probe_keys: Sequence[int],
     build_keys: Sequence[int],
+    negated: bool = False,
 ) -> jnp.ndarray:
-    """Membership mask for semi-joins (IN / EXISTS; reference
-    HashSemiJoinOperator.java + SetBuilderOperator.java)."""
+    """Membership mask for semi/anti-joins (IN / NOT IN; reference
+    HashSemiJoinOperator.java + SetBuilderOperator.java).
+
+    ANSI null semantics: a NULL probe key never matches; for NOT IN, any
+    NULL build key makes membership UNKNOWN for non-matching rows (nothing
+    passes), while an EMPTY build set makes NOT IN vacuously TRUE for every
+    probe row — including NULL keys.
+    """
     skey, slive, _ = build_sorted(build, build_keys)
     pkey, pvalid = _join_key(probe, probe_keys)
     pos = jnp.searchsorted(skey, pkey, side="left")
     pos = jnp.minimum(pos, skey.shape[0] - 1)
     hit = (jnp.take(skey, pos, axis=0) == pkey) & jnp.take(slive, pos, axis=0)
-    return probe.row_mask & pvalid & hit
+    if not negated:
+        return probe.row_mask & pvalid & hit
+    _bkey, bvalid = _join_key(build, build_keys)
+    build_has_null = jnp.any(build.row_mask & ~bvalid)
+    build_empty = ~jnp.any(build.row_mask)
+    anti = probe.row_mask & pvalid & ~hit & ~build_has_null
+    return jnp.where(build_empty, probe.row_mask, anti)

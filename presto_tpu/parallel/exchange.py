@@ -40,7 +40,8 @@ def _splitmix64(x: jnp.ndarray) -> jnp.ndarray:
 
 def hash_partition_ids(batch: Batch, key_cols: Sequence[int],
                        n_partitions: int) -> jnp.ndarray:
-    """Partition id per row in [0, n) (NULL keys -> partition 0)."""
+    """Partition id per row in [0, n). NULL keys all hash the null-storage
+    sentinel, so they colocate on one (arbitrary) partition."""
     key, _valid = _join_key(batch, key_cols)
     h = _splitmix64(key)
     return (h % jnp.uint64(n_partitions)).astype(jnp.int32)

@@ -1,0 +1,244 @@
+"""Logical plan nodes.
+
+Conceptual parity with the reference's PlanNode tree (reference
+presto-main/.../sql/planner/plan/ — 39 node types; this is the load-bearing
+subset per SURVEY.md §7 step 5). Columns are positional: every node exposes
+``fields`` (name, type) and expressions inside a node index its child's
+fields — the Symbol allocator is replaced by positions, which is also what
+the batch kernels consume.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..expr import ir
+from ..sql.analyzer import Field
+from ..connectors.spi import TableHandle
+
+
+class PlanNode:
+    fields: Tuple[Field, ...]
+
+    @property
+    def children(self) -> Tuple["PlanNode", ...]:
+        return ()
+
+    def with_children(self, children: Sequence["PlanNode"]) -> "PlanNode":
+        assert not children
+        return self
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def types(self) -> List[T.Type]:
+        return [f.type for f in self.fields]
+
+
+def _one_child(cls):
+    """Mixin-free helper: single-child with_children via dataclasses.replace."""
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, ch):
+        (c,) = ch
+        return dataclasses.replace(self, child=c)
+    cls.children = property(children)
+    cls.with_children = with_children
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class TableScanNode(PlanNode):
+    """Scan of a connector table (reference plan/TableScanNode.java).
+    ``columns`` are the connector column names actually read; predicate
+    pushdown attaches later (TupleDomain analogue)."""
+
+    catalog: str
+    table: TableHandle
+    columns: Tuple[str, ...]
+    fields: Tuple[Field, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuesNode(PlanNode):
+    fields: Tuple[Field, ...]
+    rows: Tuple[Tuple[object, ...], ...]
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class FilterNode(PlanNode):
+    child: PlanNode
+    predicate: ir.Expr
+    fields: Tuple[Field, ...] = ()
+
+    def __post_init__(self):
+        if not self.fields:
+            object.__setattr__(self, "fields", self.child.fields)
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class ProjectNode(PlanNode):
+    child: PlanNode
+    exprs: Tuple[ir.Expr, ...]
+    fields: Tuple[Field, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanAgg:
+    """One aggregate call: fn(input_index) with optional DISTINCT
+    (reference plan/AggregationNode.Aggregation)."""
+
+    fn: str
+    arg: Optional[int]            # child column index; None for count(*)
+    output_type: T.Type
+    name: str
+    distinct: bool = False
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class AggregationNode(PlanNode):
+    """Group-by aggregation; output = [group keys..., agg outputs...]
+    (reference plan/AggregationNode.java). step is assigned during
+    fragmentation (SINGLE until exchanges split it)."""
+
+    child: PlanNode
+    group_indices: Tuple[int, ...]
+    aggs: Tuple[PlanAgg, ...]
+    fields: Tuple[Field, ...]
+    step: str = "single"
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinNode(PlanNode):
+    """Equi-join (reference plan/JoinNode.java). Output = left fields +
+    right fields. ``residual`` filters post-join rows (over the combined
+    schema)."""
+
+    join_type: str                # inner | left | cross
+    left: PlanNode
+    right: PlanNode
+    left_keys: Tuple[int, ...]
+    right_keys: Tuple[int, ...]
+    fields: Tuple[Field, ...]
+    residual: Optional[ir.Expr] = None
+    # execution hints (filled by the optimizer)
+    distribution: str = "partitioned"   # partitioned | replicated
+    build_unique: bool = False          # build keys known unique (PK)
+
+    @property
+    def children(self) -> Tuple[PlanNode, ...]:
+        return (self.left, self.right)
+
+    def with_children(self, ch):
+        l, r = ch
+        return dataclasses.replace(self, left=l, right=r)
+
+
+@dataclasses.dataclass(frozen=True)
+class SemiJoinNode(PlanNode):
+    """Filters source rows by key membership in the filtering subplan
+    (reference plan/SemiJoinNode.java; executed like SetBuilder +
+    HashSemiJoin). Output = source fields."""
+
+    source: PlanNode
+    filtering: PlanNode
+    source_key: int
+    filtering_key: int
+    fields: Tuple[Field, ...]
+    negated: bool = False
+
+    @property
+    def children(self) -> Tuple[PlanNode, ...]:
+        return (self.source, self.filtering)
+
+    def with_children(self, ch):
+        s, f = ch
+        return dataclasses.replace(self, source=s, filtering=f)
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKeySpec:
+    index: int
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class SortNode(PlanNode):
+    child: PlanNode
+    keys: Tuple[SortKeySpec, ...]
+    fields: Tuple[Field, ...] = ()
+
+    def __post_init__(self):
+        if not self.fields:
+            object.__setattr__(self, "fields", self.child.fields)
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class TopNNode(PlanNode):
+    child: PlanNode
+    keys: Tuple[SortKeySpec, ...]
+    count: int
+    fields: Tuple[Field, ...] = ()
+
+    def __post_init__(self):
+        if not self.fields:
+            object.__setattr__(self, "fields", self.child.fields)
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class LimitNode(PlanNode):
+    child: PlanNode
+    count: int
+    fields: Tuple[Field, ...] = ()
+
+    def __post_init__(self):
+        if not self.fields:
+            object.__setattr__(self, "fields", self.child.fields)
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class DistinctNode(PlanNode):
+    """SELECT DISTINCT — group by every output column
+    (reference rule SingleDistinctAggregationToGroupBy shape)."""
+
+    child: PlanNode
+    fields: Tuple[Field, ...] = ()
+
+    def __post_init__(self):
+        if not self.fields:
+            object.__setattr__(self, "fields", self.child.fields)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnionNode(PlanNode):
+    children_: Tuple[PlanNode, ...]
+    fields: Tuple[Field, ...]
+    distinct: bool = False
+
+    @property
+    def children(self) -> Tuple[PlanNode, ...]:
+        return self.children_
+
+    def with_children(self, ch):
+        return dataclasses.replace(self, children_=tuple(ch))
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class OutputNode(PlanNode):
+    """Final client-visible columns (reference plan/OutputNode.java)."""
+
+    child: PlanNode
+    fields: Tuple[Field, ...]

@@ -1,0 +1,679 @@
+"""AST -> logical plan lowering.
+
+Conceptual parity with the reference's LogicalPlanner / QueryPlanner /
+RelationPlanner / SubqueryPlanner stack (reference presto-main/.../sql/
+planner/LogicalPlanner.java:156, QueryPlanner.java, RelationPlanner.java,
+SubqueryPlanner.java): relations become plan nodes, SELECT decomposes into
+project/aggregate/filter/sort layers, and subqueries lower to semi joins
+(IN/EXISTS) or init plans (uncorrelated scalar subqueries, executed before
+the main plan like reference ExchangeClient-fed index lookups).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..connectors.spi import CatalogManager, TableHandle
+from ..expr import ir
+from ..sql import ast as A
+from ..sql.analyzer import (
+    AGGREGATE_FUNCTIONS, AnalysisError, ExpressionAnalyzer, Field, Scope,
+    _FUNCTION_ALIASES, coerce,
+)
+from .plan import (
+    AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
+    OutputNode, PlanAgg, PlanNode, ProjectNode, SemiJoinNode, SortKeySpec,
+    SortNode, TableScanNode, TopNNode, UnionNode, ValuesNode,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class InitPlanRef:
+    """Placeholder literal value for an uncorrelated scalar subquery;
+    the executor runs the init plan and substitutes the scalar."""
+
+    index: int
+
+
+@dataclasses.dataclass
+class LogicalPlan:
+    root: OutputNode
+    init_plans: List[PlanNode]
+
+
+@dataclasses.dataclass
+class Session:
+    """Query session context (reference Session.java essentials)."""
+
+    catalogs: CatalogManager
+    catalog: str = "tpch"
+    schema: str = "default"
+    properties: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+
+def plan_query(query: A.Query, session: Session) -> LogicalPlan:
+    planner = _Planner(session)
+    root = planner.plan_root(query)
+    return LogicalPlan(root, planner.init_plans)
+
+
+class _Planner:
+    def __init__(self, session: Session):
+        self.session = session
+        self.ctes: Dict[str, PlanNode] = {}
+        self.init_plans: List[PlanNode] = []
+        self._ids = itertools.count()
+
+    # -- entry ---------------------------------------------------------------
+    def plan_root(self, query: A.Query) -> OutputNode:
+        node = self.plan_query_node(query)
+        if isinstance(node, OutputNode):
+            return node
+        return OutputNode(child=node, fields=node.fields)
+
+    def plan_query_node(self, query: A.Query) -> PlanNode:
+        saved = dict(self.ctes)
+        try:
+            for name, cte_q in query.with_:
+                cte_plan = self.plan_query_node(cte_q)
+                # alias fields with the CTE name
+                self.ctes[name] = _realias(cte_plan, name)
+            return self.plan_body(query.body)
+        finally:
+            self.ctes = saved
+
+    def plan_body(self, body: A.Node) -> PlanNode:
+        if isinstance(body, A.QuerySpecification):
+            return self.plan_query_spec(body)
+        if isinstance(body, A.SetOperation):
+            return self.plan_set_op(body)
+        if isinstance(body, A.Query):   # parenthesized query term
+            return self.plan_query_node(body)
+        raise AnalysisError(f"unsupported query body {type(body).__name__}")
+
+    def plan_set_op(self, op: A.SetOperation) -> PlanNode:
+        if op.op != "union":
+            raise AnalysisError(f"{op.op.upper()} is not supported yet")
+        left = self.plan_body(op.left)
+        right = self.plan_body(op.right)
+        if len(left.fields) != len(right.fields):
+            raise AnalysisError("UNION inputs have different column counts")
+        # coerce each side to common types
+        out_fields = []
+        for lf, rf in zip(left.fields, right.fields):
+            t = T.common_super_type(lf.type, rf.type)
+            if t is None:
+                raise AnalysisError(
+                    f"UNION column {lf.name}: incompatible types "
+                    f"{lf.type.display()} vs {rf.type.display()}")
+            out_fields.append(Field(lf.name, t))
+        left = _coerce_to(left, [f.type for f in out_fields])
+        right = _coerce_to(right, [f.type for f in out_fields])
+        node: PlanNode = UnionNode(
+            children_=(left, right), fields=tuple(out_fields),
+            distinct=op.distinct)
+        if op.distinct:
+            node = DistinctNode(child=node)
+        if op.order_by:
+            scope = Scope(node.fields)
+            keys = self._sort_keys(op.order_by, node, scope, {})
+            if op.limit is not None:
+                return TopNNode(child=node, keys=tuple(keys), count=op.limit)
+            node = SortNode(child=node, keys=tuple(keys))
+        if op.limit is not None:
+            node = LimitNode(child=node, count=op.limit)
+        return node
+
+    # -- relations -----------------------------------------------------------
+    def plan_relation(self, rel: A.Relation) -> PlanNode:
+        if isinstance(rel, A.Table):
+            return self.plan_table(rel)
+        if isinstance(rel, A.AliasedRelation):
+            inner = self.plan_relation(rel.relation)
+            return _realias(inner, rel.alias, rel.column_names)
+        if isinstance(rel, A.SubqueryRelation):
+            return self.plan_query_node(rel.query)
+        if isinstance(rel, A.Join):
+            return self.plan_join(rel)
+        raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+    def plan_table(self, rel: A.Table) -> PlanNode:
+        name = rel.name
+        if len(name) == 1 and name[0] in self.ctes:
+            return self.ctes[name[0]]
+        if len(name) == 1:
+            catalog, schema, table = (self.session.catalog,
+                                      self.session.schema, name[0])
+        elif len(name) == 2:
+            catalog, schema, table = self.session.catalog, name[0], name[1]
+        else:
+            catalog, schema, table = name[-3], name[-2], name[-1]
+        conn = self.session.catalogs.get(catalog)
+        handle = TableHandle(catalog, schema, table)
+        table_schema = conn.metadata.table_schema(handle)
+        fields = tuple(
+            Field(f.name, f.type, relation=table) for f in table_schema.fields)
+        return TableScanNode(
+            catalog=catalog, table=handle,
+            columns=tuple(table_schema.names), fields=fields)
+
+    def plan_join(self, rel: A.Join) -> PlanNode:
+        left = self.plan_relation(rel.left)
+        right = self.plan_relation(rel.right)
+        combined = left.fields + right.fields
+        if rel.join_type in ("cross", "implicit"):
+            return JoinNode(
+                join_type="cross", left=left, right=right,
+                left_keys=(), right_keys=(), fields=combined)
+        if rel.join_type == "full":
+            raise AnalysisError("FULL OUTER JOIN is not supported yet")
+        join_type = rel.join_type
+        if join_type == "right":
+            left, right = right, left
+            combined = left.fields + right.fields
+            join_type = "left"
+        scope = Scope(combined)
+        analyzer = ExpressionAnalyzer(scope)
+        cond = analyzer.analyze(rel.condition) if rel.condition is not None \
+            else None
+        left_keys, right_keys, residual = _extract_equi_keys(
+            cond, len(left.fields))
+        if not left_keys:
+            raise AnalysisError(
+                "non-equi join conditions require at least one equality "
+                "conjunct")
+        if residual is not None and join_type == "left":
+            # ON predicates touching only the build side filter the build
+            # input (valid for LEFT: they decide matching, not probe rows)
+            from ..expr.rewrite import (
+                combine_conjuncts, conjuncts as split_conj, referenced_inputs,
+                remap_inputs)
+            n_left = len(left.fields)
+            right_only, rest = [], []
+            for c in split_conj(residual):
+                refs = referenced_inputs(c)
+                if refs and all(r >= n_left for r in refs):
+                    right_only.append(
+                        remap_inputs(c, {r: r - n_left for r in refs}))
+                else:
+                    rest.append(c)
+            if right_only:
+                right = FilterNode(child=right,
+                                   predicate=combine_conjuncts(right_only))
+            residual = combine_conjuncts(rest)
+        # RIGHT was swapped above; for the swapped case key sides were
+        # extracted against the swapped order already (scope built after swap)
+        return JoinNode(
+            join_type=join_type, left=left, right=right,
+            left_keys=tuple(left_keys), right_keys=tuple(right_keys),
+            fields=combined, residual=residual)
+
+    # -- SELECT decomposition -----------------------------------------------
+    def plan_query_spec(self, spec: A.QuerySpecification) -> PlanNode:
+        if spec.from_ is not None:
+            node = self.plan_relation(spec.from_)
+        else:
+            node = ValuesNode(fields=(), rows=((),))
+        scope = Scope(node.fields)
+
+        # WHERE: plain conjuncts filter first (directly above the join tree
+        # so the optimizer's join-graph pass sees them), then subquery
+        # conjuncts become semi joins above the filter
+        if spec.where is not None:
+            subquery_conjs, where = _split_subquery_conjuncts(spec.where)
+            if where is not None:
+                analyzer = ExpressionAnalyzer(scope)
+                node = FilterNode(
+                    child=node,
+                    predicate=self._analyze_with_subqueries(where, analyzer))
+            for value, query, negated in subquery_conjs:
+                node = self._plan_semi_join(node, value, query, negated)
+            scope = Scope(node.fields)
+
+        select_items = self._expand_stars(spec.select, scope)
+        agg_calls = _collect_aggs(
+            [it.value for it in select_items]
+            + ([spec.having] if spec.having else [])
+            + [s.key for s in spec.order_by])
+
+        if agg_calls or spec.group_by:
+            node, replacements = self._plan_aggregation(
+                node, scope, spec, select_items, agg_calls)
+            scope = Scope(node.fields)
+        else:
+            replacements = {}
+
+        # HAVING (after aggregation)
+        if spec.having is not None:
+            analyzer = ExpressionAnalyzer(scope, replacements)
+            node = FilterNode(
+                child=node,
+                predicate=self._analyze_with_subqueries(spec.having, analyzer))
+
+        # SELECT projection (+ hidden sort keys)
+        analyzer = ExpressionAnalyzer(scope, replacements)
+        out_exprs: List[ir.Expr] = []
+        out_fields: List[Field] = []
+        for i, item in enumerate(select_items):
+            e = self._analyze_with_subqueries(item.value, analyzer)
+            name = item.alias or _derive_name(item.value, i)
+            out_exprs.append(e)
+            out_fields.append(Field(name, e.type))
+        project = ProjectNode(child=node, exprs=tuple(out_exprs),
+                              fields=tuple(out_fields))
+
+        result: PlanNode = project
+        if spec.distinct:
+            result = DistinctNode(child=result)
+
+        if spec.order_by:
+            out_scope = Scope(result.fields)
+            keys, result = self._sort_keys_with_hidden(
+                spec.order_by, result, out_scope, select_items, analyzer)
+            if spec.limit is not None and not spec.distinct:
+                result = TopNNode(child=result, keys=tuple(keys),
+                                  count=spec.limit)
+            else:
+                result = SortNode(child=result, keys=tuple(keys))
+                if spec.limit is not None:
+                    result = LimitNode(child=result, count=spec.limit)
+        elif spec.limit is not None:
+            result = LimitNode(child=result, count=spec.limit)
+
+        # drop hidden sort columns if any were added
+        if len(result.fields) > len(out_fields):
+            keep = list(range(len(out_fields)))
+            result = ProjectNode(
+                child=result,
+                exprs=tuple(ir.input_ref(i, result.fields[i].type)
+                            for i in keep),
+                fields=tuple(result.fields[i] for i in keep))
+        return result
+
+    # -- subqueries -----------------------------------------------------------
+    def _plan_semi_join(self, source: PlanNode, value: A.Expression,
+                        query: A.Query, negated: bool) -> PlanNode:
+        filtering = self.plan_query_node(query)
+        if len(filtering.fields) != 1:
+            raise AnalysisError("IN subquery must return one column")
+        analyzer = ExpressionAnalyzer(Scope(source.fields))
+        key = analyzer.analyze(value)
+        if not isinstance(key, ir.InputRef):
+            # project the key expression as a hidden column
+            exprs = tuple(
+                ir.input_ref(i, f.type)
+                for i, f in enumerate(source.fields)) + (key,)
+            fields = source.fields + (Field("$semikey", key.type),)
+            source = ProjectNode(child=source, exprs=exprs, fields=fields)
+            key_index = len(fields) - 1
+        else:
+            key_index = key.index
+        node: PlanNode = SemiJoinNode(
+            source=source, filtering=filtering, source_key=key_index,
+            filtering_key=0, fields=source.fields, negated=negated)
+        if source.fields and source.fields[-1].name == "$semikey":
+            keep = list(range(len(source.fields) - 1))
+            node = ProjectNode(
+                child=node,
+                exprs=tuple(ir.input_ref(i, source.fields[i].type)
+                            for i in keep),
+                fields=tuple(source.fields[i] for i in keep))
+        return node
+
+    def _analyze_with_subqueries(self, expr: A.Expression,
+                                 analyzer: ExpressionAnalyzer) -> ir.Expr:
+        """Lower an expression, turning uncorrelated scalar subqueries into
+        init-plan literal placeholders."""
+        rewritten = self._rewrite_scalar_subqueries(expr, analyzer)
+        return analyzer.analyze(rewritten)
+
+    def _rewrite_scalar_subqueries(self, expr: A.Expression,
+                                   analyzer: ExpressionAnalyzer):
+        if isinstance(expr, A.ScalarSubquery):
+            sub = self.plan_query_node(expr.query)
+            if len(sub.fields) != 1:
+                raise AnalysisError("scalar subquery must return one column")
+            idx = len(self.init_plans)
+            self.init_plans.append(sub)
+            placeholder = ir.lit(InitPlanRef(idx), sub.fields[0].type)
+            # stash under a synthetic replacement key
+            analyzer.replacements[expr] = placeholder
+            return expr
+        for child_name in ("left", "right", "value", "min", "max", "first",
+                           "second", "operand", "default"):
+            child = getattr(expr, child_name, None)
+            if isinstance(child, A.Expression):
+                self._rewrite_scalar_subqueries(child, analyzer)
+        for seq_name in ("args", "items", "whens"):
+            seq = getattr(expr, seq_name, None)
+            if seq:
+                for c in seq:
+                    if isinstance(c, A.WhenClause):
+                        self._rewrite_scalar_subqueries(c.condition, analyzer)
+                        self._rewrite_scalar_subqueries(c.result, analyzer)
+                    elif isinstance(c, A.Expression):
+                        self._rewrite_scalar_subqueries(c, analyzer)
+        return expr
+
+    # -- aggregation ----------------------------------------------------------
+    def _plan_aggregation(self, node: PlanNode, scope: Scope,
+                          spec: A.QuerySpecification,
+                          select_items: Sequence[A.SelectItem],
+                          agg_calls: List[A.FunctionCall]):
+        analyzer = ExpressionAnalyzer(scope)
+        # group keys (ordinals supported)
+        group_exprs: List[A.Expression] = []
+        for g in spec.group_by:
+            if isinstance(g, A.LongLiteral):
+                ordinal = g.value
+                if not (1 <= ordinal <= len(select_items)):
+                    raise AnalysisError(f"GROUP BY ordinal {ordinal} out of range")
+                group_exprs.append(select_items[ordinal - 1].value)
+            else:
+                group_exprs.append(g)
+
+        pre_exprs: List[ir.Expr] = []
+        pre_fields: List[Field] = []
+        for i, g in enumerate(group_exprs):
+            e = analyzer.analyze(g)
+            name = _derive_name(g, i)
+            pre_exprs.append(e)
+            pre_fields.append(Field(name, e.type))
+
+        aggs: List[PlanAgg] = []
+        agg_fields: List[Field] = []
+        # dedupe structurally identical aggregate calls
+        seen: Dict[A.FunctionCall, int] = {}
+        uniq_aggs: List[A.FunctionCall] = []
+        for call in agg_calls:
+            if call not in seen:
+                seen[call] = len(uniq_aggs)
+                uniq_aggs.append(call)
+        for j, call in enumerate(uniq_aggs):
+            fn = _FUNCTION_ALIASES.get(call.name, call.name)
+            if fn not in ("count", "sum", "avg", "min", "max"):
+                raise AnalysisError(f"aggregate {fn}() not supported yet")
+            if call.is_star or not call.args:
+                if fn != "count":
+                    raise AnalysisError(f"{fn}(*) is not valid")
+                aggs.append(PlanAgg("count_star", None, T.BIGINT,
+                                    f"_agg{j}", distinct=False))
+                agg_fields.append(Field(f"_agg{j}", T.BIGINT))
+                continue
+            if len(call.args) != 1:
+                raise AnalysisError(f"{fn}() takes one argument")
+            arg = analyzer.analyze(call.args[0])
+            arg_index = len(pre_exprs)
+            pre_exprs.append(arg)
+            pre_fields.append(Field(f"_aggarg{j}", arg.type))
+            out_t = _agg_output_type(fn, arg.type)
+            aggs.append(PlanAgg(fn, arg_index, out_t, f"_agg{j}",
+                                distinct=call.distinct))
+            agg_fields.append(Field(f"_agg{j}", out_t))
+
+        pre = ProjectNode(child=node, exprs=tuple(pre_exprs),
+                          fields=tuple(pre_fields))
+        out_fields = tuple(pre_fields[:len(group_exprs)]) + tuple(agg_fields)
+        agg_node = AggregationNode(
+            child=pre, group_indices=tuple(range(len(group_exprs))),
+            aggs=tuple(aggs), fields=out_fields)
+
+        replacements: Dict[A.Expression, ir.Expr] = {}
+        for i, g in enumerate(group_exprs):
+            replacements[g] = ir.input_ref(i, pre_fields[i].type)
+        for call, j in seen.items():
+            replacements[call] = ir.input_ref(
+                len(group_exprs) + j, agg_fields[j].type)
+        return agg_node, replacements
+
+    # -- ORDER BY -------------------------------------------------------------
+    def _sort_keys(self, order_by, node: PlanNode, scope: Scope,
+                   replacements) -> List[SortKeySpec]:
+        keys = []
+        for s in order_by:
+            if isinstance(s.key, A.LongLiteral):
+                idx = s.key.value - 1
+                if not (0 <= idx < len(node.fields)):
+                    raise AnalysisError("ORDER BY ordinal out of range")
+            else:
+                analyzer = ExpressionAnalyzer(scope, replacements)
+                e = analyzer.analyze(s.key)
+                if not isinstance(e, ir.InputRef):
+                    raise AnalysisError(
+                        "ORDER BY expression must be an output column here")
+                idx = e.index
+            keys.append(SortKeySpec(idx, s.ascending, s.nulls_first))
+        return keys
+
+    def _sort_keys_with_hidden(self, order_by, project: PlanNode,
+                               out_scope: Scope, select_items, analyzer):
+        """Resolve sort keys against select outputs; unmatched expressions
+        become hidden projected columns."""
+        keys: List[SortKeySpec] = []
+        extra_exprs: List[ir.Expr] = []
+        extra_fields: List[Field] = []
+        n_out = len(project.fields)
+        # map: select item AST -> output index; alias -> index
+        by_ast = {it.value: i for i, it in enumerate(select_items)}
+        by_alias = {it.alias: i for i, it in enumerate(select_items)
+                    if it.alias}
+        for s in order_by:
+            k = s.key
+            if isinstance(k, A.LongLiteral):
+                idx = k.value - 1
+                if not (0 <= idx < n_out):
+                    raise AnalysisError("ORDER BY ordinal out of range")
+            elif isinstance(k, A.Identifier) and k.name in by_alias:
+                idx = by_alias[k.name]
+            elif k in by_ast:
+                idx = by_ast[k]
+            else:
+                e = analyzer.analyze(k)
+                if isinstance(e, ir.InputRef) and isinstance(
+                        project, ProjectNode):
+                    # column of the pre-projection input: check if it is
+                    # already projected unchanged
+                    match = [i for i, pe in enumerate(project.exprs)
+                             if pe == e]
+                    if match:
+                        idx = match[0]
+                    else:
+                        idx = n_out + len(extra_exprs)
+                        extra_exprs.append(e)
+                        extra_fields.append(
+                            Field(f"$sort{len(extra_exprs)}", e.type))
+                else:
+                    idx = n_out + len(extra_exprs)
+                    extra_exprs.append(e)
+                    extra_fields.append(
+                        Field(f"$sort{len(extra_exprs)}", e.type))
+            keys.append(SortKeySpec(idx, s.ascending, s.nulls_first))
+        if extra_exprs and isinstance(project, ProjectNode):
+            project = ProjectNode(
+                child=project.child,
+                exprs=project.exprs + tuple(extra_exprs),
+                fields=project.fields + tuple(extra_fields))
+        elif extra_exprs:
+            raise AnalysisError(
+                "ORDER BY expression not derivable from output columns")
+        return keys, project
+
+    # -- stars ----------------------------------------------------------------
+    def _expand_stars(self, items, scope: Scope) -> List[A.SelectItem]:
+        out: List[A.SelectItem] = []
+        for it in items:
+            if isinstance(it.value, A.Star):
+                q = it.value.qualifier
+                for f in scope.fields:
+                    if q is None or f.relation == q:
+                        ref = (A.Identifier(f.name) if q is None
+                               else A.DereferenceExpression(
+                                   A.Identifier(q), A.Identifier(f.name)))
+                        out.append(A.SelectItem(ref, f.name))
+                if not out:
+                    raise AnalysisError(f"no columns match {q}.*")
+            else:
+                out.append(it)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _realias(node: PlanNode, alias: str,
+             column_names: Tuple[str, ...] = ()) -> PlanNode:
+    names = list(column_names) or [f.name for f in node.fields]
+    fields = tuple(Field(n, f.type, relation=alias)
+                   for n, f in zip(names, node.fields))
+    if isinstance(node, OutputNode):
+        node = node.child
+    return _Realiased(node, fields)
+
+
+def _Realiased(node: PlanNode, fields) -> PlanNode:
+    # identity projection carrying the new field names/relations
+    return ProjectNode(
+        child=node,
+        exprs=tuple(ir.input_ref(i, f.type) for i, f in enumerate(fields)),
+        fields=fields)
+
+
+def _coerce_to(node: PlanNode, types: List[T.Type]) -> PlanNode:
+    if [f.type for f in node.fields] == types:
+        return node
+    exprs = tuple(
+        coerce(ir.input_ref(i, f.type), t)
+        for i, (f, t) in enumerate(zip(node.fields, types)))
+    fields = tuple(Field(f.name, t, f.relation)
+                   for f, t in zip(node.fields, types))
+    return ProjectNode(child=node, exprs=exprs, fields=fields)
+
+
+def _split_conjuncts(e: A.Expression) -> List[A.Expression]:
+    if isinstance(e, A.LogicalBinary) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _split_subquery_conjuncts(where: A.Expression):
+    """Separate IN-subquery conjuncts (-> semi joins) from plain ones."""
+    subqueries = []
+    remaining: List[A.Expression] = []
+    for c in _split_conjuncts(where):
+        neg = False
+        inner = c
+        if isinstance(inner, A.Not):
+            neg = True
+            inner = inner.value
+        if isinstance(inner, A.InSubquery):
+            subqueries.append((inner.value, inner.query, neg != inner.negated))
+            continue
+        if isinstance(inner, A.Exists):
+            raise AnalysisError(
+                "EXISTS subqueries are not supported yet (use IN)")
+        remaining.append(c)
+    return subqueries, _and_all(remaining)
+
+
+def _and_all(conjuncts: List[A.Expression]) -> Optional[A.Expression]:
+    if not conjuncts:
+        return None
+    out = conjuncts[0]
+    for c in conjuncts[1:]:
+        out = A.LogicalBinary("and", out, c)
+    return out
+
+
+def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:
+    found: List[A.FunctionCall] = []
+
+    def walk(n):
+        if isinstance(n, A.FunctionCall):
+            fn = _FUNCTION_ALIASES.get(n.name, n.name)
+            if fn in AGGREGATE_FUNCTIONS or n.is_star and fn == "count":
+                found.append(n)
+                return  # don't descend into agg args
+        if dataclasses.is_dataclass(n) and not isinstance(n, type):
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if isinstance(v, tuple):
+                    for x in v:
+                        if dataclasses.is_dataclass(x):
+                            walk(x)
+                elif dataclasses.is_dataclass(v):
+                    walk(v)
+    for e in exprs:
+        if e is not None:
+            walk(e)
+    return found
+
+
+def _derive_name(e: A.Expression, i: int) -> str:
+    if isinstance(e, A.Identifier):
+        return e.name
+    if isinstance(e, A.DereferenceExpression):
+        return e.field.name
+    if isinstance(e, A.FunctionCall):
+        return e.name
+    return f"_col{i}"
+
+
+def _agg_output_type(fn: str, arg: T.Type) -> T.Type:
+    if fn == "count":
+        return T.BIGINT
+    if fn == "sum":
+        if isinstance(arg, T.DecimalType):
+            return T.DecimalType(18, arg.scale)
+        if T.is_integral(arg):
+            return T.BIGINT
+        return T.DOUBLE if isinstance(arg, (T.DoubleType, T.RealType)) \
+            else T.DOUBLE
+    if fn == "avg":
+        if isinstance(arg, T.DecimalType):
+            return arg
+        return T.DOUBLE
+    # min/max
+    return arg
+
+
+def _extract_equi_keys(cond: Optional[ir.Expr], n_left: int):
+    """Split an ON condition into equi-key pairs + residual.
+
+    Mirrors the reference's join-criteria extraction (reference
+    sql/planner/optimizations/PredicatePushDown.java + EqualityInference).
+    """
+    left_keys: List[int] = []
+    right_keys: List[int] = []
+    residual: List[ir.Expr] = []
+    conjuncts: List[ir.Expr] = []
+
+    def split(e: ir.Expr):
+        if isinstance(e, ir.SpecialForm) and e.form == ir.Form.AND:
+            for a in e.args:
+                split(a)
+        else:
+            conjuncts.append(e)
+    if cond is not None:
+        split(cond)
+    for c in conjuncts:
+        if (isinstance(c, ir.Call) and c.name == "eq"
+                and isinstance(c.args[0], ir.InputRef)
+                and isinstance(c.args[1], ir.InputRef)):
+            a, b = c.args
+            if a.index < n_left <= b.index:
+                left_keys.append(a.index)
+                right_keys.append(b.index - n_left)
+                continue
+            if b.index < n_left <= a.index:
+                left_keys.append(b.index)
+                right_keys.append(a.index - n_left)
+                continue
+        residual.append(c)
+    res = None
+    if residual:
+        res = residual[0] if len(residual) == 1 else ir.special(
+            ir.Form.AND, T.BOOLEAN, *residual)
+    return left_keys, right_keys, res

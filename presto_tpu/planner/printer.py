@@ -1,0 +1,66 @@
+"""Plan printer for EXPLAIN.
+
+Conceptual parity with the reference's text plan printer (reference
+presto-main/.../sql/planner/planprinter/PlanPrinter.java, textLogicalPlan).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .plan import (
+    AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
+    OutputNode, PlanNode, ProjectNode, SemiJoinNode, SortNode,
+    TableScanNode, TopNNode, UnionNode, ValuesNode,
+)
+from .planner import LogicalPlan
+
+
+def print_plan(plan: LogicalPlan) -> str:
+    lines: List[str] = []
+    _walk(plan.root, 0, lines)
+    for i, init in enumerate(plan.init_plans):
+        lines.append(f"InitPlan[{i}]:")
+        _walk(init, 1, lines)
+    return "\n".join(lines)
+
+
+def _label(n: PlanNode) -> str:
+    cols = ", ".join(f"{f.name}:{f.type.display()}" for f in n.fields)
+    if isinstance(n, TableScanNode):
+        return f"TableScan[{n.table}] => [{cols}]"
+    if isinstance(n, FilterNode):
+        return f"Filter[{n.predicate!r}]"
+    if isinstance(n, ProjectNode):
+        return f"Project => [{cols}]"
+    if isinstance(n, AggregationNode):
+        aggs = ", ".join(f"{a.name}:={a.fn}({a.arg})" for a in n.aggs)
+        return (f"Aggregate[{n.step}, keys={list(n.group_indices)}] "
+                f"=> [{aggs}]")
+    if isinstance(n, JoinNode):
+        return (f"Join[{n.join_type}, {n.distribution}, "
+                f"L{list(n.left_keys)}=R{list(n.right_keys)}"
+                f"{', unique' if n.build_unique else ''}]")
+    if isinstance(n, SemiJoinNode):
+        return (f"SemiJoin[{'anti' if n.negated else 'semi'}, "
+                f"key={n.source_key}]")
+    if isinstance(n, SortNode):
+        return f"Sort[{[(k.index, 'asc' if k.ascending else 'desc') for k in n.keys]}]"
+    if isinstance(n, TopNNode):
+        return f"TopN[{n.count}, {[(k.index, 'asc' if k.ascending else 'desc') for k in n.keys]}]"
+    if isinstance(n, LimitNode):
+        return f"Limit[{n.count}]"
+    if isinstance(n, DistinctNode):
+        return "Distinct"
+    if isinstance(n, UnionNode):
+        return f"Union[{'distinct' if n.distinct else 'all'}]"
+    if isinstance(n, ValuesNode):
+        return f"Values[{len(n.rows)} rows]"
+    if isinstance(n, OutputNode):
+        return f"Output => [{cols}]"
+    return type(n).__name__
+
+
+def _walk(n: PlanNode, depth: int, lines: List[str]) -> None:
+    lines.append("  " * depth + "- " + _label(n))
+    for c in n.children:
+        _walk(c, depth + 1, lines)

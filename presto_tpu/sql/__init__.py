@@ -1,0 +1,1 @@
+from .parser import parse_statement  # noqa: F401

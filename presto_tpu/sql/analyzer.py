@@ -1,0 +1,393 @@
+"""Name/type resolution: AST expressions -> typed kernel IR.
+
+Conceptual parity with the reference's ExpressionAnalyzer + scope machinery
+(reference presto-main/.../sql/analyzer/ExpressionAnalyzer.java, Scope.java,
+and the AST->RowExpression lowering in sql/relational/SqlToRowExpression-
+Translator.java) collapsed into one pass: resolving a column yields its
+input index, inferring a type yields the IR node, so analysis produces the
+compile-ready expression directly.
+
+Aggregate calls are NOT handled here — the query planner rewrites them to
+input references before lowering (reference sql/analyzer/
+AggregationAnalyzer.java + planner/QueryPlanner.java split).
+"""
+from __future__ import annotations
+
+import dataclasses
+from decimal import Decimal
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..expr import ir
+from ..expr.functions import infer_call_type
+from . import ast as A
+from .lexer import SqlSyntaxError
+
+
+class AnalysisError(ValueError):
+    pass
+
+
+AGGREGATE_FUNCTIONS = frozenset(
+    ["count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
+     "stddev_pop", "variance", "var_samp", "var_pop", "approx_distinct",
+     "any_value", "arbitrary", "bool_and", "bool_or"])
+
+# SQL surface name -> kernel registry name
+_FUNCTION_ALIASES = {
+    "substring": "substr", "mod": "modulus", "pow": "power",
+    "ceiling": "ceil", "char_length": "length",
+}
+
+_ARITH_OPS = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+              "%": "modulus"}
+_CMP_OPS = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt",
+            ">=": "ge"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """One resolvable output column of a relation (reference
+    sql/analyzer/Field.java): name plus originating relation alias."""
+
+    name: str
+    type: T.Type
+    relation: Optional[str] = None   # alias or table name, lowercased
+
+
+class Scope:
+    """Visible fields during expression analysis (reference Scope.java).
+
+    Resolution is positional: a resolved column is its index in the
+    underlying relation's output — the IR InputRef index.
+    """
+
+    def __init__(self, fields: Sequence[Field],
+                 parent: Optional["Scope"] = None):
+        self.fields: Tuple[Field, ...] = tuple(fields)
+        self.parent = parent
+
+    def resolve(self, name: str, qualifier: Optional[str] = None) -> int:
+        matches = [
+            i for i, f in enumerate(self.fields)
+            if f.name == name and (qualifier is None or f.relation == qualifier)
+        ]
+        if len(matches) == 1:
+            return matches[0]
+        if len(matches) > 1:
+            raise AnalysisError(f"column {name!r} is ambiguous")
+        if self.parent is not None:
+            # correlated reference into an outer query — not yet planned
+            try:
+                self.parent.resolve(name, qualifier)
+            except AnalysisError:
+                pass
+            else:
+                raise AnalysisError(
+                    f"correlated reference to outer column {name!r} is not "
+                    "supported yet")
+        q = f"{qualifier}." if qualifier else ""
+        raise AnalysisError(f"column {q}{name} cannot be resolved")
+
+    def field(self, index: int) -> Field:
+        return self.fields[index]
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+
+def literal_type(node: A.Expression) -> T.Type:
+    if isinstance(node, A.LongLiteral):
+        return T.BIGINT
+    if isinstance(node, A.DecimalLiteral):
+        d = node.value.as_tuple()
+        scale = max(0, -int(d.exponent))
+        precision = max(len(d.digits), scale)
+        return T.DecimalType(min(precision, 18), min(scale, 18))
+    if isinstance(node, A.DoubleLiteral):
+        return T.DOUBLE
+    if isinstance(node, A.StringLiteral):
+        return T.VarcharType(len(node.value))
+    if isinstance(node, A.BooleanLiteral):
+        return T.BOOLEAN
+    if isinstance(node, A.DateLiteral):
+        return T.DATE
+    if isinstance(node, A.NullLiteral):
+        return T.UNKNOWN
+    raise AnalysisError(f"not a literal: {node}")
+
+
+def coerce(e: ir.Expr, to: T.Type) -> ir.Expr:
+    if e.type == to:
+        return e
+    if isinstance(e, ir.Literal):
+        # fold literal casts at analysis time (constant folding, reference
+        # sql/planner/ExpressionInterpreter.java role)
+        v = e.value
+        if v is None:
+            return ir.lit(None, to)
+        if isinstance(to, (T.DoubleType, T.RealType)):
+            return ir.lit(float(v), to)
+        if T.is_integral(to):
+            return ir.lit(int(v), to)
+        if isinstance(to, T.DecimalType):
+            return ir.lit(Decimal(str(v)), to)
+        if isinstance(to, (T.VarcharType, T.CharType)):
+            return ir.lit(str(v), to)
+    return ir.cast(e, to)
+
+
+def unify(a: ir.Expr, b: ir.Expr) -> Tuple[ir.Expr, ir.Expr, T.Type]:
+    t = T.common_super_type(a.type, b.type)
+    if t is None:
+        raise AnalysisError(
+            f"cannot compare/combine {a.type.display()} and {b.type.display()}")
+    return coerce(a, t), coerce(b, t), t
+
+
+class ExpressionAnalyzer:
+    """Lowers one AST expression against a scope.
+
+    ``replacements`` maps AST subtrees (by structural equality) to
+    pre-computed input references — how the planner routes aggregate
+    results and group keys through post-aggregation expressions.
+    """
+
+    def __init__(self, scope: Scope,
+                 replacements: Optional[Dict[A.Expression, ir.Expr]] = None):
+        self.scope = scope
+        self.replacements = replacements or {}
+
+    def analyze(self, node: A.Expression) -> ir.Expr:
+        hit = self.replacements.get(node)
+        if hit is not None:
+            return hit
+        m = getattr(self, "_" + type(node).__name__, None)
+        if m is None:
+            raise AnalysisError(f"unsupported expression {type(node).__name__}")
+        return m(node)
+
+    # -- leaves --------------------------------------------------------------
+    def _Identifier(self, node: A.Identifier) -> ir.Expr:
+        idx = self.scope.resolve(node.name)
+        return ir.input_ref(idx, self.scope.field(idx).type)
+
+    def _DereferenceExpression(self, node: A.DereferenceExpression) -> ir.Expr:
+        if not isinstance(node.base, A.Identifier):
+            raise AnalysisError("only table.column dereference is supported")
+        idx = self.scope.resolve(node.field.name, node.base.name)
+        return ir.input_ref(idx, self.scope.field(idx).type)
+
+    def _NullLiteral(self, node):
+        return ir.lit(None, T.UNKNOWN)
+
+    def _BooleanLiteral(self, node):
+        return ir.lit(node.value, T.BOOLEAN)
+
+    def _LongLiteral(self, node):
+        return ir.lit(node.value, T.BIGINT)
+
+    def _DecimalLiteral(self, node):
+        return ir.lit(node.value, literal_type(node))
+
+    def _DoubleLiteral(self, node):
+        return ir.lit(node.value, T.DOUBLE)
+
+    def _StringLiteral(self, node):
+        return ir.lit(node.value, T.VarcharType(len(node.value)))
+
+    def _DateLiteral(self, node):
+        return ir.lit(node.value, T.DATE)
+
+    def _IntervalLiteral(self, node):
+        raise AnalysisError(
+            "interval literal only supported in date +/- interval")
+
+    # -- operators -----------------------------------------------------------
+    def _ArithmeticBinary(self, node: A.ArithmeticBinary) -> ir.Expr:
+        # date +/- interval  ->  date_add_*
+        if isinstance(node.right, A.IntervalLiteral) and node.op in "+-":
+            left = self.analyze(node.left)
+            iv = node.right
+            amount = int(iv.value) * iv.sign * (1 if node.op == "+" else -1)
+            unit_fn = {"day": "date_add_days", "month": "date_add_months",
+                       "year": "date_add_years"}.get(iv.unit)
+            if unit_fn is None or not isinstance(left.type, (T.DateType, T.TimestampType)):
+                raise AnalysisError(f"unsupported interval arithmetic {iv}")
+            return ir.call(unit_fn, left.type, left,
+                           ir.lit(amount, T.BIGINT))
+        left = self.analyze(node.left)
+        right = self.analyze(node.right)
+        name = _ARITH_OPS[node.op]
+        out = infer_call_type(name, [left.type, right.type])
+        # operands coerce toward the output domain (decimal args keep their
+        # scales: the kernel handles rescaling; float args widen)
+        if not isinstance(out, T.DecimalType):
+            left, right = coerce(left, out), coerce(right, out)
+        return ir.call(name, out, left, right)
+
+    def _ArithmeticUnary(self, node: A.ArithmeticUnary) -> ir.Expr:
+        v = self.analyze(node.value)
+        if node.op == "+":
+            return v
+        return ir.call("negate", v.type, v)
+
+    def _Comparison(self, node: A.Comparison) -> ir.Expr:
+        left = self.analyze(node.left)
+        right = self.analyze(node.right)
+        left, right, _ = unify(left, right)
+        return ir.call(_CMP_OPS[node.op], T.BOOLEAN, left, right)
+
+    def _LogicalBinary(self, node: A.LogicalBinary) -> ir.Expr:
+        # flatten chains into one n-ary special form
+        form = ir.Form.AND if node.op == "and" else ir.Form.OR
+        args: List[ir.Expr] = []
+
+        def walk(n: A.Expression):
+            if isinstance(n, A.LogicalBinary) and n.op == node.op:
+                walk(n.left)
+                walk(n.right)
+            else:
+                args.append(self._to_bool(self.analyze(n)))
+        walk(node)
+        return ir.special(form, T.BOOLEAN, *args)
+
+    def _to_bool(self, e: ir.Expr) -> ir.Expr:
+        if not isinstance(e.type, T.BooleanType):
+            raise AnalysisError(
+                f"expected boolean, got {e.type.display()}")
+        return e
+
+    def _Not(self, node: A.Not) -> ir.Expr:
+        return ir.call("not", T.BOOLEAN, self._to_bool(self.analyze(node.value)))
+
+    def _Between(self, node: A.Between) -> ir.Expr:
+        v = self.analyze(node.value)
+        lo = self.analyze(node.min)
+        hi = self.analyze(node.max)
+        v1, lo, _ = unify(v, lo)
+        v2, hi, _ = unify(v, hi)
+        # coerce v to the wider of both unifications
+        v = v1 if v1.type == v2.type else (
+            v1 if T.common_super_type(v1.type, v2.type) == v1.type else v2)
+        lo = coerce(lo, v.type)
+        hi = coerce(hi, v.type)
+        e = ir.special(ir.Form.BETWEEN, T.BOOLEAN, v, lo, hi)
+        return ir.call("not", T.BOOLEAN, e) if node.negated else e
+
+    def _InList(self, node: A.InList) -> ir.Expr:
+        v = self.analyze(node.value)
+        items = [self.analyze(i) for i in node.items]
+        for i, it in enumerate(items):
+            v2, it2, _ = unify(v, it)
+            v, items[i] = v2, it2
+        items = [coerce(it, v.type) for it in items]
+        e = ir.special(ir.Form.IN, T.BOOLEAN, v, *items)
+        return ir.call("not", T.BOOLEAN, e) if node.negated else e
+
+    def _Like(self, node: A.Like) -> ir.Expr:
+        v = self.analyze(node.value)
+        if not isinstance(node.pattern, A.StringLiteral):
+            raise AnalysisError("LIKE pattern must be a string literal")
+        escape = None
+        if node.escape is not None:
+            if not isinstance(node.escape, A.StringLiteral):
+                raise AnalysisError("LIKE escape must be a string literal")
+            escape = node.escape.value
+        pat = ir.lit(node.pattern.value, T.VarcharType(len(node.pattern.value)))
+        args = [v, pat]
+        if escape is not None:
+            args.append(ir.lit(escape, T.VarcharType(len(escape))))
+        e = ir.call("like", T.BOOLEAN, *args)
+        return ir.call("not", T.BOOLEAN, e) if node.negated else e
+
+    def _IsNull(self, node: A.IsNull) -> ir.Expr:
+        e = ir.special(ir.Form.IS_NULL, T.BOOLEAN, self.analyze(node.value))
+        return ir.call("not", T.BOOLEAN, e) if node.negated else e
+
+    def _Cast(self, node: A.Cast) -> ir.Expr:
+        v = self.analyze(node.value)
+        to = T.parse_type(node.type_name)
+        return coerce(v, to)
+
+    def _Extract(self, node: A.Extract) -> ir.Expr:
+        v = self.analyze(node.value)
+        field = node.field.lower()
+        if field not in ("year", "month", "day", "quarter"):
+            raise AnalysisError(f"EXTRACT({field}) not supported")
+        return ir.call(field, T.BIGINT, v)
+
+    def _WhenList(self, whens, default, operand=None):
+        args: List[ir.Expr] = []
+        results: List[ir.Expr] = []
+        conds: List[ir.Expr] = []
+        for w in whens:
+            if operand is not None:
+                op_e = self.analyze(operand)
+                val_e = self.analyze(w.condition)
+                a, b, _ = unify(op_e, val_e)
+                conds.append(ir.call("eq", T.BOOLEAN, a, b))
+            else:
+                conds.append(self._to_bool(self.analyze(w.condition)))
+            results.append(self.analyze(w.result))
+        d = self.analyze(default) if default is not None else ir.lit(None, T.UNKNOWN)
+        out_t = d.type
+        for r in results:
+            t = T.common_super_type(out_t, r.type)
+            if t is None:
+                raise AnalysisError("CASE branches have incompatible types")
+            out_t = t
+        results = [coerce(r, out_t) for r in results]
+        d = coerce(d, out_t)
+        for c, r in zip(conds, results):
+            args.extend([c, r])
+        args.append(d)
+        return ir.special(ir.Form.SWITCH, out_t, *args)
+
+    def _SearchedCase(self, node: A.SearchedCase) -> ir.Expr:
+        return self._WhenList(node.whens, node.default)
+
+    def _SimpleCase(self, node: A.SimpleCase) -> ir.Expr:
+        return self._WhenList(node.whens, node.default, operand=node.operand)
+
+    def _Coalesce(self, node: A.Coalesce) -> ir.Expr:
+        args = [self.analyze(a) for a in node.args]
+        out_t = args[0].type
+        for a in args[1:]:
+            t = T.common_super_type(out_t, a.type)
+            if t is None:
+                raise AnalysisError("COALESCE args have incompatible types")
+            out_t = t
+        args = [coerce(a, out_t) for a in args]
+        return ir.special(ir.Form.COALESCE, out_t, *args)
+
+    def _NullIf(self, node: A.NullIf) -> ir.Expr:
+        a = self.analyze(node.first)
+        b = self.analyze(node.second)
+        a2, b2, _ = unify(a, b)
+        return ir.special(ir.Form.NULL_IF, a.type, a2, b2)
+
+    def _FunctionCall(self, node: A.FunctionCall) -> ir.Expr:
+        name = _FUNCTION_ALIASES.get(node.name, node.name)
+        if name in AGGREGATE_FUNCTIONS:
+            raise AnalysisError(
+                f"aggregate function {name}() in scalar context (missing "
+                "GROUP BY rewrite?)")
+        args = [self.analyze(a) for a in node.args]
+        try:
+            out = infer_call_type(name, [a.type for a in args])
+        except KeyError:
+            raise AnalysisError(f"unknown function {node.name!r}")
+        return ir.call(name, out, *args)
+
+    def _ScalarSubquery(self, node):
+        raise AnalysisError("scalar subquery must be planned (init plan)")
+
+    def _InSubquery(self, node):
+        raise AnalysisError("IN subquery must be planned (semi join)")
+
+    def _Exists(self, node):
+        raise AnalysisError("EXISTS must be planned (semi join)")
+
+    def _Star(self, node):
+        raise AnalysisError("* only allowed at the top of SELECT")

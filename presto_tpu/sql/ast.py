@@ -1,0 +1,364 @@
+"""SQL abstract syntax tree.
+
+Conceptual parity with Presto's AST (reference presto-parser/src/main/java/
+io/prestosql/sql/tree/ — 169 node classes); this is the subset needed for
+the TPC-H/TPC-DS query language plus the session/DDL-lite statements the
+engine serves. Nodes are frozen dataclasses: hashable, comparable,
+printable — the analyzer annotates types out-of-band keyed by node
+identity, like Presto's Analysis maps (reference
+presto-main/.../sql/analyzer/Analysis.java).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from decimal import Decimal
+
+
+class Node:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Expressions (reference sql/tree/Expression.java subclasses)
+# ---------------------------------------------------------------------------
+
+class Expression(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Identifier(Expression):
+    name: str                      # lowercased unless quoted
+    quoted: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DereferenceExpression(Expression):
+    """Qualified name a.b (table.column)."""
+    base: Expression
+    field: Identifier
+
+
+@dataclasses.dataclass(frozen=True)
+class NullLiteral(Expression):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanLiteral(Expression):
+    value: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class LongLiteral(Expression):
+    value: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalLiteral(Expression):
+    value: Decimal
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleLiteral(Expression):
+    value: float
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLiteral(Expression):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DateLiteral(Expression):
+    """DATE 'yyyy-mm-dd' (reference sql/tree/GenericLiteral.java)."""
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalLiteral(Expression):
+    """INTERVAL '3' MONTH — sign, value text, unit."""
+    value: str
+    unit: str                      # year|month|day|hour|minute|second
+    sign: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ArithmeticBinary(Expression):
+    op: str                        # + - * / %
+    left: Expression
+    right: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class ArithmeticUnary(Expression):
+    op: str                        # + -
+    value: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Comparison(Expression):
+    op: str                        # = <> < <= > >=
+    left: Expression
+    right: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class LogicalBinary(Expression):
+    op: str                        # and | or
+    left: Expression
+    right: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Not(Expression):
+    value: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Expression):
+    value: Expression
+    min: Expression
+    max: Expression
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expression):
+    value: Expression
+    items: Tuple[Expression, ...]
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Expression):
+    value: Expression
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Expression):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Expression):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Expression):
+    value: Expression
+    pattern: Expression
+    escape: Optional[Expression] = None
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expression):
+    value: Expression
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionCall(Expression):
+    name: str                      # lowercased
+    args: Tuple[Expression, ...]
+    distinct: bool = False
+    is_star: bool = False          # count(*)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expression):
+    value: Expression
+    type_name: str                 # e.g. "decimal(12,2)"
+    try_cast: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract(Expression):
+    field: str                     # year|month|day|...
+    value: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class WhenClause(Node):
+    condition: Expression
+    result: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchedCase(Expression):
+    whens: Tuple[WhenClause, ...]
+    default: Optional[Expression] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleCase(Expression):
+    operand: Expression
+    whens: Tuple[WhenClause, ...]
+    default: Optional[Expression] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Coalesce(Expression):
+    args: Tuple[Expression, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class NullIf(Expression):
+    first: Expression
+    second: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Expression):
+    """SELECT * or t.*"""
+    qualifier: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Relations (reference sql/tree/Relation.java subclasses)
+# ---------------------------------------------------------------------------
+
+class Relation(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Table(Relation):
+    """Possibly-qualified table name: [catalog.][schema.]table"""
+    name: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class AliasedRelation(Relation):
+    relation: Relation
+    alias: str
+    column_names: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRelation(Relation):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(Relation):
+    join_type: str                 # inner|left|right|full|cross|implicit
+    left: Relation
+    right: Relation
+    condition: Optional[Expression] = None   # ON expr (None for cross)
+
+
+# ---------------------------------------------------------------------------
+# Query structure (reference sql/tree/Query.java, QuerySpecification.java)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    value: Expression
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SortItem(Node):
+    key: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None     # None = type default (last for asc)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuerySpecification(Node):
+    select: Tuple[SelectItem, ...]
+    distinct: bool = False
+    from_: Optional[Relation] = None
+    where: Optional[Expression] = None
+    group_by: Tuple[Expression, ...] = ()
+    having: Optional[Expression] = None
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Query(Node):
+    """Top-level query: body plus WITH bindings."""
+    body: Node                     # QuerySpecification | SetOperation
+    with_: Tuple[Tuple[str, "Query"], ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SetOperation(Node):
+    op: str                        # union|intersect|except
+    distinct: bool                 # False = ALL
+    left: Node
+    right: Node
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Statements beyond queries (reference sql/tree/Statement.java subclasses)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Explain(Node):
+    statement: Node
+    analyze: bool = False
+    type: str = "logical"          # logical|distributed|io
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Node):
+    schema: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowColumns(Node):
+    table: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCatalogs(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSession(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SetSession(Node):
+    name: str
+    value: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class ResetSession(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTableAsSelect(Node):
+    name: Tuple[str, ...]
+    query: Query
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Node):
+    name: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InsertInto(Node):
+    name: Tuple[str, ...]
+    query: Query
+    columns: Tuple[str, ...] = ()

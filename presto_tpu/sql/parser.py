@@ -1,0 +1,644 @@
+"""Recursive-descent SQL parser (Pratt expressions).
+
+Hand-written replacement for the reference's ANTLR parser (reference
+presto-parser/.../parser/SqlParser.java:95 createStatement and
+AstBuilder.java) covering the query language TPC-H/TPC-DS needs plus
+session/EXPLAIN/SHOW/CTAS statements. Precedence mirrors SqlBase.g4:
+OR < AND < NOT < predicate (IS/BETWEEN/IN/LIKE/comparison) < + - < * / %
+< unary < postfix.
+"""
+from __future__ import annotations
+
+from decimal import Decimal
+from typing import List, Optional, Tuple
+
+from . import ast as A
+from .lexer import NON_RESERVED, SqlSyntaxError, Token, tokenize
+
+
+def parse_statement(sql: str) -> A.Node:
+    p = _Parser(tokenize(sql))
+    stmt = p.statement()
+    p.expect_kind("EOF")
+    return stmt
+
+
+def parse_expression(sql: str) -> A.Expression:
+    p = _Parser(tokenize(sql))
+    e = p.expression()
+    p.expect_kind("EOF")
+    return e
+
+
+class _Parser:
+    def __init__(self, tokens: List[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # -- token helpers ------------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "EOF":
+            self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "KEYWORD" and t.text in words
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "OP" and t.text in ops
+
+    def accept_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> Token:
+        t = self.peek()
+        if not self.at_kw(word):
+            raise SqlSyntaxError(f"expected {word.upper()}, found {t.text!r}",
+                                 t.line, t.col)
+        return self.next()
+
+    def expect_op(self, op: str) -> Token:
+        t = self.peek()
+        if not self.at_op(op):
+            raise SqlSyntaxError(f"expected {op!r}, found {t.text!r}",
+                                 t.line, t.col)
+        return self.next()
+
+    def expect_kind(self, kind: str) -> Token:
+        t = self.peek()
+        if t.kind != kind:
+            raise SqlSyntaxError(f"expected {kind}, found {t.text!r}",
+                                 t.line, t.col)
+        return self.next()
+
+    def identifier(self) -> str:
+        t = self.peek()
+        if t.kind == "IDENT" or t.kind == "QIDENT":
+            return self.next().text
+        if t.kind == "KEYWORD" and t.text in NON_RESERVED:
+            return self.next().text
+        raise SqlSyntaxError(f"expected identifier, found {t.text!r}",
+                             t.line, t.col)
+
+    def qualified_name(self) -> Tuple[str, ...]:
+        parts = [self.identifier()]
+        while self.at_op(".") and self.peek(1).kind in ("IDENT", "QIDENT") or (
+                self.at_op(".") and self.peek(1).kind == "KEYWORD"
+                and self.peek(1).text in NON_RESERVED):
+            self.next()
+            parts.append(self.identifier())
+        return tuple(parts)
+
+    # -- statements ---------------------------------------------------------
+    def statement(self) -> A.Node:
+        if self.at_kw("explain"):
+            self.next()
+            analyze = self.accept_kw("analyze")
+            return A.Explain(self.statement(), analyze=analyze)
+        if self.at_kw("show"):
+            return self._show()
+        if self.at_kw("describe"):
+            self.next()
+            return A.ShowColumns(self.qualified_name())
+        if self.at_kw("set"):
+            self.next()
+            self.expect_kw("session")
+            name = ".".join(self.qualified_name())
+            self.expect_op("=")
+            return A.SetSession(name, self.expression())
+        if self.at_kw("reset"):
+            self.next()
+            self.expect_kw("session")
+            return A.ResetSession(".".join(self.qualified_name()))
+        if self.at_kw("create"):
+            return self._create()
+        if self.at_kw("drop"):
+            self.next()
+            self.expect_kw("table")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return A.DropTable(self.qualified_name(), if_exists)
+        if self.at_kw("insert"):
+            self.next()
+            self.expect_kw("into")
+            name = self.qualified_name()
+            cols: Tuple[str, ...] = ()
+            if self.at_op("(") and self._looks_like_column_list():
+                self.next()
+                names = [self.identifier()]
+                while self.accept_op(","):
+                    names.append(self.identifier())
+                self.expect_op(")")
+                cols = tuple(names)
+            return A.InsertInto(name, self.query(), cols)
+        return self.query()
+
+    def _looks_like_column_list(self) -> bool:
+        # distinguish INSERT INTO t (a, b) SELECT ... from INSERT INTO t (SELECT...)
+        return not (self.peek(1).kind == "KEYWORD"
+                    and self.peek(1).text in ("select", "with", "values"))
+
+    def _show(self) -> A.Node:
+        self.expect_kw("show")
+        if self.accept_kw("tables"):
+            schema = None
+            if self.accept_kw("from") or self.accept_kw("in"):
+                schema = self.qualified_name()
+            return A.ShowTables(schema)
+        if self.accept_kw("columns"):
+            self.expect_kw("from")
+            return A.ShowColumns(self.qualified_name())
+        if self.accept_kw("catalogs"):
+            return A.ShowCatalogs()
+        if self.accept_kw("session"):
+            return A.ShowSession()
+        t = self.peek()
+        raise SqlSyntaxError(f"unsupported SHOW {t.text!r}", t.line, t.col)
+
+    def _create(self) -> A.Node:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.qualified_name()
+        self.expect_kw("as")
+        return A.CreateTableAsSelect(name, self.query(), if_not_exists)
+
+    # -- queries ------------------------------------------------------------
+    def query(self) -> A.Query:
+        with_: List[Tuple[str, A.Query]] = []
+        if self.accept_kw("with"):
+            self.accept_kw("recursive")
+            while True:
+                cte = self.identifier()
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.query()
+                self.expect_op(")")
+                with_.append((cte, q))
+                if not self.accept_op(","):
+                    break
+        body = self._set_expr()
+        # ORDER BY / LIMIT bind at query level (SqlBase.g4 queryNoWith),
+        # covering the whole set operation
+        order_by = self._order_by()
+        limit = self._limit()
+        if order_by or limit is not None:
+            import dataclasses as _dc
+            if isinstance(body, A.Query) and not body.with_:
+                body = body.body
+            body = _dc.replace(body, order_by=order_by, limit=limit)
+        return A.Query(body=body, with_=tuple(with_))
+
+    def _set_expr(self) -> A.Node:
+        left = self._query_term()
+        while self.at_kw("union", "intersect", "except"):
+            op = self.next().text
+            distinct = True
+            if self.accept_kw("all"):
+                distinct = False
+            else:
+                self.accept_kw("distinct")
+            right = self._query_term()
+            left = A.SetOperation(op, distinct, left, right)
+        return left
+
+    def _query_term(self) -> A.Node:
+        if self.accept_op("("):
+            q = self.query()          # queryPrimary: '(' queryNoWith ')'
+            self.expect_op(")")
+            return q
+        return self.query_spec()
+
+    def query_spec(self) -> A.QuerySpecification:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        items = [self._select_item()]
+        while self.accept_op(","):
+            items.append(self._select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self._relation()
+            while self.accept_op(","):
+                right = self._relation()
+                from_ = A.Join("implicit", from_, right)
+        where = self.expression() if self.accept_kw("where") else None
+        group_by: Tuple[A.Expression, ...] = ()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            exprs = [self.expression()]
+            while self.accept_op(","):
+                exprs.append(self.expression())
+            group_by = tuple(exprs)
+        having = self.expression() if self.accept_kw("having") else None
+        return A.QuerySpecification(
+            select=tuple(items), distinct=distinct, from_=from_, where=where,
+            group_by=group_by, having=having)
+
+    def _order_by(self) -> Tuple[A.SortItem, ...]:
+        if not self.accept_kw("order"):
+            return ()
+        self.expect_kw("by")
+        items = [self._sort_item()]
+        while self.accept_op(","):
+            items.append(self._sort_item())
+        return tuple(items)
+
+    def _sort_item(self) -> A.SortItem:
+        key = self.expression()
+        asc = True
+        if self.accept_kw("asc"):
+            asc = True
+        elif self.accept_kw("desc"):
+            asc = False
+        nulls_first: Optional[bool] = None
+        if self.accept_kw("nulls"):
+            if self.accept_kw("first"):
+                nulls_first = True
+            else:
+                self.expect_kw("last")
+                nulls_first = False
+        return A.SortItem(key, asc, nulls_first)
+
+    def _limit(self) -> Optional[int]:
+        if self.accept_kw("limit"):
+            t = self.expect_kind("INTEGER")
+            return int(t.text)
+        return None
+
+    def _select_item(self) -> A.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return A.SelectItem(A.Star())
+        # t.* form
+        if (self.peek().kind in ("IDENT", "QIDENT") and self.peek(1).kind == "OP"
+                and self.peek(1).text == "." and self.peek(2).kind == "OP"
+                and self.peek(2).text == "*"):
+            q = self.identifier()
+            self.next()
+            self.next()
+            return A.SelectItem(A.Star(qualifier=q))
+        e = self.expression()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.identifier()
+        elif self.peek().kind in ("IDENT", "QIDENT"):
+            alias = self.identifier()
+        return A.SelectItem(e, alias)
+
+    # -- relations ----------------------------------------------------------
+    def _relation(self) -> A.Relation:
+        left = self._aliased_relation()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self._aliased_relation()
+                left = A.Join("cross", left, right)
+                continue
+            join_type = None
+            if self.at_kw("join"):
+                join_type = "inner"
+            elif self.at_kw("inner"):
+                join_type = "inner"
+                self.next()
+            elif self.at_kw("left"):
+                join_type = "left"
+                self.next()
+                self.accept_kw("outer")
+            elif self.at_kw("right"):
+                join_type = "right"
+                self.next()
+                self.accept_kw("outer")
+            elif self.at_kw("full"):
+                join_type = "full"
+                self.next()
+                self.accept_kw("outer")
+            if join_type is None:
+                return left
+            self.expect_kw("join")
+            right = self._aliased_relation()
+            self.expect_kw("on")
+            cond = self.expression()
+            left = A.Join(join_type, left, right, cond)
+
+    def _aliased_relation(self) -> A.Relation:
+        rel = self._primary_relation()
+        alias = None
+        cols: Tuple[str, ...] = ()
+        if self.accept_kw("as"):
+            alias = self.identifier()
+        elif self.peek().kind in ("IDENT", "QIDENT"):
+            alias = self.identifier()
+        if alias is not None and self.at_op("(") and False:
+            pass
+        if alias is not None:
+            return A.AliasedRelation(rel, alias, cols)
+        return rel
+
+    def _primary_relation(self) -> A.Relation:
+        if self.accept_op("("):
+            if self.at_kw("select", "with") or self.at_op("("):
+                q = self.query()
+                self.expect_op(")")
+                return A.SubqueryRelation(q)
+            rel = self._relation()
+            self.expect_op(")")
+            return rel
+        return A.Table(self.qualified_name())
+
+    # -- expressions (Pratt) ------------------------------------------------
+    def expression(self) -> A.Expression:
+        return self._or_expr()
+
+    def _or_expr(self) -> A.Expression:
+        left = self._and_expr()
+        while self.accept_kw("or"):
+            left = A.LogicalBinary("or", left, self._and_expr())
+        return left
+
+    def _and_expr(self) -> A.Expression:
+        left = self._not_expr()
+        while self.accept_kw("and"):
+            left = A.LogicalBinary("and", left, self._not_expr())
+        return left
+
+    def _not_expr(self) -> A.Expression:
+        if self.accept_kw("not"):
+            return A.Not(self._not_expr())
+        return self._predicate()
+
+    def _predicate(self) -> A.Expression:
+        left = self._additive()
+        while True:
+            if self.at_op("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.next().text
+                if op == "!=":
+                    op = "<>"
+                right = self._additive()
+                left = A.Comparison(op, left, right)
+                continue
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                if not self.at_kw("between", "in", "like"):
+                    # NOT here belongs to an IS NOT NULL-style form or is an
+                    # error; rewind and stop
+                    self.i = save
+                    return left
+                negated = True
+            if self.accept_kw("between"):
+                lo = self._additive()
+                self.expect_kw("and")
+                hi = self._additive()
+                left = A.Between(left, lo, hi, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    q = self.query()
+                    self.expect_op(")")
+                    left = A.InSubquery(left, q, negated)
+                else:
+                    items = [self.expression()]
+                    while self.accept_op(","):
+                        items.append(self.expression())
+                    self.expect_op(")")
+                    left = A.InList(left, tuple(items), negated)
+                continue
+            if self.accept_kw("like"):
+                pattern = self._additive()
+                escape = None
+                if self.accept_kw("escape"):
+                    escape = self._additive()
+                left = A.Like(left, pattern, escape, negated)
+                continue
+            if self.at_kw("is"):
+                self.next()
+                neg = self.accept_kw("not")
+                self.expect_kw("null")
+                left = A.IsNull(left, neg)
+                continue
+            return left
+
+    def _additive(self) -> A.Expression:
+        left = self._multiplicative()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().text
+                left = A.ArithmeticBinary(op, left, self._multiplicative())
+            elif self.at_op("||"):
+                self.next()
+                left = A.FunctionCall("concat", (left, self._multiplicative()))
+            else:
+                return left
+
+    def _multiplicative(self) -> A.Expression:
+        left = self._unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().text
+            left = A.ArithmeticBinary(op, left, self._unary())
+        return left
+
+    def _unary(self) -> A.Expression:
+        if self.at_op("-", "+"):
+            op = self.next().text
+            v = self._unary()
+            if op == "-" and isinstance(v, A.LongLiteral):
+                return A.LongLiteral(-v.value)
+            if op == "-" and isinstance(v, A.DecimalLiteral):
+                return A.DecimalLiteral(-v.value)
+            if op == "-" and isinstance(v, A.DoubleLiteral):
+                return A.DoubleLiteral(-v.value)
+            return A.ArithmeticUnary(op, v) if op == "-" else v
+        return self._primary()
+
+    def _primary(self) -> A.Expression:
+        t = self.peek()
+        if t.kind == "INTEGER":
+            self.next()
+            return A.LongLiteral(int(t.text))
+        if t.kind == "NUMBER":
+            self.next()
+            if "e" in t.text.lower():
+                return A.DoubleLiteral(float(t.text))
+            return A.DecimalLiteral(Decimal(t.text))
+        if t.kind == "STRING":
+            self.next()
+            return A.StringLiteral(t.text)
+        if t.kind == "KEYWORD":
+            return self._keyword_primary(t)
+        if t.kind == "OP" and t.text == "(":
+            self.next()
+            if self.at_kw("select", "with"):
+                q = self.query()
+                self.expect_op(")")
+                return A.ScalarSubquery(q)
+            e = self.expression()
+            self.expect_op(")")
+            return self._postfix(e)
+        if t.kind in ("IDENT", "QIDENT"):
+            return self._ident_primary()
+        raise SqlSyntaxError(f"unexpected token {t.text!r}", t.line, t.col)
+
+    def _keyword_primary(self, t: Token) -> A.Expression:
+        w = t.text
+        if w == "null":
+            self.next()
+            return A.NullLiteral()
+        if w in ("true", "false"):
+            self.next()
+            return A.BooleanLiteral(w == "true")
+        if w == "date":
+            if self.peek(1).kind == "STRING":
+                self.next()
+                s = self.next()
+                return A.DateLiteral(s.text)
+            return self._ident_primary()
+        if w == "timestamp" and self.peek(1).kind == "STRING":
+            self.next()
+            s = self.next()
+            return A.FunctionCall("parse_timestamp_literal",
+                                  (A.StringLiteral(s.text),))
+        if w == "interval":
+            self.next()
+            sign = 1
+            if self.accept_op("-"):
+                sign = -1
+            else:
+                self.accept_op("+")
+            v = self.expect_kind("STRING")
+            unit_t = self.peek()
+            if not (unit_t.kind == "KEYWORD" and unit_t.text in (
+                    "year", "month", "day", "hour", "minute", "second")):
+                raise SqlSyntaxError("expected interval unit",
+                                     unit_t.line, unit_t.col)
+            self.next()
+            return A.IntervalLiteral(v.text, unit_t.text, sign)
+        if w in ("cast", "try_cast"):
+            self.next()
+            self.expect_op("(")
+            e = self.expression()
+            self.expect_kw("as")
+            type_name = self._type_name()
+            self.expect_op(")")
+            return self._postfix(A.Cast(e, type_name, try_cast=(w == "try_cast")))
+        if w == "extract":
+            self.next()
+            self.expect_op("(")
+            field = self.identifier() if not self.peek().kind == "KEYWORD" \
+                else self.next().text
+            self.expect_kw("from")
+            e = self.expression()
+            self.expect_op(")")
+            return A.Extract(field, e)
+        if w == "case":
+            return self._case()
+        if w == "exists":
+            self.next()
+            self.expect_op("(")
+            q = self.query()
+            self.expect_op(")")
+            return A.Exists(q)
+        if w == "coalesce":
+            self.next()
+            self.expect_op("(")
+            args = [self.expression()]
+            while self.accept_op(","):
+                args.append(self.expression())
+            self.expect_op(")")
+            return A.Coalesce(tuple(args))
+        if w == "nullif":
+            self.next()
+            self.expect_op("(")
+            first = self.expression()
+            self.expect_op(",")
+            second = self.expression()
+            self.expect_op(")")
+            return A.NullIf(first, second)
+        if w in NON_RESERVED:
+            return self._ident_primary()
+        raise SqlSyntaxError(f"unexpected keyword {w!r}", t.line, t.col)
+
+    def _case(self) -> A.Expression:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expression()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.expression()
+            self.expect_kw("then")
+            res = self.expression()
+            whens.append(A.WhenClause(cond, res))
+        default = None
+        if self.accept_kw("else"):
+            default = self.expression()
+        self.expect_kw("end")
+        if operand is not None:
+            return A.SimpleCase(operand, tuple(whens), default)
+        return A.SearchedCase(tuple(whens), default)
+
+    def _type_name(self) -> str:
+        base = self.identifier() if self.peek().kind != "KEYWORD" \
+            else self.next().text
+        if self.accept_op("("):
+            args = [self.expect_kind("INTEGER").text]
+            while self.accept_op(","):
+                args.append(self.expect_kind("INTEGER").text)
+            self.expect_op(")")
+            return f"{base}({','.join(args)})"
+        return base
+
+    def _ident_primary(self) -> A.Expression:
+        name = self.identifier()
+        # function call?
+        if self.at_op("("):
+            self.next()
+            if self.accept_op("*"):
+                self.expect_op(")")
+                return A.FunctionCall(name.lower(), (), is_star=True)
+            distinct = False
+            args: List[A.Expression] = []
+            if not self.at_op(")"):
+                if self.accept_kw("distinct"):
+                    distinct = True
+                else:
+                    self.accept_kw("all")
+                args.append(self.expression())
+                while self.accept_op(","):
+                    args.append(self.expression())
+            self.expect_op(")")
+            return self._postfix(
+                A.FunctionCall(name.lower(), tuple(args), distinct=distinct))
+        e: A.Expression = A.Identifier(name)
+        return self._postfix(e)
+
+    def _postfix(self, e: A.Expression) -> A.Expression:
+        while self.at_op(".") and self.peek(1).kind in ("IDENT", "QIDENT"):
+            self.next()
+            e = A.DereferenceExpression(e, A.Identifier(self.identifier()))
+        return e

@@ -1,0 +1,155 @@
+"""End-to-end SQL tests against a SQLite oracle.
+
+Ring-2 of the test strategy (SURVEY.md §4): the full
+parse->plan->optimize->execute path in-process, results checked against an
+independent engine — the role H2 plays for the reference
+(presto-tests/.../H2QueryRunner.java).
+"""
+import datetime
+import math
+import sqlite3
+from decimal import Decimal
+
+import pytest
+
+from presto_tpu.connectors.spi import TableHandle
+from presto_tpu.connectors.tpch import TABLES, TpchConnector, tpch_schema
+from presto_tpu.exec.runner import LocalRunner
+
+from tpch_queries import Q as TPCH_QUERIES
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalRunner(tpch_sf=SF)
+
+
+@pytest.fixture(scope="module")
+def oracle(runner):
+    """SQLite loaded with the same generated TPC-H data."""
+    conn = sqlite3.connect(":memory:")
+    tpch = runner.session.catalogs.get("tpch")
+    for t in TABLES:
+        schema = tpch_schema(t)
+        cols = ", ".join(schema.names)
+        conn.execute(f"create table {t} ({cols})")
+        placeholders = ", ".join("?" * len(schema))
+        th = TableHandle("tpch", "default", t)
+        for split in tpch.split_manager.splits(th, 1):
+            for b in tpch.page_source(split, schema.names).batches():
+                rows = [tuple(_sql_val(v) for v in r) for r in b.to_pylist()]
+                conn.executemany(
+                    f"insert into {t} values ({placeholders})", rows)
+    conn.commit()
+    return conn
+
+
+def _sql_val(v):
+    if hasattr(v, "item"):      # numpy scalar -> python (sqlite stores
+        v = v.item()            # np.int64 as a BLOB otherwise)
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    if isinstance(v, Decimal):
+        return float(v)
+    return v
+
+
+def _norm(rows, has_order):
+    out = []
+    for r in rows:
+        nr = []
+        for v in r:
+            v = _sql_val(v)
+            if isinstance(v, float):
+                v = round(v, 4)
+            if hasattr(v, "item"):
+                v = v.item()
+                if isinstance(v, float):
+                    v = round(v, 4)
+            nr.append(v)
+        out.append(tuple(nr))
+    return out if has_order else sorted(out, key=repr)
+
+
+def compare(runner, oracle, sql, oracle_sql=None, rel=1e-9):
+    got = runner.execute(sql)
+    want = oracle.execute(oracle_sql or sql).fetchall()
+    has_order = "order by" in sql.lower()
+    g = _norm(got.rows, has_order)
+    w = _norm(want, has_order)
+    assert len(g) == len(w), f"{len(g)} rows vs oracle {len(w)}"
+    for gr, wr in zip(g, w):
+        assert len(gr) == len(wr)
+        for gv, wv in zip(gr, wr):
+            if isinstance(gv, float) and isinstance(wv, (int, float)):
+                assert gv == pytest.approx(wv, rel=rel, abs=1e-9), (gr, wr)
+            else:
+                assert gv == wv, (gr, wr)
+
+
+@pytest.mark.parametrize(
+    "name,sql,oracle_sql", TPCH_QUERIES, ids=[t[0] for t in TPCH_QUERIES])
+def test_tpch(runner, oracle, name, sql, oracle_sql):
+    compare(runner, oracle, sql, oracle_sql, rel=1e-6)
+
+
+# -- generic SQL feature coverage (AbstractTestQueries role) -----------------
+
+FEATURES = [
+    "select 1 + 2 * 3 as x",
+    "select count(*) from orders",
+    "select count(o_orderkey), min(o_totalprice), max(o_totalprice) from orders",
+    "select o_orderstatus, count(*) from orders group by o_orderstatus order by 1",
+    "select * from region order by r_regionkey",
+    "select r.r_name, n.n_name from region r join nation n on n.n_regionkey = r.r_regionkey order by 1, 2",
+    "select n_name from nation where n_regionkey in (1, 2) order by n_name",
+    "select n_name from nation where n_name like 'A%' order by 1",
+    "select n_name from nation where n_name not like '%A%' order by 1",
+    "select o_orderkey from orders where o_orderkey between 5 and 10 order by 1",
+    "select coalesce(null, 42) as x",
+    "select nullif(1, 1) as a, nullif(1, 2) as b",
+    "select abs(-5) a, length('hello') b, upper('abc') c, substr('hello', 2, 3) d",
+    "select case o_orderstatus when 'F' then 'f' when 'O' then 'o' else 'x' end s, count(*) from orders group by 1 order by 1",
+    "select cast(floor(o_totalprice) as integer) from orders order by o_orderkey limit 5",
+    "select distinct c_mktsegment from customer order by 1",
+    "select c_mktsegment, count(*) c from customer group by c_mktsegment having count(*) > 10 order by c",
+    "select s_name from supplier where s_suppkey in (select ps_suppkey from partsupp where ps_availqty > 9990) order by 1",
+    "select count(*) from orders where o_custkey not in (select c_custkey from customer where c_mktsegment = 'BUILDING')",
+    "select n_name from nation union select r_name from region order by 1",
+    "select n_regionkey from nation union all select r_regionkey from region order by 1 limit 5",
+    "select o_orderpriority, sum(o_totalprice) from orders group by o_orderpriority order by 2 desc limit 3",
+    "select count(*) from lineitem where l_shipdate is not null",
+    "select o_orderkey, o_totalprice from orders order by o_totalprice desc limit 7",
+    "select o_orderdate, count(*) from orders where o_orderdate < date '1992-03-01' group by o_orderdate order by 1",
+    "select count(*) from (select o_custkey k from orders where o_totalprice > 200000) t join customer on c_custkey = k",
+    "select max(o_orderdate) from orders",
+    "select s_name, n_name from supplier left join nation on s_nationkey = n_nationkey and n_regionkey = 0 order by s_name limit 5",
+]
+
+
+@pytest.mark.parametrize("sql", FEATURES, ids=range(len(FEATURES)))
+def test_features(runner, oracle, sql):
+    osql = sql.replace("date '", "'")     # sqlite: ISO strings compare fine
+    compare(runner, oracle, sql, osql)
+
+
+def test_explain_and_session(runner):
+    res = runner.execute("explain select count(*) from orders")
+    assert any("Aggregate" in r[0] for r in res.rows)
+    runner.execute("set session broadcast_join_row_limit = 10")
+    assert runner.session.properties["broadcast_join_row_limit"] == 10
+    runner.execute("reset session broadcast_join_row_limit")
+    assert "broadcast_join_row_limit" not in runner.session.properties
+    res = runner.execute("show tables")
+    assert ("lineitem",) in res.rows
+
+
+def test_date_semantics(runner, oracle):
+    compare(
+        runner, oracle,
+        "select extract(year from o_orderdate) y, count(*) c from orders "
+        "group by 1 order by 1",
+        "select cast(substr(o_orderdate,1,4) as integer) y, count(*) c "
+        "from orders group by 1 order by 1")
