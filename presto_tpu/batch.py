@@ -270,14 +270,17 @@ class Batch:
         return [tuple(r) for r in zip(*out_cols)] if out_cols else []
 
     # -- transforms ---------------------------------------------------------
-    def compact(self, capacity: Optional[int] = None) -> "Batch":
+    def compact(self, capacity: Optional[int] = None, *,
+                check: bool = True) -> "Batch":
         """Gather live rows to the front (device-side, static output shape).
 
         ``capacity`` smaller than the live-row count would silently drop rows;
         callers shrinking buckets must check ``host_count()`` first, so guard.
+        Pass ``check=False`` from traced (jit/shard_map) contexts where the
+        bound is guaranteed by construction — the guard needs a host sync.
         """
         cap = capacity or self.capacity
-        if capacity is not None and capacity < self.capacity:
+        if check and capacity is not None and capacity < self.capacity:
             live = self.host_count()
             if live > capacity:
                 raise ValueError(

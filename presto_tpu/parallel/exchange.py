@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 
 from ..batch import Batch, Column
-from ..ops.join import _join_key
 
 
 def _splitmix64(x: jnp.ndarray) -> jnp.ndarray:
@@ -40,10 +39,23 @@ def _splitmix64(x: jnp.ndarray) -> jnp.ndarray:
 
 def hash_partition_ids(batch: Batch, key_cols: Sequence[int],
                        n_partitions: int) -> jnp.ndarray:
-    """Partition id per row in [0, n). NULL keys all hash the null-storage
-    sentinel, so they colocate on one (arbitrary) partition."""
-    key, _valid = _join_key(batch, key_cols)
-    h = _splitmix64(key)
+    """Partition id per row in [0, n), mixing any number of key columns.
+
+    Placement only needs equal-tuple -> equal-shard, so columns fold into
+    one splitmix chain (validity folds in too: NULL and sentinel-valued
+    keys may share a shard, which is harmless for colocation).
+    """
+    h = jnp.zeros(batch.capacity, dtype=jnp.uint64)
+    for ci in key_cols:
+        c = batch.columns[ci]
+        data = c.data
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            # value-deterministic int image (collisions only co-locate)
+            data = (data * 65536.0).astype(jnp.int64)
+        h = _splitmix64(h ^ data.astype(jnp.uint64)
+                        ^ (c.validity.astype(jnp.uint64) << jnp.uint64(63)))
     return (h % jnp.uint64(n_partitions)).astype(jnp.int32)
 
 

@@ -256,7 +256,7 @@ def _plan_join_graph(join: JoinNode, extra_preds: List[ir.Expr],
         right_pos = [offsets[i] + k for k in range(len(leaves[i].fields))]
         lmap = {g: k for k, g in enumerate(cur_pos)}
         rmap = {g: k for k, g in enumerate(right_pos)}
-        lkeys, rkeys, key_casts = [], [], []
+        lkeys, rkeys = [], []
         for (a, b) in pairs:
             ia, ib = _col_index(a), _col_index(b)
             lkeys.append(lmap[ia])
@@ -304,9 +304,18 @@ def _field_at(leaves, offsets, g: int) -> Field:
 
 
 def _is_col(e: ir.Expr) -> bool:
+    """Join-key edge endpoint: a raw column, or a cast the join kernel can
+    drop safely. _join_key compares keys in the int64 domain, so an
+    int-stored widening cast (integral->integral, date->integral) is
+    value-exact without the cast; decimal rescales and float casts are NOT
+    and must stay residual filters."""
     if isinstance(e, ir.InputRef):
         return True
-    return isinstance(e, ir.Cast) and isinstance(e.arg, ir.InputRef)
+    if isinstance(e, ir.Cast) and isinstance(e.arg, ir.InputRef):
+        src, dst = e.arg.type, e.type
+        int_stored = lambda t: T.is_integral(t) or isinstance(t, T.DateType)
+        return int_stored(src) and int_stored(dst)
+    return False
 
 
 def _col_index(e: ir.Expr) -> int:

@@ -170,10 +170,12 @@ class _Planner:
         if rel.join_type == "full":
             raise AnalysisError("FULL OUTER JOIN is not supported yet")
         join_type = rel.join_type
+        swapped = False
         if join_type == "right":
             left, right = right, left
             combined = left.fields + right.fields
             join_type = "left"
+            swapped = True
         scope = Scope(combined)
         analyzer = ExpressionAnalyzer(scope)
         cond = analyzer.analyze(rel.condition) if rel.condition is not None \
@@ -203,12 +205,21 @@ class _Planner:
                 right = FilterNode(child=right,
                                    predicate=combine_conjuncts(right_only))
             residual = combine_conjuncts(rest)
-        # RIGHT was swapped above; for the swapped case key sides were
-        # extracted against the swapped order already (scope built after swap)
-        return JoinNode(
+        # RIGHT was swapped above (key sides were extracted against the
+        # swapped order, since the scope was built after the swap); restore
+        # the WRITTEN column order for parents per SQL semantics
+        node: PlanNode = JoinNode(
             join_type=join_type, left=left, right=right,
             left_keys=tuple(left_keys), right_keys=tuple(right_keys),
             fields=combined, residual=residual)
+        if swapped:
+            n_probe = len(left.fields)
+            order = list(range(n_probe, len(combined))) + list(range(n_probe))
+            node = ProjectNode(
+                child=node,
+                exprs=tuple(ir.input_ref(i, combined[i].type) for i in order),
+                fields=tuple(combined[i] for i in order))
+        return node
 
     # -- SELECT decomposition -----------------------------------------------
     def plan_query_spec(self, spec: A.QuerySpecification) -> PlanNode:
@@ -506,13 +517,15 @@ class _Planner:
         for it in items:
             if isinstance(it.value, A.Star):
                 q = it.value.qualifier
+                matched = 0
                 for f in scope.fields:
                     if q is None or f.relation == q:
                         ref = (A.Identifier(f.name) if q is None
                                else A.DereferenceExpression(
                                    A.Identifier(q), A.Identifier(f.name)))
                         out.append(A.SelectItem(ref, f.name))
-                if not out:
+                        matched += 1
+                if not matched:
                     raise AnalysisError(f"no columns match {q}.*")
             else:
                 out.append(it)
@@ -591,6 +604,8 @@ def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:
     found: List[A.FunctionCall] = []
 
     def walk(n):
+        if isinstance(n, (A.ScalarSubquery, A.InSubquery, A.Exists)):
+            return  # subquery aggregates belong to the inner query
         if isinstance(n, A.FunctionCall):
             fn = _FUNCTION_ALIASES.get(n.name, n.name)
             if fn in AGGREGATE_FUNCTIONS or n.is_star and fn == "count":

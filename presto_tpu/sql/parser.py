@@ -205,9 +205,15 @@ class _Parser:
         limit = self._limit()
         if order_by or limit is not None:
             import dataclasses as _dc
-            if isinstance(body, A.Query) and not body.with_:
-                body = body.body
-            body = _dc.replace(body, order_by=order_by, limit=limit)
+            if isinstance(body, A.Query):
+                # '(query) ORDER BY ...': order the parenthesized result —
+                # wrap as a subquery so an inner LIMIT/WITH is preserved
+                body = A.QuerySpecification(
+                    select=(A.SelectItem(A.Star()),),
+                    from_=A.SubqueryRelation(body),
+                    order_by=order_by, limit=limit)
+            else:
+                body = _dc.replace(body, order_by=order_by, limit=limit)
         return A.Query(body=body, with_=tuple(with_))
 
     def _set_expr(self) -> A.Node:
@@ -353,8 +359,14 @@ class _Parser:
             alias = self.identifier()
         elif self.peek().kind in ("IDENT", "QIDENT"):
             alias = self.identifier()
-        if alias is not None and self.at_op("(") and False:
-            pass
+        if alias is not None and self.at_op("("):
+            # aliased column list: t(a, b, c)
+            self.next()
+            names = [self.identifier()]
+            while self.accept_op(","):
+                names.append(self.identifier())
+            self.expect_op(")")
+            cols = tuple(names)
         if alias is not None:
             return A.AliasedRelation(rel, alias, cols)
         return rel

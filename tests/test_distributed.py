@@ -1,0 +1,80 @@
+"""Distributed SQL execution on the virtual 8-device mesh vs LocalRunner.
+
+Ring-3 of the test strategy (SURVEY.md §4): same queries, N shards of
+SPMD programs with real collectives, results must match the single-device
+path exactly.
+"""
+import pytest
+
+from presto_tpu.exec.distributed import DistributedRunner
+from presto_tpu.exec.runner import LocalRunner
+
+from tpch_queries import Q as TPCH_QUERIES
+
+SF = 0.01
+
+DIST_QUERIES = [t for t in TPCH_QUERIES
+                if t[0] in ("q1", "q3", "q4", "q5", "q6", "q10", "q12",
+                            "q14", "q18", "q19")]
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalRunner(tpch_sf=SF)
+
+
+@pytest.fixture(scope="module")
+def dist(local):
+    return DistributedRunner(catalogs=local.session.catalogs,
+                             rows_per_batch=1 << 13)
+
+
+def _norm(rows, has_order):
+    out = []
+    for r in rows:
+        nr = []
+        for v in r:
+            if hasattr(v, "item"):
+                v = v.item()
+            if isinstance(v, float):
+                v = round(v, 4)
+            nr.append(v)
+        out.append(tuple(nr))
+    return out if has_order else sorted(out, key=repr)
+
+
+def check(local, dist, sql, rel=1e-9):
+    want = local.execute(sql)
+    got = dist.execute(sql)
+    has_order = "order by" in sql.lower()
+    w = _norm(want.rows, has_order)
+    g = _norm(got.rows, has_order)
+    assert len(g) == len(w), f"{len(g)} rows vs local {len(w)}"
+    for gr, wr in zip(g, w):
+        for gv, wv in zip(gr, wr):
+            if isinstance(gv, float):
+                assert gv == pytest.approx(wv, rel=rel, abs=1e-9), (gr, wr)
+            else:
+                assert gv == wv, (gr, wr)
+
+
+@pytest.mark.parametrize(
+    "name,sql,_o", DIST_QUERIES, ids=[t[0] for t in DIST_QUERIES])
+def test_tpch_distributed(local, dist, name, sql, _o):
+    check(local, dist, sql, rel=1e-6)
+
+
+BASICS = [
+    "select count(*) from lineitem",
+    "select o_orderstatus, count(*), sum(o_totalprice) from orders group by 1 order by 1",
+    "select n_name from nation where n_regionkey = 2 order by 1",
+    "select distinct c_mktsegment from customer order by 1",
+    "select o_orderkey, o_totalprice from orders order by o_totalprice desc limit 5",
+    "select count(*) from orders where o_custkey not in (select c_custkey from customer where c_acctbal < 0)",
+    "select s_name, n_name from supplier left join nation on s_nationkey = n_nationkey order by 1 limit 4",
+]
+
+
+@pytest.mark.parametrize("sql", BASICS, ids=range(len(BASICS)))
+def test_basics_distributed(local, dist, sql):
+    check(local, dist, sql)
