@@ -38,7 +38,9 @@ from ..batch import Batch, Column, Schema, bucket_capacity, concat_batches
 from ..expr import ir
 from ..expr.compiler import compile_filter, compile_projection
 from ..ops.aggregation import AggSpec, global_aggregate, grouped_aggregate
-from ..ops.join import lookup_join, semi_join_mask
+from ..ops.join import (
+    expand_join, lookup_join, match_count_max, semi_join_mask,
+)
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
 from ..parallel.exchange import repartition_by_hash
 from ..parallel.mesh import make_mesh
@@ -289,37 +291,53 @@ class DistributedExecutor(_Executor):
             return
 
         lkeys, rkeys = list(node.left_keys), list(node.right_keys)
-        if node.distribution == "replicated":
+        replicated = node.distribution == "replicated"
+        if replicated:
             # FIXED_BROADCAST: build side replicated to every shard
-            build_host = _to_host(build)
-            build_rep = self._replicate(build_host)
-
-            def local_join(probe_l: Batch, build_l: Batch) -> Batch:
-                out = lookup_join(probe_l, build_l, lkeys, rkeys,
-                                  payload, payload_names, node.join_type)
-                out = Batch(out_schema, out.columns, out.row_mask)
-                return residual_fn(out) if residual_fn else out
-
-            join_fn = self._smap(local_join, 2, replicated_in=(1,))
-            for probe in self.run(node.left):
-                yield join_fn(probe, build_rep)
+            build_side = self._replicate(_to_host(build))
         else:
-            # FIXED_HASH: both sides repartitioned by join key over ICI
+            # FIXED_HASH: build repartitioned by join key over ICI once
             repart_build = self._smap(
                 lambda b: repartition_by_hash(b, rkeys, self.axis, self.n), 1)
-            build_parted = repart_build(build)
+            build_side = repart_build(build)
 
-            def local_join_p(probe_l: Batch, build_l: Batch) -> Batch:
+        def local_probe(probe_l: Batch, build_l: Batch,
+                        maxk: int) -> Batch:
+            if not replicated:
                 probe_l = repartition_by_hash(probe_l, lkeys, self.axis,
                                               self.n)
+            if node.build_unique:
                 out = lookup_join(probe_l, build_l, lkeys, rkeys,
                                   payload, payload_names, node.join_type)
-                out = Batch(out_schema, out.columns, out.row_mask)
-                return residual_fn(out) if residual_fn else out
+            else:
+                out = expand_join(probe_l, build_l, lkeys, rkeys,
+                                  payload, payload_names, node.join_type,
+                                  max_matches=maxk)
+            out = Batch(out_schema, out.columns, out.row_mask)
+            return residual_fn(out) if residual_fn else out
 
-            join_fn = self._smap(local_join_p, 2)
-            for probe in self.run(node.left):
-                yield join_fn(probe, build_parted)
+        count_fn = None
+        if not node.build_unique:
+            def local_count(p: Batch, b: Batch) -> jnp.ndarray:
+                if not replicated:
+                    p = repartition_by_hash(p, lkeys, self.axis, self.n)
+                return match_count_max(p, b, lkeys, rkeys)[None]
+            count_fn = self._smap(local_count, 2,
+                                  replicated_in=(1,) if replicated else ())
+
+        join_fns: Dict[int, object] = {}
+        for probe in self.run(node.left):
+            maxk = 1
+            if count_fn is not None:
+                maxk = bucket_capacity(
+                    max(int(np.asarray(count_fn(probe, build_side)).max()),
+                        1), minimum=1)
+            fn = join_fns.get(maxk)
+            if fn is None:
+                fn = join_fns[maxk] = self._smap(
+                    lambda p, b, _k=maxk: local_probe(p, b, _k), 2,
+                    replicated_in=(1,) if replicated else ())
+            yield fn(probe, build_side)
 
     def _SemiJoinNode(self, node: SemiJoinNode) -> Iterator[Batch]:
         build = self._drain(node.filtering)
@@ -364,6 +382,31 @@ class DistributedExecutor(_Executor):
             state = top_n(merged, keys, node.count).compact(cap)
         if state is not None:
             yield sort_batch(state, keys)
+
+    def _WindowNode(self, node) -> Iterator[Batch]:
+        from ..ops.window import WindowSpec, evaluate_window
+        b = self._drain(node.child)
+        if b is None:
+            return
+        specs = [WindowSpec(f.fn, f.args, f.output_type, f.name, f.offset,
+                            f.ignore_order) for f in node.functions]
+        keys = [SortKey(k.index, k.ascending, k.nulls_first)
+                for k in node.order_keys]
+        parts = list(node.partition_indices)
+        schema = _plan_schema(node)
+        if parts:
+            # colocate partitions via hash exchange, evaluate shard-locally
+            fn = self._smap(
+                lambda x: evaluate_window(
+                    repartition_by_hash(x, parts, self.axis, self.n),
+                    parts, keys, specs), 1)
+            out = fn(b)
+        else:
+            # single global partition: evaluate on the gathered batch,
+            # re-shard so downstream exchanges see mesh-divisible capacity
+            out = self._pad_shardable(
+                evaluate_window(_to_host(b), parts, keys, specs))
+        yield Batch(schema, out.columns, out.row_mask)
 
     def _DistinctNode(self, node: DistinctNode) -> Iterator[Batch]:
         b = self._drain(node.child)

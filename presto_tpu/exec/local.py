@@ -26,7 +26,9 @@ from ..expr import ir
 from ..expr.compiler import compile_filter, compile_projection
 from ..expr.rewrite import rewrite as ir_rewrite
 from ..ops.aggregation import AggSpec, global_aggregate, grouped_aggregate
-from ..ops.join import lookup_join, semi_join_mask
+from ..ops.join import (
+    expand_join, lookup_join, match_count_max, semi_join_mask,
+)
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
 from ..planner.plan import (
     AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
@@ -163,6 +165,18 @@ class _Executor:
         if state is not None:
             yield sort_batch(state, keys)
 
+    def _WindowNode(self, node) -> Iterator[Batch]:
+        from ..ops.window import WindowSpec, evaluate_window
+        b = self._drain(node.child)
+        if b is None:
+            return
+        specs = [WindowSpec(f.fn, f.args, f.output_type, f.name, f.offset,
+                            f.ignore_order) for f in node.functions]
+        keys = [SortKey(k.index, k.ascending, k.nulls_first)
+                for k in node.order_keys]
+        out = evaluate_window(b, list(node.partition_indices), keys, specs)
+        yield Batch(_plan_schema(node), out.columns, out.row_mask)
+
     def _DistinctNode(self, node: DistinctNode) -> Iterator[Batch]:
         b = self._drain(node.child)
         if b is None:
@@ -230,11 +244,7 @@ class _Executor:
                     continue
                 out = self._null_extend(probe, node)
             else:
-                out = lookup_join(
-                    probe, build, list(node.left_keys),
-                    list(node.right_keys), payload, payload_names,
-                    node.join_type)
-                out = Batch(_plan_schema(node), out.columns, out.row_mask)
+                out = self._probe(node, probe, build, payload, payload_names)
             if residual_fn is not None:
                 if node.join_type == "left":
                     # residual on a left join only filters matched rows'
@@ -244,6 +254,24 @@ class _Executor:
                         "residual predicate on LEFT JOIN")
                 out = residual_fn(out)
             yield out
+
+    def _probe(self, node: JoinNode, probe: Batch, build: Batch,
+               payload, payload_names) -> Batch:
+        """One probe batch against the finished build side: unique-key fast
+        path, or capacity-expanded many-to-many (reference JoinProbe fast
+        path vs PositionLinks chains)."""
+        if node.build_unique:
+            out = lookup_join(
+                probe, build, list(node.left_keys), list(node.right_keys),
+                payload, payload_names, node.join_type)
+        else:
+            maxk = int(match_count_max(
+                probe, build, list(node.left_keys), list(node.right_keys)))
+            out = expand_join(
+                probe, build, list(node.left_keys), list(node.right_keys),
+                payload, payload_names, node.join_type,
+                max_matches=bucket_capacity(max(maxk, 1), minimum=1))
+        return Batch(_plan_schema(node), out.columns, out.row_mask)
 
     def _null_extend(self, probe: Batch, node: JoinNode) -> Batch:
         cols = list(probe.columns)

@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from .. import types as T
+from ..batch import Batch
+from ..connectors.memory import MemoryConnector
 from ..connectors.spi import CatalogManager, TableHandle
 from ..connectors.tpch import TpchConnector
 from ..sql import ast as A
@@ -30,6 +32,7 @@ class LocalRunner:
         if catalogs is None:
             catalogs = CatalogManager()
             catalogs.register("tpch", TpchConnector(sf=tpch_sf))
+            catalogs.register("memory", MemoryConnector())
         self.session = Session(catalogs=catalogs, catalog=catalog,
                                schema=schema)
         self.rows_per_batch = rows_per_batch
@@ -89,8 +92,59 @@ class LocalRunner:
         if isinstance(stmt, A.ResetSession):
             self.session.properties.pop(stmt.name, None)
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.CreateTableAsSelect):
+            return self._ctas(stmt)
+        if isinstance(stmt, A.InsertInto):
+            return self._insert(stmt)
+        if isinstance(stmt, A.DropTable):
+            conn, table = self._writable(stmt.name)
+            conn.drop_table(table, if_exists=stmt.if_exists)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
         raise NotImplementedError(
             f"statement {type(stmt).__name__} is not supported yet")
+
+    # -- write path (reference TableWriterOperator + finishInsert) ----------
+    def _writable(self, name):
+        catalog = self.session.catalog if len(name) < 3 else name[-3]
+        conn = self.session.catalogs.get(catalog)
+        if not hasattr(conn, "create_table"):
+            raise ValueError(f"catalog {catalog!r} is not writable")
+        return conn, name[-1]
+
+    def _run_to_batches(self, query: A.Query):
+        from ..batch import Schema
+        from .local import _Executor, _plan_schema
+        plan = optimize(plan_query(query, self.session), self.session)
+        ex = _Executor(self.session, self.rows_per_batch)
+        init_values = []
+        for p in plan.init_plans:
+            rows = [r for b in ex.run(p) for r in b.to_pylist()]
+            if len(rows) > 1:
+                raise ValueError("scalar subquery returned more than one row")
+            init_values.append(rows[0][0] if rows else None)
+        ex.init_values = init_values
+        root = plan.root
+        schema = Schema([(f.name, f.type) for f in root.fields])
+        return schema, ex.run(root.child)
+
+    def _ctas(self, stmt: A.CreateTableAsSelect) -> QueryResult:
+        conn, table = self._writable(stmt.name)
+        schema, batches = self._run_to_batches(stmt.query)
+        if table in conn.tables and stmt.if_not_exists:
+            return QueryResult(["rows"], [T.BIGINT], [(0,)])
+        conn.create_table(table, schema, if_not_exists=stmt.if_not_exists)
+        n = 0
+        for b in batches:
+            n += conn.append(table, Batch(schema, b.columns, b.row_mask))
+        return QueryResult(["rows"], [T.BIGINT], [(n,)])
+
+    def _insert(self, stmt: A.InsertInto) -> QueryResult:
+        conn, table = self._writable(stmt.name)
+        schema, batches = self._run_to_batches(stmt.query)
+        n = 0
+        for b in batches:
+            n += conn.append(table, Batch(schema, b.columns, b.row_mask))
+        return QueryResult(["rows"], [T.BIGINT], [(n,)])
 
 
 def _literal_value(e: A.Expression):

@@ -99,6 +99,87 @@ def lookup_join(
     return Batch(Schema(out_fields), out_cols, mask)
 
 
+def match_count_max(
+    probe: Batch, build: Batch,
+    probe_keys: Sequence[int], build_keys: Sequence[int],
+) -> jnp.ndarray:
+    """Max build matches for any live probe key (device scalar).
+
+    The host syncs this once per (probe, build) pair to pick the static
+    expansion factor for ``expand_join`` — the capacity analogue of
+    Presto's PositionLinks chain length (reference operator/
+    ArrayPositionLinks.java).
+    """
+    skey, slive, _ = build_sorted(build, build_keys)
+    pkey, pvalid = _join_key(probe, probe_keys)
+    live = probe.row_mask & pvalid
+    lo = jnp.searchsorted(skey, pkey, side="left")
+    hi = jnp.searchsorted(skey, pkey, side="right")
+    # slive is sorted live-first within equal keys (dead rows pushed to the
+    # int64-max sentinel), so [lo, hi) spans only live matches
+    cnt = jnp.where(live, hi - lo, 0)
+    return jnp.max(cnt) if cnt.shape[0] else jnp.asarray(0)
+
+
+def expand_join(
+    probe: Batch,
+    build: Batch,
+    probe_keys: Sequence[int],
+    build_keys: Sequence[int],
+    payload: Sequence[int],
+    payload_names: Sequence[str],
+    join_type: str = "inner",
+    max_matches: int = 1,
+) -> Batch:
+    """Many-to-many equi-join with static expansion factor.
+
+    Output capacity = probe capacity * max_matches: slot k of probe row i
+    holds its k-th match (masked off past the row's match count). The
+    caller obtains ``max_matches`` from ``match_count_max`` (bucketed, so
+    kernels recompile only when the multiplicity crosses a power of two).
+    Left joins keep unmatched probe rows in slot 0 with null payload.
+    """
+    assert join_type in ("inner", "left")
+    k = max(1, max_matches)
+    skey, slive, perm = build_sorted(build, build_keys)
+    pkey, pvalid = _join_key(probe, probe_keys)
+    live = probe.row_mask & pvalid
+    lo = jnp.searchsorted(skey, pkey, side="left")
+    hi = jnp.searchsorted(skey, pkey, side="right")
+    cnt = jnp.where(live, hi - lo, 0)
+
+    # [k, C] grids -> flattened [k*C] output (probe-major within slots)
+    slot = jnp.arange(k)[:, None]                      # [k, 1]
+    pos = jnp.minimum(lo[None, :] + slot, skey.shape[0] - 1)
+    # slive guards the sentinel edge (a probe key equal to int64-max would
+    # otherwise "match" dead build rows)
+    matched = (slot < cnt[None, :]) & jnp.take(slive, pos, axis=0)  # [k, C]
+
+    out_fields = list(zip(probe.schema.names, probe.schema.types))
+    out_cols: List[Column] = []
+    for c in probe.columns:
+        data = jnp.broadcast_to(c.data[None, :], (k,) + c.data.shape)
+        valid = jnp.broadcast_to(c.validity[None, :], (k,) + c.validity.shape)
+        out_cols.append(Column(c.type, data.reshape(-1), valid.reshape(-1),
+                               c.dictionary))
+    for ci, name in zip(payload, payload_names):
+        c = build.columns[ci]
+        sdata = jnp.take(c.data, perm, axis=0)
+        svalid = jnp.take(c.validity, perm, axis=0)
+        gdata = jnp.take(sdata, pos, axis=0)           # [k, C]
+        gvalid = jnp.take(svalid, pos, axis=0) & matched
+        out_fields.append((name, c.type))
+        out_cols.append(Column(c.type, gdata.reshape(-1), gvalid.reshape(-1),
+                               c.dictionary))
+    if join_type == "inner":
+        mask = matched
+    else:
+        # unmatched probe rows survive in slot 0 with null payload
+        first_slot = (slot == 0) & (cnt[None, :] == 0) & probe.row_mask
+        mask = matched | first_slot
+    return Batch(Schema(out_fields), out_cols, mask.reshape(-1))
+
+
 def semi_join_mask(
     probe: Batch,
     build: Batch,

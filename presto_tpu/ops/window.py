@@ -1,0 +1,262 @@
+"""Window function kernels.
+
+The TPU-native replacement for Presto's window machinery (reference
+presto-main/.../operator/WindowOperator.java sorts via PagesIndex, then
+WindowPartition evaluates functions per partition; built-ins in
+operator/window/). Here the whole batch is sorted once by
+(partition keys, order keys) with every payload column riding along, and
+per-row values come from branch-free cumulative/segment ops:
+
+- partition boundaries -> segment ids (like the group-by kernel);
+- peer runs (equal order keys within a partition) for RANGE-frame
+  semantics: ranking ties and running aggregates include full peer runs;
+- running aggregates = cumsum over peer-run ends minus the partition base.
+
+Rows are returned in (partition, order) order — a valid SQL result order;
+the planner's own ORDER BY, if any, sorts afterwards.
+
+Default frame only (RANGE UNBOUNDED PRECEDING..CURRENT ROW) — explicit
+frames are a follow-up, mirroring FrameInfo.java.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import Batch, Column, Schema
+from ..types import Type
+from .sort import SortKey, _sortable
+
+RANKING = ("row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
+           "ntile")
+VALUE_FNS = ("first_value", "last_value", "lag", "lead", "nth_value")
+AGG_FNS = ("sum", "count", "avg", "min", "max", "count_star")
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    """One window function application over shared partition/order keys."""
+
+    fn: str
+    args: Tuple[int, ...]          # input column indices
+    output_type: Type
+    name: str
+    offset: int = 1                # lag/lead offset; ntile buckets
+    ignore_order: bool = False     # aggregate without ORDER BY: whole part.
+
+
+def _cummax_int(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.associative_scan(jnp.maximum, x)
+
+
+def _reverse_cummin_int(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.associative_scan(jnp.minimum, x, reverse=True)
+
+
+def evaluate_window(
+    batch: Batch,
+    partition_by: Sequence[int],
+    order_by: Sequence[SortKey],
+    specs: Sequence[WindowSpec],
+) -> Batch:
+    """Append one output column per spec; rows re-ordered by
+    (partition, order, original position)."""
+    cap = batch.capacity
+    # ---- global sort: dead rows last, then partition keys, then order keys
+    dead = jnp.where(batch.row_mask, 0, 1).astype(jnp.int32)
+    operands: List[jnp.ndarray] = [dead]
+    for pi in partition_by:
+        c = batch.columns[pi]
+        operands.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))
+        d = c.data
+        operands.append(d.astype(jnp.int32) if d.dtype == jnp.bool_ else d)
+    n_part_ops = len(operands)
+    for k in order_by:
+        operands.extend(_sortable(batch.columns[k.column], k))
+    n_ops = len(operands)
+    payload: List[jnp.ndarray] = [batch.row_mask,
+                                  jnp.arange(cap, dtype=jnp.int32)]
+    for c in batch.columns:
+        payload.append(c.data)
+        payload.append(c.validity)
+    out = jax.lax.sort(operands + payload, num_keys=n_ops, is_stable=True)
+    s_ops = out[:n_ops]
+    mask = out[n_ops]
+    s_cols = out[n_ops + 2:]
+
+    idx = jnp.arange(cap, dtype=jnp.int64)
+
+    # ---- partition boundaries and per-partition segment base
+    pboundary = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for op in s_ops[1:n_part_ops]:
+        pboundary = pboundary | (op != jnp.roll(op, 1))
+    pboundary = pboundary.at[0].set(True)
+    pstart = _cummax_int(jnp.where(pboundary, idx, -1))          # seg start
+    # partition end (last live row of the partition)
+    live_n = jnp.sum(mask.astype(jnp.int64))
+    nxt_start = _reverse_cummin_int(
+        jnp.where(jnp.roll(pboundary, -1).at[-1].set(True),
+                  idx + 1, jnp.iinfo(jnp.int64).max))
+    pend = jnp.minimum(nxt_start, live_n) - 1                     # inclusive
+    psize = jnp.maximum(pend - pstart + 1, 1)
+
+    # ---- peer runs (order-key ties)
+    oboundary = pboundary
+    for op in s_ops[n_part_ops:]:
+        oboundary = oboundary | (op != jnp.roll(op, 1))
+    oboundary = oboundary.at[0].set(True)
+    ostart = _cummax_int(jnp.where(oboundary, idx, -1))
+    onext = _reverse_cummin_int(
+        jnp.where(jnp.roll(oboundary, -1).at[-1].set(True),
+                  idx + 1, jnp.iinfo(jnp.int64).max))
+    oend = jnp.minimum(onext, live_n) - 1                         # inclusive
+
+    row_in_part = idx - pstart                                    # 0-based
+    dense = jnp.cumsum(oboundary.astype(jnp.int64))               # global
+    dense_at_pstart = jnp.take(dense, jnp.maximum(pstart, 0))
+
+    new_cols: List[Column] = []
+    fields: List[Tuple[str, Type]] = []
+    for i, c in enumerate(batch.columns):
+        fields.append((batch.schema.names[i], batch.schema.types[i]))
+        new_cols.append(Column(c.type, s_cols[2 * i], s_cols[2 * i + 1],
+                               c.dictionary))
+
+    for spec in specs:
+        data, valid = _one_window(
+            spec, s_cols, batch, mask, idx, pstart, pend, psize,
+            row_in_part, ostart, oend, dense, dense_at_pstart)
+        fields.append((spec.name, spec.output_type))
+        new_cols.append(Column(spec.output_type,
+                               data.astype(spec.output_type.storage_dtype),
+                               valid & mask, None))
+    return Batch(Schema(fields), new_cols, mask)
+
+
+def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
+                row_in_part, ostart, oend, dense, dense_at_pstart):
+    fn = spec.fn
+    cap = mask.shape[0]
+    if fn == "row_number":
+        return row_in_part + 1, jnp.ones(cap, dtype=bool)
+    if fn == "rank":
+        return ostart - pstart + 1, jnp.ones(cap, dtype=bool)
+    if fn == "dense_rank":
+        return dense - dense_at_pstart + 1, jnp.ones(cap, dtype=bool)
+    if fn == "percent_rank":
+        r = (ostart - pstart).astype(jnp.float64)
+        den = jnp.maximum(psize - 1, 1).astype(jnp.float64)
+        return jnp.where(psize > 1, r / den, 0.0), jnp.ones(cap, dtype=bool)
+    if fn == "cume_dist":
+        covered = (oend - pstart + 1).astype(jnp.float64)
+        return covered / psize.astype(jnp.float64), jnp.ones(cap, dtype=bool)
+    if fn == "ntile":
+        n = jnp.int64(spec.offset)
+        size, rem = psize // n, psize % n
+        big = (size + 1) * rem
+        bucket = jnp.where(
+            row_in_part < big,
+            row_in_part // jnp.maximum(size + 1, 1),
+            rem + (row_in_part - big) // jnp.maximum(size, 1))
+        return bucket + 1, jnp.ones(cap, dtype=bool)
+
+    def col(j):
+        return s_cols[2 * j], s_cols[2 * j + 1]
+
+    if fn in ("lag", "lead"):
+        data, valid = col(spec.args[0])
+        off = spec.offset if fn == "lag" else -spec.offset
+        src = idx - off
+        in_part = (src >= pstart) & (src <= pend)
+        src = jnp.clip(src, 0, cap - 1)
+        return (jnp.take(data, src, axis=0),
+                jnp.take(valid, src, axis=0) & in_part)
+    if fn == "first_value":
+        data, valid = col(spec.args[0])
+        src = jnp.maximum(pstart, 0)
+        return jnp.take(data, src, axis=0), jnp.take(valid, src, axis=0)
+    if fn == "last_value":
+        # default frame ends at the current row's last PEER
+        data, valid = col(spec.args[0])
+        src = jnp.clip(oend, 0, cap - 1)
+        return jnp.take(data, src, axis=0), jnp.take(valid, src, axis=0)
+    if fn == "nth_value":
+        data, valid = col(spec.args[0])
+        src = pstart + spec.offset - 1
+        ok = src <= jnp.minimum(oend, pend)
+        src = jnp.clip(src, 0, cap - 1)
+        return jnp.take(data, src, axis=0), jnp.take(valid, src, axis=0) & ok
+
+    # ---- aggregates over the default frame --------------------------------
+    if fn == "count_star":
+        contrib = mask.astype(jnp.int64)
+        valid_in = mask
+        data = contrib
+    else:
+        data, valid_in = col(spec.args[0])
+        valid_in = valid_in & mask
+    acc_dtype = spec.output_type.storage_dtype
+    if fn in ("count", "count_star"):
+        x = valid_in.astype(jnp.int64)
+        zero = jnp.int64(0)
+    else:
+        x = jnp.where(valid_in, data.astype(acc_dtype)
+                      if fn != "avg" else data.astype(jnp.float64), 0)
+        zero = jnp.zeros((), dtype=x.dtype)
+    if fn in ("min", "max"):
+        big = jnp.iinfo(acc_dtype).max if jnp.issubdtype(acc_dtype, jnp.integer) \
+            else jnp.asarray(jnp.inf, acc_dtype)
+        small = jnp.iinfo(acc_dtype).min if jnp.issubdtype(acc_dtype, jnp.integer) \
+            else jnp.asarray(-jnp.inf, acc_dtype)
+        sent = big if fn == "min" else small
+        op = jnp.minimum if fn == "min" else jnp.maximum
+        xm = jnp.where(valid_in, data.astype(acc_dtype), sent)
+        if spec.ignore_order:
+            # whole partition: segmented reduce via sort-order scan
+            run = _segment_scan(xm, pstart, op)
+            val = jnp.take(run, jnp.clip(pend, 0, cap - 1), axis=0)
+        else:
+            run = _segment_scan(xm, pstart, op)
+            val = jnp.take(run, jnp.clip(oend, 0, cap - 1), axis=0)
+        cnt = _running_count(valid_in, pstart, oend, pend, spec.ignore_order)
+        return val, cnt > 0
+    # sum / count / avg
+    csum = jnp.cumsum(x)
+    base = jnp.where(pstart > 0,
+                     jnp.take(csum, jnp.maximum(pstart - 1, 0), axis=0), zero)
+    upto = jnp.clip(pend if spec.ignore_order else oend, 0, cap - 1)
+    val = jnp.take(csum, upto, axis=0) - base
+    cnt = _running_count(valid_in, pstart, oend, pend, spec.ignore_order)
+    if fn in ("count", "count_star"):
+        return val, jnp.ones(cap, dtype=bool)
+    if fn == "avg":
+        return val / jnp.maximum(cnt, 1).astype(jnp.float64), cnt > 0
+    return val, cnt > 0
+
+
+def _running_count(valid_in, pstart, oend, pend, whole_partition):
+    cap = valid_in.shape[0]
+    csum = jnp.cumsum(valid_in.astype(jnp.int64))
+    base = jnp.where(pstart > 0,
+                     jnp.take(csum, jnp.maximum(pstart - 1, 0), axis=0), 0)
+    upto = jnp.clip(pend if whole_partition else oend, 0, cap - 1)
+    return jnp.take(csum, upto, axis=0) - base
+
+
+def _segment_scan(x, pstart, op):
+    """Inclusive running-op within segments: reset at segment starts."""
+    idx = jnp.arange(x.shape[0], dtype=jnp.int64)
+
+    def combine(a, b):
+        (sa, va) = a
+        (sb, vb) = b
+        # b's segment start wins if it started later
+        s = jnp.maximum(sa, sb)
+        v = jnp.where(sb > sa, vb, op(va, vb))
+        return (s, v)
+    _, out = jax.lax.associative_scan(combine, (pstart, x))
+    return out

@@ -543,12 +543,10 @@ def _implement_joins(node: PlanNode, session: Session) -> PlanNode:
         elif left_unique:
             swap = True
         elif not right_unique:
-            raise ValueError(
-                "many-to-many join (no unique key side) is not supported yet")
-    else:  # left outer: probe must stay on the left
-        if not right_unique:
-            raise ValueError(
-                "left join with non-unique build side is not supported yet")
+            # many-to-many: expansion join; build on the smaller side
+            swap = lrows < rrows
+    # left outer: probe must stay on the left (expansion join handles a
+    # non-unique build side)
     if swap:
         n_left, n_right = len(node.left.fields), len(node.right.fields)
         # old global index -> index in the swapped join's output

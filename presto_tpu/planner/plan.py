@@ -164,6 +164,33 @@ class SemiJoinNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowFnSpec:
+    """One window function over the node's shared window
+    (reference plan/WindowNode.Function)."""
+
+    fn: str
+    args: Tuple[int, ...]          # child column indices
+    output_type: T.Type
+    name: str
+    offset: int = 1                # lag/lead/ntile/nth_value parameter
+    ignore_order: bool = False
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class WindowNode(PlanNode):
+    """Window evaluation (reference plan/WindowNode.java). Output =
+    child fields + one column per function; rows re-ordered by
+    (partition, order)."""
+
+    child: PlanNode
+    partition_indices: Tuple[int, ...]
+    order_keys: Tuple["SortKeySpec", ...]
+    functions: Tuple[WindowFnSpec, ...]
+    fields: Tuple[Field, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class SortKeySpec:
     index: int
     ascending: bool = True

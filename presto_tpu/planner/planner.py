@@ -248,6 +248,8 @@ class _Planner:
             [it.value for it in select_items]
             + ([spec.having] if spec.having else [])
             + [s.key for s in spec.order_by])
+        window_calls = _collect_windows(
+            [it.value for it in select_items] + [s.key for s in spec.order_by])
 
         if agg_calls or spec.group_by:
             node, replacements = self._plan_aggregation(
@@ -255,6 +257,14 @@ class _Planner:
             scope = Scope(node.fields)
         else:
             replacements = {}
+        if window_calls:
+            if agg_calls or spec.group_by:
+                raise AnalysisError(
+                    "window functions over aggregated queries are not "
+                    "supported yet")
+            node, win_repl = self._plan_windows(node, scope, window_calls)
+            scope = Scope(node.fields)
+            replacements.update(win_repl)
 
         # HAVING (after aggregation)
         if spec.having is not None:
@@ -439,6 +449,107 @@ class _Planner:
                 len(group_exprs) + j, agg_fields[j].type)
         return agg_node, replacements
 
+    # -- windows --------------------------------------------------------------
+    def _plan_windows(self, node: PlanNode, scope: Scope,
+                      window_calls: List[A.WindowFunction]):
+        """One WindowNode per distinct (PARTITION BY, ORDER BY) window;
+        shared windows evaluate together (reference plan/WindowNode.java
+        groups functions under one window)."""
+        from .plan import WindowFnSpec, WindowNode
+        replacements: Dict[A.Expression, ir.Expr] = {}
+        groups: Dict[Tuple, List[A.WindowFunction]] = {}
+        for w in window_calls:
+            groups.setdefault((w.partition_by, w.order_by), []).append(w)
+        for (partition_by, order_by), wins in groups.items():
+            analyzer = ExpressionAnalyzer(Scope(node.fields))
+            base = len(node.fields)
+            extra_exprs: List[ir.Expr] = []
+            extra_fields: List[Field] = []
+
+            def col_of(ast_expr: A.Expression):
+                e = analyzer.analyze(ast_expr)
+                if isinstance(e, ir.InputRef):
+                    return e.index, e.type
+                extra_exprs.append(e)
+                extra_fields.append(
+                    Field(f"$w{base + len(extra_exprs) - 1}", e.type))
+                return base + len(extra_exprs) - 1, e.type
+
+            part_idx = [col_of(p)[0] for p in partition_by]
+            okeys = [SortKeySpec(col_of(s.key)[0], s.ascending, s.nulls_first)
+                     for s in order_by]
+            fn_specs: List[WindowFnSpec] = []
+            out_fields: List[Field] = []
+            for j, w in enumerate(wins):
+                spec = self._window_fn_spec(w, col_of, f"_win{j}",
+                                            bool(order_by))
+                fn_specs.append(spec)
+                out_fields.append(Field(spec.name, spec.output_type))
+            if extra_exprs:
+                exprs = tuple(ir.input_ref(i, f.type)
+                              for i, f in enumerate(node.fields)
+                              ) + tuple(extra_exprs)
+                fields = node.fields + tuple(extra_fields)
+                node = ProjectNode(child=node, exprs=exprs, fields=fields)
+            win_out = node.fields + tuple(out_fields)
+            node = WindowNode(
+                child=node, partition_indices=tuple(part_idx),
+                order_keys=tuple(okeys), functions=tuple(fn_specs),
+                fields=win_out)
+            for j, w in enumerate(wins):
+                replacements[w] = ir.input_ref(
+                    len(node.fields) - len(wins) + j,
+                    fn_specs[j].output_type)
+        return node, replacements
+
+    def _window_fn_spec(self, w: A.WindowFunction, col_of, name: str,
+                        has_order: bool):
+        from .plan import WindowFnSpec
+        from ..ops.window import AGG_FNS, RANKING, VALUE_FNS
+        call = w.call
+        fn = _FUNCTION_ALIASES.get(call.name, call.name)
+        if fn in ("rank", "dense_rank", "row_number", "percent_rank",
+                  "cume_dist") and not has_order:
+            raise AnalysisError(f"{fn}() requires window ORDER BY")
+        offset = 1
+        args: List[int] = []
+        if fn == "ntile":
+            if len(call.args) != 1 or not isinstance(call.args[0],
+                                                     A.LongLiteral):
+                raise AnalysisError("ntile(n) takes a literal bucket count")
+            offset = call.args[0].value
+            return WindowFnSpec("ntile", (), T.BIGINT, name, offset)
+        if fn in ("row_number", "rank", "dense_rank"):
+            return WindowFnSpec(fn, (), T.BIGINT, name)
+        if fn in ("percent_rank", "cume_dist"):
+            return WindowFnSpec(fn, (), T.DOUBLE, name)
+        if fn in ("lag", "lead", "nth_value"):
+            if not call.args:
+                raise AnalysisError(f"{fn}() needs an argument")
+            arg, arg_t = col_of(call.args[0])
+            if len(call.args) > 1:
+                if not isinstance(call.args[1], A.LongLiteral):
+                    raise AnalysisError(f"{fn} offset must be a literal")
+                offset = call.args[1].value
+            if len(call.args) > 2:
+                raise AnalysisError(
+                    f"{fn} default argument is not supported yet")
+            return WindowFnSpec(fn, (arg,), arg_t, name, offset)
+        if fn in ("first_value", "last_value"):
+            arg, arg_t = col_of(call.args[0])
+            return WindowFnSpec(fn, (arg,), arg_t, name)
+        if fn in ("count",) and (call.is_star or not call.args):
+            return WindowFnSpec("count_star", (), T.BIGINT, name,
+                                ignore_order=not has_order)
+        if fn in ("sum", "avg", "min", "max", "count"):
+            arg, arg_t = col_of(call.args[0])
+            out_t = (T.BIGINT if fn == "count" else
+                     T.DOUBLE if fn == "avg" else
+                     _agg_output_type(fn, arg_t))
+            return WindowFnSpec(fn, (arg,), out_t, name,
+                                ignore_order=not has_order)
+        raise AnalysisError(f"window function {fn}() is not supported")
+
     # -- ORDER BY -------------------------------------------------------------
     def _sort_keys(self, order_by, node: PlanNode, scope: Scope,
                    replacements) -> List[SortKeySpec]:
@@ -606,11 +717,38 @@ def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:
     def walk(n):
         if isinstance(n, (A.ScalarSubquery, A.InSubquery, A.Exists)):
             return  # subquery aggregates belong to the inner query
+        if isinstance(n, A.WindowFunction):
+            return  # sum(x) OVER (...) is a window, not a group aggregate
         if isinstance(n, A.FunctionCall):
             fn = _FUNCTION_ALIASES.get(n.name, n.name)
             if fn in AGGREGATE_FUNCTIONS or n.is_star and fn == "count":
                 found.append(n)
                 return  # don't descend into agg args
+        if dataclasses.is_dataclass(n) and not isinstance(n, type):
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if isinstance(v, tuple):
+                    for x in v:
+                        if dataclasses.is_dataclass(x):
+                            walk(x)
+                elif dataclasses.is_dataclass(v):
+                    walk(v)
+    for e in exprs:
+        if e is not None:
+            walk(e)
+    return found
+
+
+def _collect_windows(exprs: Sequence[A.Expression]
+                     ) -> List[A.WindowFunction]:
+    found: List[A.WindowFunction] = []
+
+    def walk(n):
+        if isinstance(n, (A.ScalarSubquery, A.InSubquery, A.Exists)):
+            return
+        if isinstance(n, A.WindowFunction):
+            found.append(n)
+            return
         if dataclasses.is_dataclass(n) and not isinstance(n, type):
             for f in dataclasses.fields(n):
                 v = getattr(n, f.name)

@@ -389,5 +389,10 @@ class ExpressionAnalyzer:
     def _Exists(self, node):
         raise AnalysisError("EXISTS must be planned (semi join)")
 
+    def _WindowFunction(self, node):
+        raise AnalysisError(
+            "window function in invalid context (only SELECT items and "
+            "ORDER BY may contain OVER)")
+
     def _Star(self, node):
         raise AnalysisError("* only allowed at the top of SELECT")

@@ -173,6 +173,15 @@ class FunctionCall(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowFunction(Expression):
+    """fn(...) OVER (PARTITION BY ... ORDER BY ...) (reference
+    sql/tree/FunctionCall window + Window.java)."""
+    call: "FunctionCall"
+    partition_by: Tuple[Expression, ...] = ()
+    order_by: Tuple["SortItem", ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class Cast(Expression):
     value: Expression
     type_name: str                 # e.g. "decimal(12,2)"

@@ -42,6 +42,7 @@ KEYWORDS = frozenset("""
     count sum avg min max coalesce nullif
     create table drop insert into values if show session set reset explain
     analyze describe catalogs schemas tables columns functions
+    over partition rows range preceding following unbounded current row
 """.split())
 
 # Keywords that can still be used as identifiers in non-ambiguous positions
@@ -50,6 +51,7 @@ NON_RESERVED = frozenset("""
     date time timestamp year month day hour minute second catalogs schemas
     tables columns functions session analyze show if first last nulls
     count sum avg min max coalesce nullif interval
+    over partition rows range preceding following unbounded current row
 """.split())
 
 

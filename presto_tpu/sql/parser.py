@@ -632,7 +632,8 @@ class _Parser:
             self.next()
             if self.accept_op("*"):
                 self.expect_op(")")
-                return A.FunctionCall(name.lower(), (), is_star=True)
+                return self._maybe_window(
+                    A.FunctionCall(name.lower(), (), is_star=True))
             distinct = False
             args: List[A.Expression] = []
             if not self.at_op(")"):
@@ -644,10 +645,36 @@ class _Parser:
                 while self.accept_op(","):
                     args.append(self.expression())
             self.expect_op(")")
-            return self._postfix(
-                A.FunctionCall(name.lower(), tuple(args), distinct=distinct))
+            return self._postfix(self._maybe_window(
+                A.FunctionCall(name.lower(), tuple(args), distinct=distinct)))
         e: A.Expression = A.Identifier(name)
         return self._postfix(e)
+
+    def _maybe_window(self, call: A.FunctionCall) -> A.Expression:
+        """fn(...) OVER (PARTITION BY ... ORDER BY ... [frame])."""
+        if not self.at_kw("over"):
+            return call
+        self.next()
+        self.expect_op("(")
+        partition: List[A.Expression] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.expression())
+            while self.accept_op(","):
+                partition.append(self.expression())
+        order_by = self._order_by()
+        if self.at_kw("rows", "range"):
+            # default-frame semantics only; accept and validate the common
+            # spelling of the default frame
+            self.next()
+            self.expect_kw("between")
+            self.expect_kw("unbounded")
+            self.expect_kw("preceding")
+            self.expect_kw("and")
+            self.expect_kw("current")
+            self.expect_kw("row")
+        self.expect_op(")")
+        return A.WindowFunction(call, tuple(partition), order_by)
 
     def _postfix(self, e: A.Expression) -> A.Expression:
         while self.at_op(".") and self.peek(1).kind in ("IDENT", "QIDENT"):

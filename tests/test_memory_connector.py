@@ -1,0 +1,59 @@
+"""Memory connector: CTAS / INSERT / DROP / scans (presto-memory role)."""
+import pytest
+
+from presto_tpu.exec.runner import LocalRunner
+
+
+@pytest.fixture()
+def runner():
+    return LocalRunner(tpch_sf=0.002)
+
+
+def test_ctas_and_query(runner):
+    res = runner.execute(
+        "create table memory.default.big_orders as "
+        "select o_orderkey, o_totalprice from orders "
+        "where o_totalprice > 200000")
+    n = res.rows[0][0]
+    assert n > 0
+    res = runner.execute("select count(*) from memory.default.big_orders")
+    assert res.rows[0][0] == n
+    res = runner.execute(
+        "select max(o_totalprice) from memory.default.big_orders")
+    want = runner.execute(
+        "select max(o_totalprice) from orders where o_totalprice > 200000")
+    assert res.rows == want.rows
+
+
+def test_insert_appends(runner):
+    runner.execute("create table memory.default.t as select 1 as x")
+    runner.execute("insert into memory.default.t select 2 as x")
+    runner.execute("insert into memory.default.t select x + 10 from memory.default.t")
+    res = runner.execute("select x from memory.default.t order by x")
+    assert [r[0] for r in res.rows] == [1, 2, 11, 12]
+
+
+def test_drop(runner):
+    runner.execute("create table memory.default.d as select 1 as x")
+    runner.execute("drop table memory.default.d")
+    with pytest.raises(KeyError):
+        runner.execute("select * from memory.default.d")
+    runner.execute("drop table if exists memory.default.d")
+
+
+def test_ctas_strings_and_joins(runner):
+    runner.execute(
+        "create table memory.default.nr as "
+        "select n_name, r_name from nation join region "
+        "on n_regionkey = r_regionkey")
+    res = runner.execute(
+        "select r_name, count(*) c from memory.default.nr "
+        "group by r_name order by r_name")
+    assert len(res.rows) == 5
+    assert sum(r[1] for r in res.rows) == 25
+
+
+def test_show_tables_includes_memory(runner):
+    runner.execute("create table memory.default.vis as select 1 as x")
+    conn = runner.session.catalogs.get("memory")
+    assert "vis" in conn.metadata.list_tables()

@@ -126,6 +126,10 @@ FEATURES = [
     "select count(*) from (select o_custkey k from orders where o_totalprice > 200000) t join customer on c_custkey = k",
     "select max(o_orderdate) from orders",
     "select s_name, n_name from supplier left join nation on s_nationkey = n_nationkey and n_regionkey = 0 order by s_name limit 5",
+    # many-to-many joins (expansion path)
+    "select count(*) from nation n join customer c on n.n_nationkey = c.c_nationkey",
+    "select n_name, count(o_orderkey) from nation left join customer on n_nationkey = c_nationkey left join orders on c_custkey = o_custkey group by n_name order by 1",
+    "select count(*), sum(l1.l_quantity) from lineitem l1 join lineitem l2 on l1.l_orderkey = l2.l_orderkey where l1.l_linenumber = 1 and l2.l_linenumber = 2",
 ]
 
 

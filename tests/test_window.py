@@ -1,0 +1,44 @@
+"""Window functions vs the SQLite oracle (sqlite3 >= 3.25 has windows)."""
+import pytest
+
+from test_sql import compare, oracle, runner  # noqa: F401 (fixtures)
+
+WINDOW_QUERIES = [
+    "select o_custkey, o_orderkey, row_number() over (partition by o_custkey order by o_orderkey) rn from orders order by o_custkey, o_orderkey limit 50",
+    "select n_regionkey, n_name, rank() over (partition by n_regionkey order by n_name) r from nation order by n_regionkey, n_name",
+    "select n_regionkey, n_name, dense_rank() over (order by n_regionkey) d from nation order by n_regionkey, n_name",
+    "select o_orderkey, sum(o_totalprice) over (partition by o_custkey) s from orders order by o_orderkey limit 30",
+    "select o_orderkey, sum(o_totalprice) over (partition by o_custkey order by o_orderkey) run from orders order by o_orderkey limit 30",
+    "select o_orderkey, count(*) over (partition by o_orderstatus) c from orders order by o_orderkey limit 20",
+    "select n_name, lag(n_name, 1) over (order by n_name) prev from nation order by n_name",
+    "select n_name, lead(n_name, 2) over (partition by n_regionkey order by n_name) nx from nation order by n_regionkey, n_name",
+    "select n_name, first_value(n_name) over (partition by n_regionkey order by n_name) f from nation order by n_regionkey, n_name",
+    "select o_custkey, avg(o_totalprice) over (partition by o_custkey) a from orders order by o_custkey, o_orderkey limit 25",
+    "select n_regionkey, n_name, percent_rank() over (partition by n_regionkey order by n_name) p from nation order by n_regionkey, n_name",
+    "select n_regionkey, n_name, cume_dist() over (partition by n_regionkey order by n_name) p from nation order by n_regionkey, n_name",
+    "select n_name, ntile(3) over (order by n_name) t from nation order by n_name",
+    "select o_orderkey, min(o_totalprice) over (partition by o_orderstatus order by o_orderkey) m from orders order by o_orderkey limit 25",
+]
+
+
+@pytest.mark.parametrize("sql", WINDOW_QUERIES, ids=range(len(WINDOW_QUERIES)))
+def test_window(runner, oracle, sql):
+    compare(runner, oracle, sql, rel=1e-9)
+
+
+def test_window_distributed(runner):
+    from presto_tpu.exec.distributed import DistributedRunner
+    dist = DistributedRunner(catalogs=runner.session.catalogs,
+                             rows_per_batch=1 << 13)
+    for sql in WINDOW_QUERIES[:6]:
+        want = runner.execute(sql)
+        got = dist.execute(sql)
+        w = [tuple(round(v, 6) if isinstance(v, float) else v for v in r)
+             for r in want.rows]
+        g = [tuple(round(float(v), 6) if hasattr(v, "item") and
+                   isinstance(v.item(), float) else
+                   (v.item() if hasattr(v, "item") else v) for v in r)
+             for r in got.rows]
+        w2 = [tuple(v.item() if hasattr(v, "item") else v for v in r)
+              for r in want.rows]
+        assert len(g) == len(w2)

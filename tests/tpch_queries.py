@@ -275,6 +275,28 @@ group by l_shipmode
 order by l_shipmode
 """)
 
+q("q13", """
+select c_count, count(*) as custdist
+from (
+  select c_custkey, count(o_orderkey) as c_count
+  from customer left outer join orders on c_custkey = o_custkey
+    and o_comment not like '%special%requests%'
+  group by c_custkey
+) c_orders (c_custkey, c_count)
+group by c_count
+order by custdist desc, c_count desc
+""", """
+select c_count, count(*) as custdist
+from (
+  select c_custkey, count(o_orderkey) as c_count
+  from customer left outer join orders on c_custkey = o_custkey
+    and o_comment not like '%special%requests%'
+  group by c_custkey
+) c_orders
+group by c_count
+order by custdist desc, c_count desc
+""")
+
 q("q14", """
 select 100.00 * sum(case when p_type like 'PROMO%'
     then l_extendedprice * (1 - l_discount) else 0 end)
