@@ -367,7 +367,8 @@ class DistributedExecutor(_Executor):
         # distributed sort: local sort per shard, then gather + final merge
         # sort (reference MergeOperator.java:45 / dist-sort.rst)
         local_sorted = self._smap(lambda x: sort_batch(x, keys), 1)
-        yield sort_batch(_to_host(local_sorted(b)), keys)
+        # re-shard so a downstream exchange sees mesh-divisible capacity
+        yield self._pad_shardable(sort_batch(_to_host(local_sorted(b)), keys))
 
     def _TopNNode(self, node: TopNNode) -> Iterator[Batch]:
         keys = [SortKey(k.index, k.ascending, k.nulls_first)
@@ -381,7 +382,7 @@ class DistributedExecutor(_Executor):
             merged = cand if state is None else concat_batches([state, cand])
             state = top_n(merged, keys, node.count).compact(cap)
         if state is not None:
-            yield sort_batch(state, keys)
+            yield self._pad_shardable(sort_batch(state, keys))
 
     def _WindowNode(self, node) -> Iterator[Batch]:
         from ..ops.window import WindowSpec, evaluate_window
@@ -389,7 +390,7 @@ class DistributedExecutor(_Executor):
         if b is None:
             return
         specs = [WindowSpec(f.fn, f.args, f.output_type, f.name, f.offset,
-                            f.ignore_order) for f in node.functions]
+                            f.ignore_order, f.frame) for f in node.functions]
         keys = [SortKey(k.index, k.ascending, k.nulls_first)
                 for k in node.order_keys]
         parts = list(node.partition_indices)
@@ -493,14 +494,9 @@ class DistributedRunner:
                 "DistributedRunner serves queries; use LocalRunner for "
                 "session statements")
         plan = self._optimize(plan_query(stmt, self.session), self.session)
+        from .local import run_init_plans
         ex = DistributedExecutor(self.session, self.rows_per_batch, self.mesh)
-        init_values = []
-        for p in plan.init_plans:
-            rows = [r for b in ex.run(p) for r in b.to_pylist()]
-            if len(rows) > 1:
-                raise ValueError("scalar subquery returned more than one row")
-            init_values.append(rows[0][0] if rows else None)
-        ex.init_values = init_values
+        run_init_plans(ex, plan)
         root = plan.root
         rows = [r for b in ex.run(root.child) for r in b.to_pylist()]
         return QueryResult(names=[f.name for f in root.fields],

@@ -45,18 +45,24 @@ class QueryResult:
     rows: List[tuple]
 
 
-def execute_plan(plan: LogicalPlan, session: Session,
-                 rows_per_batch: int = 1 << 17) -> QueryResult:
-    ex = _Executor(session, rows_per_batch)
-    # run init plans first, extract their scalar results
-    init_values: List[object] = []
+def run_init_plans(ex, plan: LogicalPlan) -> None:
+    """Run uncorrelated scalar subqueries (init plans), exposing results to
+    the main plan AND to later init plans: inner subqueries are appended
+    first (lower indices), so binding the live list to the executor before
+    the loop makes a nested init plan's InitPlanRef resolvable while the
+    outer one runs."""
+    ex.init_values = init_values = []
     for p in plan.init_plans:
-        batches = list(ex.run(p))
-        rows = [r for b in batches for r in b.to_pylist()]
+        rows = [r for b in ex.run(p) for r in b.to_pylist()]
         if len(rows) > 1:
             raise ValueError("scalar subquery returned more than one row")
         init_values.append(rows[0][0] if rows else None)
-    ex.init_values = init_values
+
+
+def execute_plan(plan: LogicalPlan, session: Session,
+                 rows_per_batch: int = 1 << 17) -> QueryResult:
+    ex = _Executor(session, rows_per_batch)
+    run_init_plans(ex, plan)
     root = plan.root
     out_batches = list(ex.run(root.child))
     rows = [r for b in out_batches for r in b.to_pylist()]
@@ -171,7 +177,7 @@ class _Executor:
         if b is None:
             return
         specs = [WindowSpec(f.fn, f.args, f.output_type, f.name, f.offset,
-                            f.ignore_order) for f in node.functions]
+                            f.ignore_order, f.frame) for f in node.functions]
         keys = [SortKey(k.index, k.ascending, k.nulls_first)
                 for k in node.order_keys]
         out = evaluate_window(b, list(node.partition_indices), keys, specs)

@@ -113,16 +113,10 @@ class LocalRunner:
 
     def _run_to_batches(self, query: A.Query):
         from ..batch import Schema
-        from .local import _Executor, _plan_schema
+        from .local import _Executor, run_init_plans
         plan = optimize(plan_query(query, self.session), self.session)
         ex = _Executor(self.session, self.rows_per_batch)
-        init_values = []
-        for p in plan.init_plans:
-            rows = [r for b in ex.run(p) for r in b.to_pylist()]
-            if len(rows) > 1:
-                raise ValueError("scalar subquery returned more than one row")
-            init_values.append(rows[0][0] if rows else None)
-        ex.init_values = init_values
+        run_init_plans(ex, plan)
         root = plan.root
         schema = Schema([(f.name, f.type) for f in root.fields])
         return schema, ex.run(root.child)

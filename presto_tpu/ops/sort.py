@@ -43,14 +43,26 @@ def _rank_table(vocab: Tuple[str, ...]) -> jnp.ndarray:
     return jnp.asarray(table)
 
 
+def rank_codes(data: jnp.ndarray, vocab: Optional[Tuple[str, ...]]) -> jnp.ndarray:
+    """Map dictionary codes to lexicographic ranks (negative codes -> -1)."""
+    table = _rank_table(vocab or ())
+    idx = jnp.where(data >= 0, data, table.shape[0] - 1)
+    return jnp.take(table, idx, axis=0)
+
+
+def unrank_table(vocab: Optional[Tuple[str, ...]]) -> jnp.ndarray:
+    """Inverse of _rank_table: rank -> dictionary code."""
+    order = (np.argsort(np.asarray(vocab, dtype=object))
+             if vocab else np.zeros(1))
+    return jnp.asarray(order.astype(np.int64))
+
+
 def _sortable(col: Column, key: SortKey) -> List[jnp.ndarray]:
     """Transform one column into ascending-sortable operand(s):
     [null_rank, data'] where smaller sorts first."""
     data = col.data
     if col.type.is_string:
-        table = _rank_table(col.dictionary or ())
-        idx = jnp.where(data >= 0, data, table.shape[0] - 1)
-        data = jnp.take(table, idx, axis=0)
+        data = rank_codes(data, col.dictionary)
     if data.dtype == jnp.bool_:
         data = data.astype(jnp.int32)
     if not key.ascending:

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from .. import types as T
 from ..batch import Batch, Column, Schema
 from ..types import Type
-from .sort import SortKey, _sortable
+from .sort import SortKey, _sortable, rank_codes, unrank_table
 
 RANKING = ("row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
            "ntile")
@@ -47,6 +47,8 @@ class WindowSpec:
     name: str
     offset: int = 1                # lag/lead offset; ntile buckets
     ignore_order: bool = False     # aggregate without ORDER BY: whole part.
+    frame: str = "range"           # "range": frame ends at last peer row;
+                                   # "rows": frame ends at the current row
 
 
 def _cummax_int(x: jnp.ndarray) -> jnp.ndarray:
@@ -131,9 +133,16 @@ def evaluate_window(
             spec, s_cols, batch, mask, idx, pstart, pend, psize,
             row_in_part, ostart, oend, dense, dense_at_pstart)
         fields.append((spec.name, spec.output_type))
+        # String-valued outputs (lag/lead/first/last/nth_value, min/max over
+        # varchar) are dictionary codes drawn from the argument column's
+        # vocabulary — carry that dictionary (reference LagFunction.java
+        # returns the source block's value, dictionary included).
+        dictionary = None
+        if spec.output_type.is_string and spec.args:
+            dictionary = batch.columns[spec.args[0]].dictionary
         new_cols.append(Column(spec.output_type,
                                data.astype(spec.output_type.storage_dtype),
-                               valid & mask, None))
+                               valid & mask, dictionary))
     return Batch(Schema(fields), new_cols, mask)
 
 
@@ -179,15 +188,18 @@ def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
         data, valid = col(spec.args[0])
         src = jnp.maximum(pstart, 0)
         return jnp.take(data, src, axis=0), jnp.take(valid, src, axis=0)
+    # frame end: RANGE frames end at the current row's last peer, ROWS
+    # frames at the current row itself (reference window/FrameInfo.java)
+    frame_end = idx if spec.frame == "rows" else oend
+
     if fn == "last_value":
-        # default frame ends at the current row's last PEER
         data, valid = col(spec.args[0])
-        src = jnp.clip(oend, 0, cap - 1)
+        src = jnp.clip(frame_end, 0, cap - 1)
         return jnp.take(data, src, axis=0), jnp.take(valid, src, axis=0)
     if fn == "nth_value":
         data, valid = col(spec.args[0])
         src = pstart + spec.offset - 1
-        ok = src <= jnp.minimum(oend, pend)
+        ok = src <= jnp.minimum(frame_end, pend)
         src = jnp.clip(src, 0, cap - 1)
         return jnp.take(data, src, axis=0), jnp.take(valid, src, axis=0) & ok
 
@@ -208,29 +220,39 @@ def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
                       if fn != "avg" else data.astype(jnp.float64), 0)
         zero = jnp.zeros((), dtype=x.dtype)
     if fn in ("min", "max"):
-        big = jnp.iinfo(acc_dtype).max if jnp.issubdtype(acc_dtype, jnp.integer) \
-            else jnp.asarray(jnp.inf, acc_dtype)
-        small = jnp.iinfo(acc_dtype).min if jnp.issubdtype(acc_dtype, jnp.integer) \
-            else jnp.asarray(-jnp.inf, acc_dtype)
+        # min/max over strings must compare lexicographic ranks, not codes
+        # (codes are assigned in order of appearance).
+        is_str = bool(spec.args) and batch.columns[spec.args[0]].type.is_string
+        if is_str:
+            vocab = batch.columns[spec.args[0]].dictionary
+            xdata = rank_codes(data, vocab)
+            red_dtype = xdata.dtype
+        else:
+            xdata = data.astype(acc_dtype)
+            red_dtype = acc_dtype
+        big = jnp.iinfo(red_dtype).max if jnp.issubdtype(red_dtype, jnp.integer) \
+            else jnp.asarray(jnp.inf, red_dtype)
+        small = jnp.iinfo(red_dtype).min if jnp.issubdtype(red_dtype, jnp.integer) \
+            else jnp.asarray(-jnp.inf, red_dtype)
         sent = big if fn == "min" else small
         op = jnp.minimum if fn == "min" else jnp.maximum
-        xm = jnp.where(valid_in, data.astype(acc_dtype), sent)
-        if spec.ignore_order:
-            # whole partition: segmented reduce via sort-order scan
-            run = _segment_scan(xm, pstart, op)
-            val = jnp.take(run, jnp.clip(pend, 0, cap - 1), axis=0)
-        else:
-            run = _segment_scan(xm, pstart, op)
-            val = jnp.take(run, jnp.clip(oend, 0, cap - 1), axis=0)
-        cnt = _running_count(valid_in, pstart, oend, pend, spec.ignore_order)
+        xm = jnp.where(valid_in, xdata, sent)
+        run = _segment_scan(xm, pstart, op)
+        upto = _agg_frame_end(spec, frame_end, pend)
+        val = jnp.take(run, jnp.clip(upto, 0, cap - 1), axis=0)
+        if is_str:
+            # map winning rank back to a dictionary code
+            inv = unrank_table(vocab)
+            val = jnp.take(inv, jnp.clip(val, 0, inv.shape[0] - 1), axis=0)
+        cnt = _running_count(valid_in, pstart, upto)
         return val, cnt > 0
     # sum / count / avg
     csum = jnp.cumsum(x)
     base = jnp.where(pstart > 0,
                      jnp.take(csum, jnp.maximum(pstart - 1, 0), axis=0), zero)
-    upto = jnp.clip(pend if spec.ignore_order else oend, 0, cap - 1)
-    val = jnp.take(csum, upto, axis=0) - base
-    cnt = _running_count(valid_in, pstart, oend, pend, spec.ignore_order)
+    upto = _agg_frame_end(spec, frame_end, pend)
+    val = jnp.take(csum, jnp.clip(upto, 0, cap - 1), axis=0) - base
+    cnt = _running_count(valid_in, pstart, upto)
     if fn in ("count", "count_star"):
         return val, jnp.ones(cap, dtype=bool)
     if fn == "avg":
@@ -238,13 +260,21 @@ def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
     return val, cnt > 0
 
 
-def _running_count(valid_in, pstart, oend, pend, whole_partition):
+def _agg_frame_end(spec, frame_end, pend):
+    """Frame end for running aggregates: an explicit ROWS frame always ends
+    at the current row, even without ORDER BY (ignore_order covers only the
+    default whole-partition frame of order-less windows)."""
+    if spec.frame == "rows":
+        return frame_end
+    return pend if spec.ignore_order else frame_end
+
+
+def _running_count(valid_in, pstart, upto):
     cap = valid_in.shape[0]
     csum = jnp.cumsum(valid_in.astype(jnp.int64))
     base = jnp.where(pstart > 0,
                      jnp.take(csum, jnp.maximum(pstart - 1, 0), axis=0), 0)
-    upto = jnp.clip(pend if whole_partition else oend, 0, cap - 1)
-    return jnp.take(csum, upto, axis=0) - base
+    return jnp.take(csum, jnp.clip(upto, 0, cap - 1), axis=0) - base
 
 
 def _segment_scan(x, pstart, op):

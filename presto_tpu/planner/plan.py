@@ -174,6 +174,7 @@ class WindowFnSpec:
     name: str
     offset: int = 1                # lag/lead/ntile/nth_value parameter
     ignore_order: bool = False
+    frame: str = "range"           # RANGE (peer-inclusive) | ROWS frame
 
 
 @_one_child

@@ -483,6 +483,8 @@ class _Planner:
             for j, w in enumerate(wins):
                 spec = self._window_fn_spec(w, col_of, f"_win{j}",
                                             bool(order_by))
+                if w.frame != "range":
+                    spec = dataclasses.replace(spec, frame=w.frame)
                 fn_specs.append(spec)
                 out_fields.append(Field(spec.name, spec.output_type))
             if extra_exprs:

@@ -179,6 +179,7 @@ class WindowFunction(Expression):
     call: "FunctionCall"
     partition_by: Tuple[Expression, ...] = ()
     order_by: Tuple["SortItem", ...] = ()
+    frame: str = "range"           # RANGE (peer-inclusive) | ROWS frame kind
 
 
 @dataclasses.dataclass(frozen=True)

@@ -663,18 +663,25 @@ class _Parser:
             while self.accept_op(","):
                 partition.append(self.expression())
         order_by = self._order_by()
+        # UNBOUNDED PRECEDING .. CURRENT ROW frames only (the default frame
+        # shape); RANGE ends at the last peer row, ROWS at the current row
+        # (reference operator/window/FrameInfo.java distinguishes these).
+        frame = "range"
         if self.at_kw("rows", "range"):
-            # default-frame semantics only; accept and validate the common
-            # spelling of the default frame
+            frame = "rows" if self.at_kw("rows") else "range"
             self.next()
-            self.expect_kw("between")
-            self.expect_kw("unbounded")
-            self.expect_kw("preceding")
-            self.expect_kw("and")
-            self.expect_kw("current")
-            self.expect_kw("row")
+            if self.accept_kw("between"):
+                self.expect_kw("unbounded")
+                self.expect_kw("preceding")
+                self.expect_kw("and")
+                self.expect_kw("current")
+                self.expect_kw("row")
+            else:
+                # frame-start-only spelling: "ROWS UNBOUNDED PRECEDING"
+                self.expect_kw("unbounded")
+                self.expect_kw("preceding")
         self.expect_op(")")
-        return A.WindowFunction(call, tuple(partition), order_by)
+        return A.WindowFunction(call, tuple(partition), order_by, frame)
 
     def _postfix(self, e: A.Expression) -> A.Expression:
         while self.at_op(".") and self.peek(1).kind in ("IDENT", "QIDENT"):

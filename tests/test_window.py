@@ -18,6 +18,15 @@ WINDOW_QUERIES = [
     "select n_regionkey, n_name, cume_dist() over (partition by n_regionkey order by n_name) p from nation order by n_regionkey, n_name",
     "select n_name, ntile(3) over (order by n_name) t from nation order by n_name",
     "select o_orderkey, min(o_totalprice) over (partition by o_orderstatus order by o_orderkey) m from orders order by o_orderkey limit 25",
+    # ROWS vs RANGE frames: order key with ties (o_orderstatus) makes them differ
+    "select o_orderkey, sum(o_totalprice) over (partition by o_custkey order by o_orderstatus rows between unbounded preceding and current row) s from orders order by o_orderkey limit 30",
+    "select o_orderkey, count(*) over (partition by o_custkey order by o_orderstatus range between unbounded preceding and current row) c from orders order by o_orderkey limit 30",
+    "select o_orderkey, last_value(o_orderstatus) over (partition by o_custkey order by o_totalprice rows unbounded preceding) lv from orders order by o_orderkey limit 30",
+    # min/max over strings must compare lexicographically, not by code order
+    "select n_regionkey, max(n_name) over (partition by n_regionkey order by n_nationkey) m from nation order by n_regionkey, n_nationkey",
+    # explicit ROWS frame with no window ORDER BY still runs row-by-row
+    # (which row gets which count is order-dependent, so sort by the count)
+    "select count(*) over (rows between unbounded preceding and current row) c from nation order by c",
 ]
 
 
