@@ -341,20 +341,45 @@ class DistributedExecutor(_Executor):
 
     def _SemiJoinNode(self, node: SemiJoinNode) -> Iterator[Batch]:
         build = self._drain(node.filtering)
+        skeys, fkeys = list(node.source_keys), list(node.filtering_keys)
+        neg = node.negated
         if build is None:
             for b in self.run(node.source):
-                if node.negated:
+                if neg:
                     yield b
             return
         build_rep = self._replicate(_to_host(build))
-        skey, fkey, neg = node.source_key, node.filtering_key, node.negated
 
-        def local(b: Batch, flt: Batch) -> Batch:
-            mask = semi_join_mask(b, flt, [skey], [fkey], negated=neg)
-            return Batch(b.schema, b.columns, mask)
+        if node.residual is None:
+            def local(b: Batch, flt: Batch) -> Batch:
+                mask = semi_join_mask(b, flt, skeys, fkeys, negated=neg,
+                                      null_aware=node.null_aware)
+                return Batch(b.schema, b.columns, mask)
 
-        fn = self._smap(local, 2, replicated_in=(1,))
+            fn = self._smap(local, 2, replicated_in=(1,))
+            for b in self.run(node.source):
+                yield fn(b, build_rep)
+            return
+
+        # mark-join (EXISTS with residual): shard-local against the
+        # replicated filtering side; expansion factor host-synced per chunk
+        from .local import mark_exists_mask
+        count_fn = self._smap(
+            lambda p, f: match_count_max(p, f, skeys, fkeys)[None], 2,
+            replicated_in=(1,))
+        fns: Dict[int, object] = {}
         for b in self.run(node.source):
+            maxk = bucket_capacity(
+                max(int(np.asarray(count_fn(b, build_rep)).max()), 1),
+                minimum=1)
+            fn = fns.get(maxk)
+            if fn is None:
+                def local_mark(p: Batch, f: Batch, _k=maxk) -> Batch:
+                    mask = mark_exists_mask(p, f, skeys, fkeys,
+                                            node.residual, neg, _k)
+                    return Batch(p.schema, p.columns, mask)
+                fn = fns[maxk] = self._smap(local_mark, 2,
+                                            replicated_in=(1,))
             yield fn(b, build_rep)
 
     # -- sort family: local pre-reduce + gather-merge -------------------------

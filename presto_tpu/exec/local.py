@@ -74,6 +74,35 @@ def _plan_schema(node: PlanNode) -> Schema:
     return Schema([(f.name, f.type) for f in node.fields])
 
 
+def mark_exists_mask(probe: Batch, build: Batch, probe_keys, build_keys,
+                     residual, negated: bool, max_matches: int):
+    """Correlated-EXISTS mark: probe row passes iff ANY build row with
+    equal keys satisfies the residual predicate (over probe fields +
+    build fields). The decorrelated mark-join shape of reference
+    TransformExistsApplyToCorrelatedJoin.java: expand the m:n matches,
+    filter by the residual, then test membership of each probe row id in
+    the surviving matches."""
+    from ..expr.rewrite import referenced_inputs, remap_inputs
+    cap = probe.capacity
+    rid = Column(T.BIGINT, jnp.arange(cap, dtype=jnp.int64),
+                 probe.row_mask, None)
+    schema2 = Schema(list(zip(probe.schema.names, probe.schema.types))
+                     + [("$rid", T.BIGINT)])
+    probe2 = Batch(schema2, list(probe.columns) + [rid], probe.row_mask)
+    payload = list(range(len(build.columns)))
+    pnames = [f"$f{i}" for i in payload]
+    expanded = expand_join(probe2, build, probe_keys, build_keys,
+                           payload, pnames, "inner", max_matches)
+    # expanded layout: probe cols, $rid, build cols — shift build refs by 1
+    n_src = len(probe.columns)
+    shift = {i: (i if i < n_src else i + 1)
+             for i in referenced_inputs(residual)}
+    filt = compile_filter(remap_inputs(residual, shift), expanded.schema)
+    kept = filt(expanded)
+    return semi_join_mask(probe2, kept, [n_src], [n_src],
+                          negated=negated, null_aware=False)
+
+
 class _Executor:
     def __init__(self, session: Session, rows_per_batch: int):
         self.session = session
@@ -314,6 +343,8 @@ class _Executor:
 
     def _SemiJoinNode(self, node: SemiJoinNode) -> Iterator[Batch]:
         build = self._drain(node.filtering)
+        skeys = list(node.source_keys)
+        fkeys = list(node.filtering_keys)
         for b in self.run(node.source):
             if build is None:
                 if node.negated:
@@ -322,6 +353,13 @@ class _Executor:
                     yield Batch(b.schema, b.columns,
                                 jnp.zeros_like(b.row_mask))
                 continue
-            mask = semi_join_mask(b, build, [node.source_key],
-                                  [node.filtering_key], negated=node.negated)
+            if node.residual is None:
+                mask = semi_join_mask(b, build, skeys, fkeys,
+                                      negated=node.negated,
+                                      null_aware=node.null_aware)
+            else:
+                maxk = int(match_count_max(b, build, skeys, fkeys))
+                mask = mark_exists_mask(
+                    b, build, skeys, fkeys, node.residual, node.negated,
+                    bucket_capacity(max(maxk, 1), minimum=1))
             yield Batch(b.schema, b.columns, mask)

@@ -518,8 +518,24 @@ def _vocab_transform(fn):
             else:
                 raise NotImplementedError(
                     "string function positional args must be constants")
-        new_vocab = tuple(fn(s, *extra) for s in a.dictionary)
-        return Val(a.data, a.valid, out, dictionary=new_vocab)
+        entries = [fn(s, *extra) for s in a.dictionary]
+        # dedupe the transformed vocab and remap codes: distinct inputs can
+        # map to one output (substr prefixes), and equal strings MUST share
+        # one code — grouping/joins compare codes
+        lookup: dict = {}
+        vocab: list = []
+        remap = np.empty(len(entries) + 1, dtype=np.int32)
+        for i, s in enumerate(entries):
+            code = lookup.get(s)
+            if code is None:
+                code = lookup[s] = len(vocab)
+                vocab.append(s)
+            remap[i] = code
+        remap[-1] = -1
+        if len(vocab) == len(entries):
+            return Val(a.data, a.valid, out, dictionary=tuple(entries))
+        codes = _code_gather(jnp.asarray(remap), a.data)
+        return Val(codes, a.valid, out, dictionary=tuple(vocab))
     return impl
 
 

@@ -186,14 +186,18 @@ def semi_join_mask(
     probe_keys: Sequence[int],
     build_keys: Sequence[int],
     negated: bool = False,
+    null_aware: bool = True,
 ) -> jnp.ndarray:
-    """Membership mask for semi/anti-joins (IN / NOT IN; reference
-    HashSemiJoinOperator.java + SetBuilderOperator.java).
+    """Membership mask for semi/anti-joins (IN / NOT IN / [NOT] EXISTS;
+    reference HashSemiJoinOperator.java + SetBuilderOperator.java).
 
-    ANSI null semantics: a NULL probe key never matches; for NOT IN, any
-    NULL build key makes membership UNKNOWN for non-matching rows (nothing
-    passes), while an EMPTY build set makes NOT IN vacuously TRUE for every
-    probe row — including NULL keys.
+    null_aware=True (IN / NOT IN) follows ANSI IN-predicate semantics: a
+    NULL probe key never matches; for NOT IN, any NULL build key makes
+    membership UNKNOWN for non-matching rows (nothing passes), while an
+    EMPTY build set makes NOT IN vacuously TRUE for every probe row —
+    including NULL keys. null_aware=False (decorrelated [NOT] EXISTS)
+    treats NULL keys as simply never equal: NOT EXISTS keeps every probe
+    row without a live match.
     """
     skey, slive, _ = build_sorted(build, build_keys)
     pkey, pvalid = _join_key(probe, probe_keys)
@@ -202,6 +206,8 @@ def semi_join_mask(
     hit = (jnp.take(skey, pos, axis=0) == pkey) & jnp.take(slive, pos, axis=0)
     if not negated:
         return probe.row_mask & pvalid & hit
+    if not null_aware:
+        return probe.row_mask & ~(pvalid & hit)
     _bkey, bvalid = _join_key(build, build_keys)
     build_has_null = jnp.any(build.row_mask & ~bvalid)
     build_empty = ~jnp.any(build.row_mask)

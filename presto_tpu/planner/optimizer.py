@@ -61,6 +61,30 @@ def _rewrite_joins(node: PlanNode, session: Session) -> PlanNode:
     if (isinstance(node, FilterNode) and isinstance(node.child, JoinNode)
             and node.child.join_type in ("cross", "inner")):
         return _plan_join_graph(node.child, [node.predicate], session)
+    if (isinstance(node, FilterNode) and isinstance(node.child, JoinNode)
+            and node.child.join_type == "left"):
+        # WHERE conjuncts that touch only the probe side of a LEFT JOIN
+        # push below it (they cannot change match semantics; reference
+        # optimizations/PredicatePushDown.java outer-join handling), which
+        # lets the probe side's own join graph form.
+        j = node.child
+        n_left = len(j.left.fields)
+        push, keep = [], []
+        for c in conjuncts(node.predicate):
+            refs = referenced_inputs(c)
+            if refs and all(r < n_left for r in refs):
+                push.append(c)
+            else:
+                keep.append(c)
+        if push:
+            j = dataclasses.replace(
+                j, left=FilterNode(child=j.left,
+                                   predicate=combine_conjuncts(push)))
+            rebuilt: PlanNode = j
+            if keep:
+                rebuilt = FilterNode(child=j,
+                                     predicate=combine_conjuncts(keep))
+            return _rewrite_joins(rebuilt, session)
     if isinstance(node, JoinNode) and node.join_type in ("cross", "inner"):
         return _plan_join_graph(node, [], session)
     return node.with_children([_rewrite_joins(c, session)
@@ -383,14 +407,27 @@ def _prune(node: PlanNode, required: List[int]) -> Tuple[PlanNode, Dict[int, int
                        [node.fields[i] for i in req]), mapping
 
     if isinstance(node, SemiJoinNode):
-        need = set(req) | {node.source_key}
+        n_src = len(node.source.fields)
+        res_refs = (referenced_inputs(node.residual)
+                    if node.residual is not None else set())
+        src_res = {i for i in res_refs if i < n_src}
+        flt_res = {i - n_src for i in res_refs if i >= n_src}
+        need = set(req) | set(node.source_keys) | src_res
         source, smap = _prune(node.source, sorted(need))
-        filtering, fmap = _prune(node.filtering, [node.filtering_key])
+        fneed = sorted(set(node.filtering_keys) | flt_res)
+        filtering, fmap = _prune(node.filtering, fneed)
+        residual = None
+        if node.residual is not None:
+            both = {i: smap[i] for i in src_res}
+            both.update({n_src + i: len(source.fields) + fmap[i]
+                         for i in flt_res})
+            residual = remap_inputs(node.residual, both)
         inner = SemiJoinNode(
             source=source, filtering=filtering,
-            source_key=smap[node.source_key],
-            filtering_key=fmap[node.filtering_key],
-            fields=source.fields, negated=node.negated)
+            source_keys=tuple(smap[k] for k in node.source_keys),
+            filtering_keys=tuple(fmap[k] for k in node.filtering_keys),
+            fields=source.fields, negated=node.negated,
+            residual=residual, null_aware=node.null_aware)
         return _narrow(inner, [smap[i] for i in req],
                        [node.fields[i] for i in req]), mapping
 

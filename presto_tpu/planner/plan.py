@@ -145,14 +145,22 @@ class JoinNode(PlanNode):
 class SemiJoinNode(PlanNode):
     """Filters source rows by key membership in the filtering subplan
     (reference plan/SemiJoinNode.java; executed like SetBuilder +
-    HashSemiJoin). Output = source fields."""
+    HashSemiJoin). Output = source fields.
+
+    ``residual`` (over source fields + filtering fields) restricts which
+    matches count — the decorrelated-EXISTS mark-join shape (reference
+    iterative/rule/TransformExistsApplyToCorrelatedJoin.java).
+    ``null_aware`` selects NOT IN semantics (NULL build key poisons the
+    anti side) vs NOT EXISTS semantics (NULLs simply never match)."""
 
     source: PlanNode
     filtering: PlanNode
-    source_key: int
-    filtering_key: int
+    source_keys: Tuple[int, ...]
+    filtering_keys: Tuple[int, ...]
     fields: Tuple[Field, ...]
     negated: bool = False
+    residual: Optional[ir.Expr] = None
+    null_aware: bool = True
 
     @property
     def children(self) -> Tuple[PlanNode, ...]:

@@ -223,6 +223,7 @@ class _Planner:
 
     # -- SELECT decomposition -----------------------------------------------
     def plan_query_spec(self, spec: A.QuerySpecification) -> PlanNode:
+        spec = self._decorrelate_scalar_aggs(spec)
         if spec.from_ is not None:
             node = self.plan_relation(spec.from_)
         else:
@@ -239,8 +240,11 @@ class _Planner:
                 node = FilterNode(
                     child=node,
                     predicate=self._analyze_with_subqueries(where, analyzer))
-            for value, query, negated in subquery_conjs:
-                node = self._plan_semi_join(node, value, query, negated)
+            for kind, value, query, negated in subquery_conjs:
+                if kind == "in":
+                    node = self._plan_semi_join(node, value, query, negated)
+                else:
+                    node = self._plan_exists(node, query, negated)
             scope = Scope(node.fields)
 
         select_items = self._expand_stars(spec.select, scope)
@@ -332,8 +336,8 @@ class _Planner:
         else:
             key_index = key.index
         node: PlanNode = SemiJoinNode(
-            source=source, filtering=filtering, source_key=key_index,
-            filtering_key=0, fields=source.fields, negated=negated)
+            source=source, filtering=filtering, source_keys=(key_index,),
+            filtering_keys=(0,), fields=source.fields, negated=negated)
         if source.fields and source.fields[-1].name == "$semikey":
             keep = list(range(len(source.fields) - 1))
             node = ProjectNode(
@@ -342,6 +346,195 @@ class _Planner:
                             for i in keep),
                 fields=tuple(source.fields[i] for i in keep))
         return node
+
+    def _plan_exists(self, source: PlanNode, query: A.Query,
+                     negated: bool) -> PlanNode:
+        """Decorrelate [NOT] EXISTS into a semi/anti join: correlated
+        equality conjuncts become join keys, inner-only conjuncts filter
+        the filtering side, any other correlated conjunct becomes the
+        join's residual (mark-join; reference iterative/rule/
+        TransformExistsApplyToCorrelatedJoin.java)."""
+        body = query.body
+        if query.with_ or not isinstance(body, A.QuerySpecification):
+            raise AnalysisError("unsupported EXISTS subquery shape")
+        if body.group_by or body.having or body.limit is not None \
+                or body.from_ is None:
+            raise AnalysisError("unsupported EXISTS subquery shape")
+        if _collect_aggs([it.value for it in body.select
+                          if not isinstance(it.value, A.Star)]):
+            # an ungrouped aggregate subquery always returns exactly one
+            # row, so EXISTS over it is constant TRUE — not a semi join
+            raise AnalysisError(
+                "EXISTS over an aggregate subquery is not supported")
+        inner = self.plan_relation(body.from_)
+        inner_scope = Scope(inner.fields)
+        outer_scope = Scope(source.fields)
+        combined_scope = Scope(source.fields + inner.fields)
+
+        inner_filters: List[ir.Expr] = []
+        skeys: List[int] = []
+        fkeys: List[int] = []
+        residuals: List[ir.Expr] = []
+        conjs = _split_conjuncts(body.where) if body.where is not None else []
+        for c in conjs:
+            try:
+                inner_filters.append(
+                    ExpressionAnalyzer(inner_scope).analyze(c))
+                continue
+            except AnalysisError:
+                pass
+            pair = None
+            if isinstance(c, A.Comparison) and c.op == "=":
+                for o_ast, i_ast in ((c.left, c.right), (c.right, c.left)):
+                    try:
+                        oe = ExpressionAnalyzer(outer_scope).analyze(o_ast)
+                        ie = ExpressionAnalyzer(inner_scope).analyze(i_ast)
+                    except AnalysisError:
+                        continue
+                    if isinstance(oe, ir.InputRef) and isinstance(
+                            ie, ir.InputRef):
+                        pair = (oe.index, ie.index)
+                        break
+            if pair is not None:
+                skeys.append(pair[0])
+                fkeys.append(pair[1])
+            else:
+                # general correlated conjunct -> residual over
+                # (source fields, filtering fields)
+                residuals.append(
+                    ExpressionAnalyzer(combined_scope).analyze(c))
+        if not skeys:
+            raise AnalysisError(
+                "EXISTS must correlate on at least one equality")
+        if len(skeys) > 2:
+            raise AnalysisError("EXISTS on >2 correlation keys")
+        from ..expr.rewrite import combine_conjuncts
+        filtering: PlanNode = inner
+        if inner_filters:
+            filtering = FilterNode(child=inner,
+                                   predicate=combine_conjuncts(inner_filters))
+        residual = combine_conjuncts(residuals) if residuals else None
+        return SemiJoinNode(
+            source=source, filtering=filtering, source_keys=tuple(skeys),
+            filtering_keys=tuple(fkeys), fields=source.fields,
+            negated=negated, residual=residual, null_aware=False)
+
+    # -- correlated scalar aggregates (AST pre-pass) --------------------------
+    def _decorrelate_scalar_aggs(
+            self, spec: A.QuerySpecification) -> A.QuerySpecification:
+        """Rewrite `expr CMP (SELECT agg(..) FROM t WHERE t.k = outer.k
+        AND ..)` conjuncts into a LEFT JOIN against a grouped derived table
+        (reference iterative/rule/
+        TransformCorrelatedScalarAggregationToJoin.java). Missing groups
+        yield NULL, which fails the comparison — exactly the scalar
+        subquery's empty-result semantics for min/max/sum/avg (count is
+        rejected: empty groups must yield 0, which a join cannot)."""
+        if spec.where is None or spec.from_ is None:
+            return spec
+        conjs = _split_conjuncts(spec.where)
+        if not any(_find_scalar_subqueries(c) for c in conjs):
+            return spec
+        outer_scope: Optional[Scope] = None
+        new_from = spec.from_
+        new_conjs: List[A.Expression] = []
+        changed = False
+        for c in conjs:
+            subs = _find_scalar_subqueries(c)
+            if len(subs) != 1 or not self._is_correlated(subs[0].query):
+                new_conjs.append(c)
+                continue
+            sub = subs[0]
+            body = sub.query.body
+            if (sub.query.with_ or not isinstance(body, A.QuerySpecification)
+                    or body.group_by or body.having
+                    or body.limit is not None or len(body.select) != 1
+                    or body.from_ is None):
+                raise AnalysisError("unsupported correlated subquery shape")
+            value_expr = body.select[0].value
+            if any(_FUNCTION_ALIASES.get(a.name, a.name) == "count"
+                   for a in _collect_aggs([value_expr])):
+                raise AnalysisError(
+                    "correlated count() subquery is not supported yet")
+            if not _collect_aggs([value_expr]):
+                raise AnalysisError(
+                    "correlated non-aggregate subquery is not supported yet")
+            if outer_scope is None:
+                saved = list(self.init_plans)
+                outer_scope = Scope(self.plan_relation(spec.from_).fields)
+                self.init_plans = saved
+            saved = list(self.init_plans)
+            inner_scope = Scope(self.plan_relation(body.from_).fields)
+            self.init_plans = saved
+            inner_only: List[A.Expression] = []
+            corr_pairs: List[Tuple[A.Expression, A.Expression]] = []
+            for ic in (_split_conjuncts(body.where)
+                       if body.where is not None else []):
+                try:
+                    ExpressionAnalyzer(inner_scope).analyze(ic)
+                    inner_only.append(ic)
+                    continue
+                except AnalysisError:
+                    pass
+                pair = None
+                if isinstance(ic, A.Comparison) and ic.op == "=":
+                    for o_ast, i_ast in ((ic.left, ic.right),
+                                         (ic.right, ic.left)):
+                        try:
+                            ExpressionAnalyzer(outer_scope).analyze(o_ast)
+                            ExpressionAnalyzer(inner_scope).analyze(i_ast)
+                            pair = (o_ast, i_ast)
+                            break
+                        except AnalysisError:
+                            continue
+                if pair is None:
+                    raise AnalysisError(
+                        "cannot decorrelate subquery predicate")
+                corr_pairs.append(pair)
+            if not corr_pairs:
+                raise AnalysisError("cannot decorrelate subquery")
+            n = next(self._ids)
+            alias = f"__corr{n}"
+            knames = [f"__ck{i}" for i in range(len(corr_pairs))]
+            vname = "__cv"
+            derived_spec = A.QuerySpecification(
+                select=tuple(
+                    A.SelectItem(i_ast, kn)
+                    for (_, i_ast), kn in zip(corr_pairs, knames)
+                ) + (A.SelectItem(value_expr, vname),),
+                from_=body.from_,
+                where=_and_all(inner_only),
+                group_by=tuple(i_ast for (_, i_ast) in corr_pairs))
+            derived = A.AliasedRelation(
+                A.SubqueryRelation(A.Query(body=derived_spec)),
+                alias, tuple(knames) + (vname,))
+            on = _and_all([
+                A.Comparison("=", o_ast,
+                             A.DereferenceExpression(
+                                 A.Identifier(alias), A.Identifier(kn)))
+                for (o_ast, _), kn in zip(corr_pairs, knames)])
+            new_from = A.Join("left", new_from, derived, on)
+            new_conjs.append(_replace_node(
+                c, sub,
+                A.DereferenceExpression(A.Identifier(alias),
+                                        A.Identifier(vname))))
+            changed = True
+        if not changed:
+            return spec
+        return dataclasses.replace(spec, from_=new_from,
+                                   where=_and_all(new_conjs))
+
+    def _is_correlated(self, query: A.Query) -> bool:
+        """A subquery is correlated iff it fails to plan standalone."""
+        saved_init = list(self.init_plans)
+        saved_ctes = dict(self.ctes)
+        try:
+            self.plan_query_node(query)
+            return False
+        except AnalysisError:
+            return True
+        finally:
+            self.init_plans = saved_init
+            self.ctes = saved_ctes
 
     def _analyze_with_subqueries(self, expr: A.Expression,
                                  analyzer: ExpressionAnalyzer) -> ir.Expr:
@@ -437,9 +630,34 @@ class _Planner:
         pre = ProjectNode(child=node, exprs=tuple(pre_exprs),
                           fields=tuple(pre_fields))
         out_fields = tuple(pre_fields[:len(group_exprs)]) + tuple(agg_fields)
-        agg_node = AggregationNode(
-            child=pre, group_indices=tuple(range(len(group_exprs))),
-            aggs=tuple(aggs), fields=out_fields)
+        nk = len(group_exprs)
+        if any(a.distinct for a in aggs):
+            # distinct rows of (keys, arg) first, then plain aggregation
+            # (reference iterative/rule/
+            # SingleDistinctAggregationToGroupBy.java)
+            args = {a.arg for a in aggs}
+            if not all(a.distinct for a in aggs) or len(args) != 1 \
+                    or None in args:
+                raise AnalysisError(
+                    "mixed or multi-argument DISTINCT aggregates are not "
+                    "supported yet")
+            arg0 = aggs[0].arg
+            sel = list(range(nk)) + [arg0]
+            dproj = ProjectNode(
+                child=pre,
+                exprs=tuple(ir.input_ref(i, pre_fields[i].type)
+                            for i in sel),
+                fields=tuple(pre_fields[i] for i in sel))
+            dnode = DistinctNode(child=dproj)
+            aggs = [dataclasses.replace(a, arg=nk, distinct=False)
+                    for a in aggs]
+            agg_node = AggregationNode(
+                child=dnode, group_indices=tuple(range(nk)),
+                aggs=tuple(aggs), fields=out_fields)
+        else:
+            agg_node = AggregationNode(
+                child=pre, group_indices=tuple(range(nk)),
+                aggs=tuple(aggs), fields=out_fields)
 
         replacements: Dict[A.Expression, ir.Expr] = {}
         for i, g in enumerate(group_exprs):
@@ -685,7 +903,9 @@ def _split_conjuncts(e: A.Expression) -> List[A.Expression]:
 
 
 def _split_subquery_conjuncts(where: A.Expression):
-    """Separate IN-subquery conjuncts (-> semi joins) from plain ones."""
+    """Separate IN-subquery and [NOT] EXISTS conjuncts (-> semi joins)
+    from plain ones. Entries: ("in", value, query, negated) or
+    ("exists", None, query, negated)."""
     subqueries = []
     remaining: List[A.Expression] = []
     for c in _split_conjuncts(where):
@@ -695,11 +915,13 @@ def _split_subquery_conjuncts(where: A.Expression):
             neg = True
             inner = inner.value
         if isinstance(inner, A.InSubquery):
-            subqueries.append((inner.value, inner.query, neg != inner.negated))
+            subqueries.append(
+                ("in", inner.value, inner.query, neg != inner.negated))
             continue
         if isinstance(inner, A.Exists):
-            raise AnalysisError(
-                "EXISTS subqueries are not supported yet (use IN)")
+            subqueries.append(
+                ("exists", None, inner.query, neg != inner.negated))
+            continue
         remaining.append(c)
     return subqueries, _and_all(remaining)
 
@@ -739,6 +961,54 @@ def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:
         if e is not None:
             walk(e)
     return found
+
+
+def _find_scalar_subqueries(e: A.Expression) -> List[A.ScalarSubquery]:
+    """Top-level scalar subqueries of an expression (no descent into
+    nested subquery bodies)."""
+    found: List[A.ScalarSubquery] = []
+
+    def walk(n):
+        if isinstance(n, A.ScalarSubquery):
+            found.append(n)
+            return
+        if isinstance(n, (A.InSubquery, A.Exists)):
+            if isinstance(n, A.InSubquery):
+                walk(n.value)
+            return
+        if dataclasses.is_dataclass(n) and not isinstance(n, type):
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if isinstance(v, tuple):
+                    for x in v:
+                        if dataclasses.is_dataclass(x):
+                            walk(x)
+                elif dataclasses.is_dataclass(v):
+                    walk(v)
+    walk(e)
+    return found
+
+
+def _replace_node(root, target, replacement):
+    """Structurally replace ``target`` with ``replacement`` in an AST."""
+    if root == target:
+        return replacement
+    if not (dataclasses.is_dataclass(root) and not isinstance(root, type)):
+        return root
+    changed = {}
+    for f in dataclasses.fields(root):
+        v = getattr(root, f.name)
+        if isinstance(v, tuple):
+            nv = tuple(
+                _replace_node(x, target, replacement)
+                if dataclasses.is_dataclass(x) else x for x in v)
+            if nv != v:
+                changed[f.name] = nv
+        elif dataclasses.is_dataclass(v):
+            nv = _replace_node(v, target, replacement)
+            if nv != v:
+                changed[f.name] = nv
+    return dataclasses.replace(root, **changed) if changed else root
 
 
 def _collect_windows(exprs: Sequence[A.Expression]

@@ -41,8 +41,9 @@ def _label(n: PlanNode) -> str:
                 f"L{list(n.left_keys)}=R{list(n.right_keys)}"
                 f"{', unique' if n.build_unique else ''}]")
     if isinstance(n, SemiJoinNode):
+        res = ", residual" if n.residual is not None else ""
         return (f"SemiJoin[{'anti' if n.negated else 'semi'}, "
-                f"key={n.source_key}]")
+                f"keys={list(n.source_keys)}{res}]")
     if isinstance(n, SortNode):
         return f"Sort[{[(k.index, 'asc' if k.ascending else 'desc') for k in n.keys]}]"
     if isinstance(n, TopNNode):

@@ -15,7 +15,8 @@ SF = 0.01
 
 DIST_QUERIES = [t for t in TPCH_QUERIES
                 if t[0] in ("q1", "q3", "q4", "q5", "q6", "q10", "q12",
-                            "q13", "q14", "q18", "q19")]
+                            "q13", "q14", "q16", "q17", "q18", "q19",
+                            "q20", "q21", "q22", "q2")]
 
 
 @pytest.fixture(scope="module")

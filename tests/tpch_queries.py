@@ -58,23 +58,23 @@ order by revenue desc, o_orderdate, l_orderkey
 limit 10
 """)
 
-# Q4 with the correlated EXISTS rewritten as uncorrelated IN (equivalent
-# because the subquery predicate only references lineitem)
 q("q4", """
 select o_orderpriority, count(*) as order_count
 from orders
 where o_orderdate >= date '1993-07-01'
   and o_orderdate < date '1993-07-01' + interval '3' month
-  and o_orderkey in (
-    select l_orderkey from lineitem where l_commitdate < l_receiptdate)
+  and exists (
+    select * from lineitem
+    where l_orderkey = o_orderkey and l_commitdate < l_receiptdate)
 group by o_orderpriority
 order by o_orderpriority
 """, """
 select o_orderpriority, count(*) as order_count
 from orders
 where o_orderdate >= '1993-07-01' and o_orderdate < '1993-10-01'
-  and o_orderkey in (
-    select l_orderkey from lineitem where l_commitdate < l_receiptdate)
+  and exists (
+    select * from lineitem
+    where l_orderkey = o_orderkey and l_commitdate < l_receiptdate)
 group by o_orderpriority
 order by o_orderpriority
 """)
@@ -372,4 +372,127 @@ where (p_partkey = l_partkey and p_brand = 'Brand#12'
     and l_quantity >= 20 and l_quantity <= 30
     and p_size between 1 and 15 and l_shipmode in ('AIR', 'REG AIR')
     and l_shipinstruct = 'DELIVER IN PERSON')
+""")
+
+q("q2", """
+select s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address, s_phone,
+  s_comment
+from part, supplier, partsupp, nation, region
+where p_partkey = ps_partkey and s_suppkey = ps_suppkey and p_size = 15
+  and p_type like '%BRASS' and s_nationkey = n_nationkey
+  and n_regionkey = r_regionkey and r_name = 'EUROPE'
+  and ps_supplycost = (
+    select min(ps_supplycost)
+    from partsupp, supplier, nation, region
+    where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+      and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+      and r_name = 'EUROPE')
+order by s_acctbal desc, n_name, s_name, p_partkey
+limit 100
+""")
+
+q("q16", """
+select p_brand, p_type, p_size, count(distinct ps_suppkey) as supplier_cnt
+from partsupp, part
+where p_partkey = ps_partkey and p_brand <> 'Brand#45'
+  and p_type not like 'MEDIUM POLISHED%'
+  and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+  and ps_suppkey not in (
+    select s_suppkey from supplier
+    where s_comment like '%Customer%Complaints%')
+group by p_brand, p_type, p_size
+order by supplier_cnt desc, p_brand, p_type, p_size
+""")
+
+q("q17", """
+select sum(l_extendedprice) / 7.0 as avg_yearly
+from lineitem, part
+where p_partkey = l_partkey and p_brand = 'Brand#23'
+  and p_container = 'MED BOX'
+  and l_quantity < (
+    select 0.2 * avg(l_quantity) from lineitem
+    where l_partkey = p_partkey)
+""")
+
+q("q20", """
+select s_name, s_address
+from supplier, nation
+where s_suppkey in (
+    select ps_suppkey from partsupp
+    where ps_partkey in (
+        select p_partkey from part where p_name like 'forest%')
+      and ps_availqty > (
+        select 0.5 * sum(l_quantity) from lineitem
+        where l_partkey = ps_partkey and l_suppkey = ps_suppkey
+          and l_shipdate >= date '1994-01-01'
+          and l_shipdate < date '1994-01-01' + interval '1' year))
+  and s_nationkey = n_nationkey and n_name = 'CANADA'
+order by s_name
+""", """
+select s_name, s_address
+from supplier, nation
+where s_suppkey in (
+    select ps_suppkey from partsupp
+    where ps_partkey in (
+        select p_partkey from part where p_name like 'forest%')
+      and ps_availqty > (
+        select 0.5 * sum(l_quantity) from lineitem
+        where l_partkey = ps_partkey and l_suppkey = ps_suppkey
+          and l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'))
+  and s_nationkey = n_nationkey and n_name = 'CANADA'
+order by s_name
+""")
+
+q("q21", """
+select s_name, count(*) as numwait
+from supplier, lineitem l1, orders, nation
+where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey
+  and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate
+  and exists (
+    select * from lineitem l2
+    where l2.l_orderkey = l1.l_orderkey
+      and l2.l_suppkey <> l1.l_suppkey)
+  and not exists (
+    select * from lineitem l3
+    where l3.l_orderkey = l1.l_orderkey
+      and l3.l_suppkey <> l1.l_suppkey
+      and l3.l_receiptdate > l3.l_commitdate)
+  and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA'
+group by s_name
+order by numwait desc, s_name
+limit 100
+""")
+
+q("q22", """
+select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+from (
+  select substring(c_phone, 1, 2) as cntrycode, c_acctbal
+  from customer
+  where substring(c_phone, 1, 2) in
+      ('13', '31', '23', '29', '30', '18', '17')
+    and c_acctbal > (
+      select avg(c_acctbal) from customer
+      where c_acctbal > 0.00 and substring(c_phone, 1, 2) in
+          ('13', '31', '23', '29', '30', '18', '17'))
+    and not exists (
+      select * from orders where o_custkey = c_custkey)
+) as custsale
+group by cntrycode
+order by cntrycode
+""", """
+select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+from (
+  select substr(c_phone, 1, 2) as cntrycode, c_acctbal
+  from customer
+  where substr(c_phone, 1, 2) in
+      ('13', '31', '23', '29', '30', '18', '17')
+    and c_acctbal > (
+      select avg(c_acctbal) from customer
+      where c_acctbal > 0.00 and substr(c_phone, 1, 2) in
+          ('13', '31', '23', '29', '30', '18', '17'))
+    and not exists (
+      select * from orders where o_custkey = c_custkey)
+) as custsale
+group by cntrycode
+order by cntrycode
 """)
