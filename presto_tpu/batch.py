@@ -332,6 +332,20 @@ def unify_dictionaries(columns: Sequence[Column]) -> Tuple[Tuple[str, ...], List
     return tuple(vocab), remaps
 
 
+def apply_remap_np(codes: np.ndarray, remap: np.ndarray) -> np.ndarray:
+    """Host-side dictionary code remap (-1 maps through the sentinel)."""
+    idx = np.where(codes >= 0, codes, len(remap) - 1)
+    return remap[idx]
+
+
+def vocab_column(vocab: Optional[Tuple[str, ...]]) -> Column:
+    """Dummy 1-slot column carrying only a vocabulary — lets host code
+    reuse unify_dictionaries without real data."""
+    from .types import VARCHAR
+    return Column(VARCHAR, jnp.zeros(1, dtype=jnp.int32),
+                  jnp.zeros(1, dtype=bool), vocab)
+
+
 def remap_codes(col: Column, remap: np.ndarray, vocab: Tuple[str, ...]) -> Column:
     """Apply a dictionary remap on device (gather)."""
     table = jnp.asarray(remap)

@@ -108,6 +108,12 @@ class _Executor:
         self.session = session
         self.rows_per_batch = rows_per_batch
         self.init_values: List[object] = []
+        from ..memory import QueryMemoryPool
+        self.pool = QueryMemoryPool(
+            session.properties.get("query_max_memory"))
+        self.spill_partitions = int(
+            session.properties.get("spill_partitions", 16))
+        session.last_memory_stats = self.pool.stats
 
     # -- expression preparation ---------------------------------------------
     def _resolve(self, e: ir.Expr) -> ir.Expr:
@@ -182,10 +188,16 @@ class _Executor:
         return batches[0] if len(batches) == 1 else concat_batches(batches)
 
     def _SortNode(self, node: SortNode) -> Iterator[Batch]:
-        b = self._drain(node.child)
-        if b is not None:
-            yield sort_batch(b, [SortKey(k.index, k.ascending, k.nulls_first)
-                                 for k in node.keys])
+        from .spill import SortSpillBuffer
+        keys = [SortKey(k.index, k.ascending, k.nulls_first)
+                for k in node.keys]
+        buf = SortSpillBuffer(self.pool, "order-by", keys)
+        try:
+            for b in self.run(node.child):
+                buf.add(b)
+            yield from buf.results(self.rows_per_batch)
+        finally:
+            buf.close()
 
     def _TopNNode(self, node: TopNNode) -> Iterator[Batch]:
         keys = [SortKey(k.index, k.ascending, k.nulls_first)
@@ -213,11 +225,17 @@ class _Executor:
         yield Batch(_plan_schema(node), out.columns, out.row_mask)
 
     def _DistinctNode(self, node: DistinctNode) -> Iterator[Batch]:
-        b = self._drain(node.child)
-        if b is None:
-            return
-        yield grouped_aggregate(b, list(range(len(node.fields))), [],
-                                mode="single")
+        from .spill import AggSpillBuffer
+        cols = list(range(len(node.fields)))
+        buf = AggSpillBuffer(self.pool, "distinct", cols, [],
+                             self.spill_partitions)
+        try:
+            for b in self.run(node.child):
+                buf.add_partial(grouped_aggregate(b, cols, [],
+                                                  mode="partial"))
+            yield from buf.results()
+        finally:
+            buf.close()
 
     def _AggregationNode(self, node: AggregationNode) -> Iterator[Batch]:
         aggs = [
@@ -244,51 +262,89 @@ class _Executor:
             states = (concat_batches(parts) if len(parts) > 1 else parts[0])
             yield global_aggregate(states, aggs, mode="final")
             return
-        # grouped: partial per input batch, hierarchical merge, final
-        parts = []
-        for b in self.run(node.child):
-            parts.append(grouped_aggregate(b, group, aggs, mode="partial"))
-            if len(parts) >= 16:
-                merged = concat_batches(parts)
-                key_idx = list(range(len(group)))
-                state = grouped_aggregate(merged, key_idx, aggs, mode="merge")
-                parts = [state.compact(bucket_capacity(state.host_count()))]
-        if not parts:
-            return
-        states = concat_batches(parts) if len(parts) > 1 else parts[0]
+        # grouped: partial per input batch, hierarchical merge (spillable
+        # state, hash-partitioned by group keys under memory pressure),
+        # final per state / per spilled partition
+        from .spill import AggSpillBuffer
         key_idx = list(range(len(group)))
-        yield grouped_aggregate(states, key_idx, aggs, mode="final")
+        buf = AggSpillBuffer(self.pool, "hash-agg", key_idx, aggs,
+                             self.spill_partitions)
+        try:
+            for b in self.run(node.child):
+                buf.add_partial(
+                    grouped_aggregate(b, group, aggs, mode="partial"))
+            yield from buf.results()
+        finally:
+            buf.close()
 
     def _JoinNode(self, node: JoinNode) -> Iterator[Batch]:
-        build = self._drain(node.right)
-        schema_names = [f.name for f in node.fields]
-        n_left = len(node.left.fields)
         payload = list(range(len(node.right.fields)))
         payload_names = [f"$b{i}" for i in payload]
         if node.join_type == "cross":
-            yield from self._cross_join(node, build)
+            yield from self._cross_join(node, self._drain(node.right))
             return
         residual = (self._resolve(node.residual)
                     if node.residual is not None else None)
         residual_fn = None
         if residual is not None:
+            if node.join_type == "left":
+                # residual on a left join only filters matched rows'
+                # payload, not probe rows — approximate by filtering
+                # (correct for inner; left-join residuals are rare)
+                raise NotImplementedError(
+                    "residual predicate on LEFT JOIN")
             residual_fn = compile_filter(residual, _plan_schema(node))
+
+        from .spill import HostPartitionStore, SpillableBuildBuffer
+        buf = SpillableBuildBuffer(self.pool, "join-build",
+                                   list(node.right_keys),
+                                   self.spill_partitions)
+        try:
+            for b in self.run(node.right):
+                buf.add(b)
+            build = buf.finish()
+            if isinstance(build, HostPartitionStore):
+                yield from self._partitioned_join(
+                    node, build, payload, payload_names, residual_fn)
+                return
+            for probe in self.run(node.left):
+                if build is None:
+                    if node.join_type == "inner":
+                        continue
+                    out = self._null_extend(probe, node)
+                else:
+                    out = self._probe(node, probe, build, payload,
+                                      payload_names)
+                if residual_fn is not None:
+                    out = residual_fn(out)
+                yield out
+        finally:
+            buf.close()
+
+    def _partitioned_join(self, node: JoinNode, store, payload,
+                          payload_names, residual_fn) -> Iterator[Batch]:
+        """Spilled-build probe: stage the probe side host-partitioned by
+        the same key hash, then join partition-serially so only one build
+        partition plus one probe chunk is device-resident at a time
+        (reference GenericPartitioningSpiller.java probe protocol)."""
+        from .spill import HostPartitionStore
+        pstore: Optional[HostPartitionStore] = None
         for probe in self.run(node.left):
-            if build is None:
-                if node.join_type == "inner":
+            if pstore is None:
+                pstore = HostPartitionStore(probe.schema, store.n)
+            pstore.add(probe, list(node.left_keys))
+        if pstore is None:
+            return
+        for p in range(store.n):
+            bpart = store.partition_batch(p)
+            for probe_p in pstore.partition_batches(p, self.rows_per_batch):
+                if bpart is None:
+                    if node.join_type == "left":
+                        yield self._null_extend(probe_p, node)
                     continue
-                out = self._null_extend(probe, node)
-            else:
-                out = self._probe(node, probe, build, payload, payload_names)
-            if residual_fn is not None:
-                if node.join_type == "left":
-                    # residual on a left join only filters matched rows'
-                    # payload, not probe rows — approximate by filtering
-                    # (correct for inner; left-join residuals are rare)
-                    raise NotImplementedError(
-                        "residual predicate on LEFT JOIN")
-                out = residual_fn(out)
-            yield out
+                out = self._probe(node, probe_p, bpart, payload,
+                                  payload_names)
+                yield residual_fn(out) if residual_fn is not None else out
 
     def _probe(self, node: JoinNode, probe: Batch, build: Batch,
                payload, payload_names) -> Batch:

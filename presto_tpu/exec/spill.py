@@ -1,0 +1,382 @@
+"""Host-DRAM spill: hash-partitioned staging for join/agg state, full
+chunk staging for sort.
+
+The TPU reshape of the reference's spill stack (reference
+presto-main/.../spiller/GenericPartitioningSpiller.java for partitioned
+join spill, operator/aggregation/builder/SpillableHashAggregationBuilder.java
+for agg state, OrderByOperator.java + FileSingleStreamSpiller.java for
+sort): the "disk" is host DRAM (device_get), the natural first spill tier
+on a TPU host, and partition ids are computed ON DEVICE with the same
+value-based splitmix64 row hash the exchange uses — so a spilled build
+partition and its probe partition agree by construction, including for
+dictionary-encoded strings (hashed by VALUE, not per-chunk code).
+
+Buffers accumulate device batches against an OperatorMemoryContext; when
+the pool can't fit the next batch (or another operator revokes them) they
+stage everything to host numpy arrays and keep accepting input host-side.
+Each staged chunk is bucketed once at staging time (argsort of partition
+ids), so per-partition readback is slicing, not a rescan.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..batch import (
+    Batch, Schema, apply_remap_np, bucket_capacity, concat_batches,
+    unify_dictionaries, vocab_column,
+)
+from ..memory import QueryMemoryPool, batch_device_bytes
+from ..ops.aggregation import AggSpec, grouped_aggregate
+from ..ops.sort import SortKey, sort_batch
+from ..parallel.exchange import hash_partition_ids
+
+
+@dataclasses.dataclass
+class _StagedChunk:
+    datas: List[np.ndarray]
+    valids: List[np.ndarray]
+    dicts: List[Optional[Tuple[str, ...]]]
+    part_rows: np.ndarray              # live row indices, partition-sorted
+    bounds: Optional[np.ndarray]       # partition p = part_rows[b[p]:b[p+1]]
+
+    def rows_of(self, p: Optional[int]) -> np.ndarray:
+        if p is None or self.bounds is None:
+            return self.part_rows
+        return self.part_rows[self.bounds[p]:self.bounds[p + 1]]
+
+
+def _stage_chunk(batch: Batch, pid=None,
+                 n_partitions: Optional[int] = None) -> _StagedChunk:
+    mask = np.asarray(batch.row_mask)
+    live = np.nonzero(mask)[0]
+    if pid is None:
+        part_rows, bounds = live, None
+    else:
+        p = np.asarray(pid)[live]
+        order = np.argsort(p, kind="stable")
+        part_rows = live[order]
+        bounds = np.searchsorted(p[order], np.arange(n_partitions + 1))
+    return _StagedChunk(
+        datas=[np.asarray(c.data) for c in batch.columns],
+        valids=[np.asarray(c.validity) for c in batch.columns],
+        dicts=[c.dictionary for c in batch.columns],
+        part_rows=part_rows, bounds=bounds)
+
+
+def _gather_chunks(schema: Schema,
+                   selections: Iterable[Tuple[_StagedChunk, np.ndarray]]):
+    """Concatenate selected rows across staged chunks, unifying string
+    dictionaries incrementally. Returns (arrays, validity, vocabs) or
+    None when no rows are selected."""
+    ncols = len(schema)
+    datas: List[List[np.ndarray]] = [[] for _ in range(ncols)]
+    valids: List[List[np.ndarray]] = [[] for _ in range(ncols)]
+    vocabs: List[Optional[Tuple[str, ...]]] = [None] * ncols
+    any_rows = False
+    for ch, rows in selections:
+        if rows.size == 0:
+            continue
+        any_rows = True
+        for ci in range(ncols):
+            d = ch.datas[ci][rows]
+            v = ch.valids[ci][rows]
+            if ch.dicts[ci] is not None:
+                if vocabs[ci] is None:
+                    vocabs[ci] = ch.dicts[ci]
+                elif vocabs[ci] != ch.dicts[ci]:
+                    merged, remaps = unify_dictionaries(
+                        [vocab_column(vocabs[ci]),
+                         vocab_column(ch.dicts[ci])])
+                    vocabs[ci] = merged
+                    datas[ci] = [apply_remap_np(a, remaps[0])
+                                 for a in datas[ci]]
+                    d = apply_remap_np(d, remaps[1])
+            datas[ci].append(d)
+            valids[ci].append(v)
+    if not any_rows:
+        return None
+    arrays = [np.concatenate(datas[ci]) for ci in range(ncols)]
+    valid_arr = [np.concatenate(valids[ci]) for ci in range(ncols)]
+    return arrays, valid_arr, vocabs
+
+
+class HostPartitionStore:
+    """Rows staged to host DRAM, hash-partitioned by key columns."""
+
+    def __init__(self, schema: Schema, n_partitions: int):
+        self.schema = schema
+        self.n = n_partitions
+        self.chunks: List[_StagedChunk] = []
+
+    def add(self, batch: Batch, key_cols: Sequence[int]) -> int:
+        """Stage a device batch; returns the device bytes it occupied."""
+        pid = hash_partition_ids(batch, list(key_cols), self.n)
+        self.chunks.append(_stage_chunk(batch, pid, self.n))
+        return batch_device_bytes(batch)
+
+    def _partition_arrays(self, p: int):
+        return _gather_chunks(
+            self.schema, ((ch, ch.rows_of(p)) for ch in self.chunks))
+
+    def partition_batch(self, p: int) -> Optional[Batch]:
+        """The whole partition as one device batch (build sides)."""
+        got = self._partition_arrays(p)
+        if got is None:
+            return None
+        arrays, valids, vocabs = got
+        n = len(arrays[0]) if arrays else 0
+        if n == 0:
+            return None
+        return Batch.from_arrays(self.schema, arrays, valids, vocabs,
+                                 num_rows=n)
+
+    def partition_batches(self, p: int,
+                          rows_per_batch: int) -> Iterator[Batch]:
+        """The partition streamed in bounded device chunks (probe sides)."""
+        got = self._partition_arrays(p)
+        if got is None:
+            return
+        arrays, valids, vocabs = got
+        n = len(arrays[0]) if arrays else 0
+        for lo in range(0, n, rows_per_batch):
+            hi = min(lo + rows_per_batch, n)
+            yield Batch.from_arrays(
+                self.schema, [a[lo:hi] for a in arrays],
+                [v[lo:hi] for v in valids], vocabs, num_rows=hi - lo)
+
+
+class SpillableBuildBuffer:
+    """Join-build-side accumulator: device-resident until the pool forces
+    host staging (reference HashBuilderOperator spill states :155-180).
+    finish() returns None (empty), a device Batch, or a
+    HostPartitionStore for partitioned probing."""
+
+    def __init__(self, pool: QueryMemoryPool, name: str,
+                 key_cols: Sequence[int], n_partitions: int):
+        self.ctx = pool.context(name, revoke_cb=self._spill_all)
+        self.key_cols = list(key_cols)
+        self.n_partitions = n_partitions
+        self.device: List[Batch] = []
+        self.store: Optional[HostPartitionStore] = None
+        self.spilled = False
+
+    def add(self, b: Batch) -> None:
+        if self.spilled:
+            self._stage(b)
+            return
+        nb = batch_device_bytes(b)
+        if self.ctx.pool.try_reserve(nb, self.ctx):
+            self.device.append(b)
+        else:
+            self.ctx.revoke()   # spills everything accumulated so far
+            self._stage(b)
+
+    def _stage(self, b: Batch) -> int:
+        if self.store is None:
+            self.store = HostPartitionStore(b.schema, self.n_partitions)
+        n = self.store.add(b, self.key_cols)
+        self.ctx.pool.stats.spilled_bytes += n
+        return n
+
+    def _spill_all(self) -> int:
+        freed = 0
+        for b in self.device:
+            freed += self._stage(b)
+        self.device = []
+        self.spilled = True
+        return freed
+
+    def finish(self):
+        # once the build is handed to the prober, revoking can no longer
+        # free its device memory — keep the reservation, end revocability
+        self.ctx.pin()
+        if self.spilled:
+            return self.store
+        if not self.device:
+            return None
+        return (self.device[0] if len(self.device) == 1
+                else concat_batches(self.device))
+
+    def close(self) -> None:
+        self.ctx.close()
+
+
+class AggSpillBuffer:
+    """Grouped-aggregation state accumulator: merges partial-state batches
+    on device; under memory pressure stages states to host partitioned by
+    group-key hash, finalizing partition-serially (reference
+    SpillableHashAggregationBuilder.java + MergingHashAggregationBuilder).
+    Group keys are disjoint across hash partitions, so per-partition FINAL
+    results concatenate to the global answer."""
+
+    def __init__(self, pool: QueryMemoryPool, name: str,
+                 key_idx: Sequence[int], aggs: Sequence[AggSpec],
+                 n_partitions: int, merge_every: int = 16):
+        self.ctx = pool.context(name, revoke_cb=self._spill_all)
+        self.key_idx = list(key_idx)
+        self.aggs = list(aggs)
+        self.n_partitions = n_partitions
+        self.merge_every = merge_every
+        self.device: List[Batch] = []
+        self.store: Optional[HostPartitionStore] = None
+        self.spilled = False
+
+    def add_partial(self, partial: Batch) -> None:
+        if self.spilled:
+            self._stage(partial)
+            return
+        nb = batch_device_bytes(partial)
+        if self.ctx.pool.try_reserve(nb, self.ctx):
+            self.device.append(partial)
+            if len(self.device) >= self.merge_every:
+                self._merge_device()
+        else:
+            self.ctx.revoke()
+            self._stage(partial)
+
+    def _merge_device(self) -> None:
+        merged = grouped_aggregate(concat_batches(self.device),
+                                   self.key_idx, self.aggs, mode="merge")
+        state = merged.compact(bucket_capacity(max(merged.host_count(), 1)))
+        self.ctx.release_all()
+        self.device = []
+        if self.ctx.pool.try_reserve(batch_device_bytes(state), self.ctx):
+            self.device = [state]
+        else:
+            self._stage(state)
+            self.spilled = True
+
+    def _stage(self, b: Batch) -> int:
+        if self.store is None:
+            self.store = HostPartitionStore(b.schema, self.n_partitions)
+        n = self.store.add(b, self.key_idx)
+        self.ctx.pool.stats.spilled_bytes += n
+        return n
+
+    def _spill_all(self) -> int:
+        freed = 0
+        for b in self.device:
+            freed += self._stage(b)
+        self.device = []
+        self.spilled = True
+        return freed
+
+    def results(self) -> Iterator[Batch]:
+        self.ctx.pin()   # consumers hold the yielded state from here on
+        if not self.spilled:
+            if not self.device:
+                return
+            states = (self.device[0] if len(self.device) == 1
+                      else concat_batches(self.device))
+            yield grouped_aggregate(states, self.key_idx, self.aggs,
+                                    mode="final")
+            return
+        for p in range(self.n_partitions):
+            part = None if self.store is None else \
+                self.store.partition_batch(p)
+            if part is None:
+                continue
+            yield grouped_aggregate(part, self.key_idx, self.aggs,
+                                    mode="final")
+
+    def close(self) -> None:
+        self.ctx.close()
+
+
+class SortSpillBuffer:
+    """ORDER BY accumulator: device sort when everything fits; otherwise
+    raw chunks stage to host and the final ordering is one np.lexsort over
+    sortable operands replicating ops.sort._sortable's transforms
+    (reference OrderByOperator spill; the host takes the role of
+    FileSingleStreamSpiller's disk)."""
+
+    def __init__(self, pool: QueryMemoryPool, name: str,
+                 keys: Sequence[SortKey]):
+        self.ctx = pool.context(name, revoke_cb=self._spill_all)
+        self.keys = list(keys)
+        self.device: List[Batch] = []
+        self.chunks: List[_StagedChunk] = []
+        self.schema: Optional[Schema] = None
+        self.spilled = False
+
+    def add(self, b: Batch) -> None:
+        self.schema = b.schema
+        if self.spilled:
+            self._stage(b)
+            return
+        nb = batch_device_bytes(b)
+        if self.ctx.pool.try_reserve(nb, self.ctx):
+            self.device.append(b)
+        else:
+            self.ctx.revoke()
+            self._stage(b)
+
+    def _stage(self, b: Batch) -> int:
+        n = batch_device_bytes(b)
+        self.chunks.append(_stage_chunk(b))
+        self.ctx.pool.stats.spilled_bytes += n
+        return n
+
+    def _spill_all(self) -> int:
+        freed = 0
+        for b in self.device:
+            freed += self._stage(b)
+        self.device = []
+        self.spilled = True
+        return freed
+
+    def results(self, rows_per_batch: int) -> Iterator[Batch]:
+        self.ctx.pin()
+        if not self.spilled:
+            if not self.device:
+                return
+            merged = (self.device[0] if len(self.device) == 1
+                      else concat_batches(self.device))
+            yield sort_batch(merged, self.keys)
+            return
+        yield from self._host_sorted(rows_per_batch)
+
+    def _host_sorted(self, rows_per_batch: int) -> Iterator[Batch]:
+        schema = self.schema
+        got = _gather_chunks(
+            schema, ((ch, ch.rows_of(None)) for ch in self.chunks))
+        if got is None:
+            return
+        arrays, valid_arr, vocabs = got
+        operands: List[np.ndarray] = []
+        for k in self.keys:
+            operands.extend(_np_sortable(
+                arrays[k.column], valid_arr[k.column], vocabs[k.column],
+                schema.types[k.column], k))
+        # lexsort: last key is primary -> reverse; stable like lax.sort
+        perm = np.lexsort(tuple(reversed(operands)))
+        n = len(perm)
+        for lo in range(0, n, rows_per_batch):
+            idx = perm[lo:min(lo + rows_per_batch, n)]
+            yield Batch.from_arrays(
+                schema, [a[idx] for a in arrays],
+                [v[idx] for v in valid_arr], vocabs, num_rows=len(idx))
+
+    def close(self) -> None:
+        self.ctx.close()
+
+
+def _np_sortable(data: np.ndarray, valid: np.ndarray,
+                 vocab: Optional[Tuple[str, ...]], typ,
+                 key: SortKey) -> List[np.ndarray]:
+    """Host replica of ops.sort._sortable: [null_rank, data'] ascending."""
+    if typ.is_string:
+        v = np.asarray(vocab or ("",), dtype=object)
+        rank = np.argsort(np.argsort(v))
+        data = rank[np.where(data >= 0, data, 0)]
+    if data.dtype == np.bool_:
+        data = data.astype(np.int32)
+    if not key.ascending:
+        data = -data if np.issubdtype(data.dtype, np.floating) else ~data
+    nulls_first = key.effective_nulls_first()
+    null_rank = (np.where(valid, 1, 0) if nulls_first
+                 else np.where(valid, 0, 1)).astype(np.int32)
+    return [null_rank, data]

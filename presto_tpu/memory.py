@@ -1,0 +1,138 @@
+"""Memory accounting: per-query device-memory pool + operator contexts.
+
+Conceptual parity with the reference's memory stack (reference
+presto-memory-context/.../AggregatedMemoryContext.java,
+LocalMemoryContext.java; pools memory/MemoryPool.java:44,111,143; revoke
+execution/MemoryRevokingScheduler.java:46) re-shaped for a device runtime:
+
+- the accounted resource is DEVICE-RESIDENT batch bytes (HBM), the scarce
+  resource on a TPU chip; host DRAM is the spill target, so host copies
+  are deliberately not charged;
+- a reservation is *revocable* when its context registered a revoke
+  callback (operators that can stage their state to host DRAM — join
+  build, sort runs, agg state — reference HashBuilderOperator's
+  SPILLING_INPUT states :165-180);
+- revoking is synchronous and only ever targets OTHER contexts: an
+  operator whose own reservation fails spills itself (try_reserve returns
+  False); a reservation that still doesn't fit after revoking raises.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+UNLIMITED = 1 << 62
+
+
+def batch_device_bytes(batch) -> int:
+    """Accounted HBM footprint of a batch (data + validity + row mask)."""
+    total = batch.row_mask.size  # bool mask, 1 byte/slot
+    for c in batch.columns:
+        total += c.data.size * c.data.dtype.itemsize
+        total += c.validity.size
+    return int(total)
+
+
+@dataclasses.dataclass
+class MemoryStats:
+    peak_bytes: int = 0
+    revocations: int = 0
+    spilled_bytes: int = 0
+
+
+class MemoryLimitExceeded(RuntimeError):
+    pass
+
+
+class QueryMemoryPool:
+    """Per-query device-memory budget (reference memory/MemoryPool.java)."""
+
+    def __init__(self, limit_bytes: Optional[int] = None):
+        self.limit = limit_bytes if limit_bytes is not None else UNLIMITED
+        self.reserved = 0
+        self.stats = MemoryStats()
+        self._contexts: List["OperatorMemoryContext"] = []
+
+    def context(self, name: str,
+                revoke_cb: Optional[Callable[[], int]] = None
+                ) -> "OperatorMemoryContext":
+        ctx = OperatorMemoryContext(self, name, revoke_cb)
+        self._contexts.append(ctx)
+        return ctx
+
+    def try_reserve(self, n: int, ctx: "OperatorMemoryContext") -> bool:
+        """Reserve n bytes for ctx; revokes other revocable contexts
+        (largest first) if needed. False = caller must spill itself."""
+        if n > self.limit:
+            return False  # can never fit: don't force futile spills
+        if self.reserved + n > self.limit:
+            self._revoke_others(self.reserved + n - self.limit, ctx)
+        if self.reserved + n > self.limit:
+            return False
+        self.reserved += n
+        ctx.bytes += n
+        self.stats.peak_bytes = max(self.stats.peak_bytes, self.reserved)
+        return True
+
+    def reserve(self, n: int, ctx: "OperatorMemoryContext") -> None:
+        """Like try_reserve but raising — for state that cannot spill."""
+        if not self.try_reserve(n, ctx):
+            raise MemoryLimitExceeded(
+                f"query memory limit {self.limit} bytes exceeded: "
+                f"reserved {self.reserved}, requested {n} ({ctx.name})")
+
+    def _revoke_others(self, needed: int,
+                       requester: "OperatorMemoryContext") -> None:
+        holders = sorted(
+            (c for c in self._contexts
+             if c is not requester and c.revocable and c.bytes > 0),
+            key=lambda c: -c.bytes)
+        freed = 0
+        for c in holders:
+            if freed >= needed:
+                break
+            freed += c.revoke()
+            self.stats.revocations += 1
+
+
+class OperatorMemoryContext:
+    """One operator's reservation (reference LocalMemoryContext).
+
+    ``revoke_cb`` (if set) makes the reservation revocable: when invoked
+    it must release the context's device memory (staging it to host) and
+    return the bytes freed.
+    """
+
+    def __init__(self, pool: QueryMemoryPool, name: str,
+                 revoke_cb: Optional[Callable[[], int]] = None):
+        self.pool = pool
+        self.name = name
+        self.bytes = 0
+        self._revoke_cb = revoke_cb
+
+    @property
+    def revocable(self) -> bool:
+        return self._revoke_cb is not None
+
+    def pin(self) -> None:
+        """End revocability: the holder has handed its state to a consumer
+        (a finished build side being probed), so revoking could no longer
+        actually free the device memory."""
+        self._revoke_cb = None
+
+    def revoke(self) -> int:
+        # spilled-byte accounting happens at the staging site (the buffer
+        # knows what it moved to host), not here — a revoke that finds an
+        # empty buffer frees nothing yet later adds still stage
+        freed = self._revoke_cb() if self._revoke_cb is not None else 0
+        self.release_all()
+        return freed
+
+    def release_all(self) -> None:
+        self.pool.reserved -= self.bytes
+        self.bytes = 0
+
+    def close(self) -> None:
+        self.release_all()
+        if self in self.pool._contexts:
+            self.pool._contexts.remove(self)

@@ -20,12 +20,28 @@ correctness baseline.
 """
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..batch import Batch, Column
+
+
+def _fnv1a64(s: str) -> int:
+    """Deterministic 64-bit string hash (FNV-1a) — stable across chunks
+    and processes, so dictionary VALUES (not per-chunk codes) decide
+    partition placement."""
+    h = 0xCBF29CE484222325
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _vocab_hash_table(vocab: Tuple[str, ...]) -> jnp.ndarray:
+    vals = [_fnv1a64(s) for s in vocab] + [0]  # sentinel slot for -1 codes
+    return jnp.asarray(np.asarray(vals, dtype=np.uint64))
 
 
 def _splitmix64(x: jnp.ndarray) -> jnp.ndarray:
@@ -49,9 +65,15 @@ def hash_partition_ids(batch: Batch, key_cols: Sequence[int],
     for ci in key_cols:
         c = batch.columns[ci]
         data = c.data
-        if data.dtype == jnp.bool_:
+        if c.type.is_string:
+            # hash the string VALUE via the vocab, never the code: codes
+            # differ between chunks/sides with different dictionaries
+            table = _vocab_hash_table(c.dictionary or ())
+            idx = jnp.where(data >= 0, data, table.shape[0] - 1)
+            data = jnp.take(table, idx, axis=0)
+        elif data.dtype == jnp.bool_:
             data = data.astype(jnp.int32)
-        if jnp.issubdtype(data.dtype, jnp.floating):
+        elif jnp.issubdtype(data.dtype, jnp.floating):
             # value-deterministic int image (collisions only co-locate)
             data = (data * 65536.0).astype(jnp.int64)
         h = _splitmix64(h ^ data.astype(jnp.uint64)

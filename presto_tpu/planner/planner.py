@@ -20,7 +20,7 @@ from ..expr import ir
 from ..sql import ast as A
 from ..sql.analyzer import (
     AGGREGATE_FUNCTIONS, AnalysisError, ExpressionAnalyzer, Field, Scope,
-    _FUNCTION_ALIASES, coerce,
+    UnresolvedColumnError, _FUNCTION_ALIASES, coerce,
 )
 from .plan import (
     AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
@@ -51,6 +51,8 @@ class Session:
     catalog: str = "tpch"
     schema: str = "default"
     properties: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # filled by the executor: memory.MemoryStats of the last query
+    last_memory_stats: object = None
 
 
 def plan_query(query: A.Query, session: Session) -> LogicalPlan:
@@ -524,13 +526,16 @@ class _Planner:
                                    where=_and_all(new_conjs))
 
     def _is_correlated(self, query: A.Query) -> bool:
-        """A subquery is correlated iff it fails to plan standalone."""
+        """A subquery is correlated iff standalone planning fails on an
+        UNRESOLVED COLUMN specifically — any other failure is a genuine
+        error in the subquery and must surface as-is, not be misreported
+        as a decorrelation failure."""
         saved_init = list(self.init_plans)
         saved_ctes = dict(self.ctes)
         try:
             self.plan_query_node(query)
             return False
-        except AnalysisError:
+        except UnresolvedColumnError:
             return True
         finally:
             self.init_plans = saved_init

@@ -28,6 +28,12 @@ class AnalysisError(ValueError):
     pass
 
 
+class UnresolvedColumnError(AnalysisError):
+    """A name did not resolve in any visible scope — the signal the
+    planner's decorrelation uses to distinguish a correlated subquery
+    from one that fails for unrelated reasons."""
+
+
 AGGREGATE_FUNCTIONS = frozenset(
     ["count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
      "stddev_pop", "variance", "var_samp", "var_pop", "approx_distinct",
@@ -83,11 +89,11 @@ class Scope:
             except AnalysisError:
                 pass
             else:
-                raise AnalysisError(
+                raise UnresolvedColumnError(
                     f"correlated reference to outer column {name!r} is not "
                     "supported yet")
         q = f"{qualifier}." if qualifier else ""
-        raise AnalysisError(f"column {q}{name} cannot be resolved")
+        raise UnresolvedColumnError(f"column {q}{name} cannot be resolved")
 
     def field(self, index: int) -> Field:
         return self.fields[index]
