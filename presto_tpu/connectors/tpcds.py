@@ -1,0 +1,397 @@
+"""TPC-DS data-generator connector (star-schema subset).
+
+Conceptual parity with presto-tpcds (reference presto-tpcds/src/main/java/
+io/prestosql/plugin/tpcds/TpcdsMetadata.java, TpcdsRecordSetProvider
+wrapping the teradata tpcds generators), built with the same TPU-first
+design as the TPC-H connector (connectors/tpch.py): every column is a
+stateless splitmix64 hash of the row's surrogate key, so any split can
+generate any row range referentially consistently and in parallel.
+
+Tables are the star-schema subset the BASELINE q27/q55 configs touch:
+``store_sales`` (fact), ``date_dim``, ``item``, ``store``,
+``customer_demographics``. Distributions follow the spec's shapes
+(demographics are the spec's exact cross-product encoding; date_dim is a
+real calendar); exact dsdgen bit-compatibility is NOT a goal —
+correctness tests compare against an oracle over this same data.
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..batch import Batch, Schema
+from .spi import (
+    ColumnStats, Connector, ConnectorMetadata, ConnectorSplitManager,
+    PageSource, Split, TableHandle, TableStats,
+)
+from .tpch import _U64, _h, _money, _pick, _randint
+
+# date_dim spans 1900-01-01 .. 2100-01-01 (spec); sk = julian day number,
+# stored here as days since 1900-01-01 plus the spec's base surrogate
+D_BASE_SK = 2415022            # julian day of 1900-01-01 (spec's first sk)
+D_DAYS = 73_049                # rows in date_dim (fixed, scale-independent)
+_EPOCH_1900 = datetime.date(1900, 1, 1)
+
+# fact sales dates concentrate in 1998-2002 (spec's active window)
+SALES_D0 = (datetime.date(1998, 1, 1) - _EPOCH_1900).days
+SALES_D1 = (datetime.date(2003, 1, 1) - _EPOCH_1900).days
+
+GENDERS = ("M", "F")
+MARITAL = ("M", "S", "D", "W", "U")
+EDUCATION = ("Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown")
+CD_PURCHASE_MAX = 20           # purchase estimate buckets (500,1000,..)
+CREDIT_RATING = ("Low Risk", "Good", "High Risk", "Unknown")
+N_DEMOGRAPHICS = (len(GENDERS) * len(MARITAL) * len(EDUCATION)
+                  * CD_PURCHASE_MAX * len(CREDIT_RATING)
+                  * 7 * 7 * 7)   # dep, dep_employed, dep_college counts 0-6
+
+STATES = ("TN", "TN", "TN", "TN", "TN", "TN", "AL", "GA", "KY", "NC",
+          "OH", "TX", "VA", "MO", "SC")   # TN-heavy like dsdgen defaults
+CATEGORIES = ("Books", "Children", "Electronics", "Home", "Jewelry",
+              "Men", "Music", "Shoes", "Sports", "Women")
+
+
+def _rows(table: str, sf: float) -> int:
+    if table == "store_sales":
+        return int(2_880_000 * sf)
+    if table == "date_dim":
+        return D_DAYS
+    if table == "item":
+        return max(1, int(18_000 * max(sf, 1) ** 0.5))
+    if table == "store":
+        return max(1, int(12 * max(sf, 1) ** 0.5))
+    if table == "customer_demographics":
+        return 1_920_800     # fixed cross-product (spec)
+    raise KeyError(table)
+
+
+V = T.VARCHAR
+_SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
+    "store_sales": [
+        ("ss_sold_date_sk", T.BIGINT), ("ss_item_sk", T.BIGINT),
+        ("ss_customer_sk", T.BIGINT), ("ss_cdemo_sk", T.BIGINT),
+        ("ss_store_sk", T.BIGINT), ("ss_ticket_number", T.BIGINT),
+        ("ss_quantity", T.INTEGER), ("ss_wholesale_cost", T.DOUBLE),
+        ("ss_list_price", T.DOUBLE), ("ss_sales_price", T.DOUBLE),
+        ("ss_ext_sales_price", T.DOUBLE), ("ss_coupon_amt", T.DOUBLE),
+        ("ss_net_paid", T.DOUBLE), ("ss_net_profit", T.DOUBLE),
+    ],
+    "date_dim": [
+        ("d_date_sk", T.BIGINT), ("d_date", T.DATE),
+        ("d_year", T.INTEGER), ("d_moy", T.INTEGER),
+        ("d_dom", T.INTEGER), ("d_qoy", T.INTEGER),
+        ("d_day_name", T.varchar(9)),
+    ],
+    "item": [
+        ("i_item_sk", T.BIGINT), ("i_item_id", T.varchar(16)),
+        ("i_brand_id", T.INTEGER), ("i_brand", T.varchar(50)),
+        ("i_manufact_id", T.INTEGER), ("i_manager_id", T.INTEGER),
+        ("i_category_id", T.INTEGER), ("i_category", T.varchar(50)),
+        ("i_current_price", T.DOUBLE),
+    ],
+    "store": [
+        ("s_store_sk", T.BIGINT), ("s_store_id", T.varchar(16)),
+        ("s_store_name", T.varchar(50)), ("s_state", T.varchar(2)),
+        ("s_number_employees", T.INTEGER),
+    ],
+    "customer_demographics": [
+        ("cd_demo_sk", T.BIGINT), ("cd_gender", T.varchar(1)),
+        ("cd_marital_status", T.varchar(1)),
+        ("cd_education_status", T.varchar(20)),
+        ("cd_purchase_estimate", T.INTEGER),
+        ("cd_credit_rating", T.varchar(10)),
+        ("cd_dep_count", T.INTEGER),
+        ("cd_dep_employed_count", T.INTEGER),
+        ("cd_dep_college_count", T.INTEGER),
+    ],
+}
+
+TABLES = tuple(_SCHEMAS)
+
+_DAY_NAMES = ("Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+              "Saturday", "Sunday")
+_BRANDS = tuple(f"Brand#{i}" for i in range(1, 1001))
+
+
+class _Gen:
+    """Column generators keyed by 1-based surrogate row keys."""
+
+    def __init__(self, sf: float):
+        self.sf = sf
+        self.n_item = _rows("item", sf)
+        self.n_store = _rows("store", sf)
+        self.n_demo = _rows("customer_demographics", sf)
+        self.n_cust = max(1, int(100_000 * max(sf, 1) ** 0.5))
+
+    # ---- store_sales (fact; key = row id) ----
+    def store_sales(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        qty = 1 + (_h(key, 201) % _U64(100)).astype(np.int64)
+        wholesale = _money(key, 202, 1.0, 100.0)
+        list_price = np.round(wholesale * (1.0 + (
+            _h(key, 203) % _U64(100)).astype(np.float64) / 100.0), 2)
+        sales_price = np.round(list_price * (
+            (_h(key, 204) % _U64(100)).astype(np.float64) / 100.0), 2)
+        ext_sales = np.round(sales_price * qty, 2)
+        coupon = np.where(_h(key, 205) % _U64(10) == 0,
+                          np.round(ext_sales * 0.1, 2), 0.0)
+        for c in cols:
+            if c == "ss_sold_date_sk":
+                d = SALES_D0 + (_h(key, 200)
+                                % _U64(SALES_D1 - SALES_D0)).astype(np.int64)
+                out[c] = (D_BASE_SK + d, None)
+            elif c == "ss_item_sk":
+                out[c] = (1 + (_h(key, 206)
+                               % _U64(self.n_item)).astype(np.int64), None)
+            elif c == "ss_customer_sk":
+                out[c] = (1 + (_h(key, 207)
+                               % _U64(self.n_cust)).astype(np.int64), None)
+            elif c == "ss_cdemo_sk":
+                out[c] = (1 + (_h(key, 208)
+                               % _U64(self.n_demo)).astype(np.int64), None)
+            elif c == "ss_store_sk":
+                out[c] = (1 + (_h(key, 209)
+                               % _U64(self.n_store)).astype(np.int64), None)
+            elif c == "ss_ticket_number":
+                out[c] = (1 + (key.astype(np.int64) - 1) // 8, None)
+            elif c == "ss_quantity":
+                out[c] = (qty.astype(np.int32), None)
+            elif c == "ss_wholesale_cost":
+                out[c] = (wholesale, None)
+            elif c == "ss_list_price":
+                out[c] = (list_price, None)
+            elif c == "ss_sales_price":
+                out[c] = (sales_price, None)
+            elif c == "ss_ext_sales_price":
+                out[c] = (ext_sales, None)
+            elif c == "ss_coupon_amt":
+                out[c] = (coupon, None)
+            elif c == "ss_net_paid":
+                out[c] = (np.round(ext_sales - coupon, 2), None)
+            elif c == "ss_net_profit":
+                out[c] = (np.round(ext_sales - coupon
+                                   - wholesale * qty, 2), None)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- date_dim (key = 1..D_DAYS; calendar date = 1900-01-01 + key-1) --
+    def date_dim(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        days = key.astype(np.int64) - 1
+        # vectorized calendar via numpy datetime64
+        dt = (np.datetime64("1900-01-01") + days.astype("timedelta64[D]"))
+        years = dt.astype("datetime64[Y]").astype(np.int64) + 1970
+        months = dt.astype("datetime64[M]").astype(np.int64) % 12 + 1
+        dom = (dt - dt.astype("datetime64[M]")).astype(np.int64) + 1
+        for c in cols:
+            if c == "d_date_sk":
+                out[c] = (D_BASE_SK + days, None)
+            elif c == "d_date":
+                # engine DATE storage = days since 1970-01-01
+                epoch70 = (np.datetime64("1900-01-01")
+                           - np.datetime64("1970-01-01")).astype(np.int64)
+                out[c] = ((days + epoch70).astype(np.int32), None)
+            elif c == "d_year":
+                out[c] = (years.astype(np.int32), None)
+            elif c == "d_moy":
+                out[c] = (months.astype(np.int32), None)
+            elif c == "d_dom":
+                out[c] = (dom.astype(np.int32), None)
+            elif c == "d_qoy":
+                out[c] = (((months - 1) // 3 + 1).astype(np.int32), None)
+            elif c == "d_day_name":
+                # 1900-01-01 was a Monday
+                out[c] = ((days % 7).astype(np.int32), _DAY_NAMES)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- item ----
+    def item(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        brand_id = 1 + (_h(key, 221) % _U64(1000)).astype(np.int64)
+        cat = (_h(key, 222) % _U64(len(CATEGORIES))).astype(np.int64)
+        for c in cols:
+            if c == "i_item_sk":
+                out[c] = (key.astype(np.int64), None)
+            elif c == "i_item_id":
+                out[c] = ([f"AAAAAAAA{i:08d}" for i in key], "text")
+            elif c == "i_brand_id":
+                out[c] = (brand_id.astype(np.int32), None)
+            elif c == "i_brand":
+                out[c] = ((brand_id - 1).astype(np.int32), _BRANDS)
+            elif c == "i_manufact_id":
+                out[c] = (_randint(key, 223, 1, 1000).astype(np.int32), None)
+            elif c == "i_manager_id":
+                out[c] = (_randint(key, 224, 1, 100).astype(np.int32), None)
+            elif c == "i_category_id":
+                out[c] = ((cat + 1).astype(np.int32), None)
+            elif c == "i_category":
+                out[c] = (cat.astype(np.int32), CATEGORIES)
+            elif c == "i_current_price":
+                out[c] = (_money(key, 225, 0.09, 99.99), None)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- store ----
+    def store(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        for c in cols:
+            if c == "s_store_sk":
+                out[c] = (key.astype(np.int64), None)
+            elif c == "s_store_id":
+                out[c] = ([f"AAAAAAAA{i:08d}" for i in key], "text")
+            elif c == "s_store_name":
+                names = ("ought", "able", "pri", "ese", "anti", "cally",
+                         "ation", "eing", "n st", "bar")
+                out[c] = ((_h(key, 231)
+                           % _U64(len(names))).astype(np.int32), names)
+            elif c == "s_state":
+                out[c] = (_pick(key, 232, STATES),
+                          tuple(dict.fromkeys(STATES)))
+            elif c == "s_number_employees":
+                out[c] = (_randint(key, 233, 200, 300).astype(np.int32),
+                          None)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- customer_demographics (exact cross-product, spec encoding) ----
+    def customer_demographics(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        i = key.astype(np.int64) - 1
+        g = i % len(GENDERS)
+        i2 = i // len(GENDERS)
+        ms = i2 % len(MARITAL)
+        i3 = i2 // len(MARITAL)
+        ed = i3 % len(EDUCATION)
+        i4 = i3 // len(EDUCATION)
+        pe = i4 % CD_PURCHASE_MAX
+        i5 = i4 // CD_PURCHASE_MAX
+        cr = i5 % len(CREDIT_RATING)
+        i6 = i5 // len(CREDIT_RATING)
+        dep = i6 % 7
+        i7 = i6 // 7
+        dep_emp = i7 % 7
+        dep_col = (i7 // 7) % 7
+        for c in cols:
+            if c == "cd_demo_sk":
+                out[c] = (key.astype(np.int64), None)
+            elif c == "cd_gender":
+                out[c] = (g.astype(np.int32), GENDERS)
+            elif c == "cd_marital_status":
+                out[c] = (ms.astype(np.int32), MARITAL)
+            elif c == "cd_education_status":
+                out[c] = (ed.astype(np.int32), EDUCATION)
+            elif c == "cd_purchase_estimate":
+                out[c] = (((pe + 1) * 500).astype(np.int32), None)
+            elif c == "cd_credit_rating":
+                out[c] = (cr.astype(np.int32), CREDIT_RATING)
+            elif c == "cd_dep_count":
+                out[c] = (dep.astype(np.int32), None)
+            elif c == "cd_dep_employed_count":
+                out[c] = (dep_emp.astype(np.int32), None)
+            elif c == "cd_dep_college_count":
+                out[c] = (dep_col.astype(np.int32), None)
+            else:
+                raise KeyError(c)
+        return out
+
+
+def tpcds_schema(table: str) -> Schema:
+    return Schema(_SCHEMAS[table])
+
+
+class TpcdsPageSource(PageSource):
+    def __init__(self, gen: _Gen, split: Split, columns: Sequence[str],
+                 rows_per_batch: int):
+        self.gen = gen
+        self.split = split
+        self.columns = list(columns)
+        self.rows_per_batch = rows_per_batch
+
+    def batches(self) -> Iterator[Batch]:
+        from .tpch import _to_batch
+        table = self.split.table.table
+        schema = tpcds_schema(table)
+        start, end = self.split.info
+        genfn = getattr(self.gen, table)
+        for a in range(start, end, self.rows_per_batch):
+            b = min(a + self.rows_per_batch, end)
+            keys = np.arange(a, b, dtype=np.int64)
+            data = genfn(keys, self.columns)
+            yield _to_batch(schema, self.columns, data, b - a)
+
+
+class _Metadata(ConnectorMetadata):
+    def __init__(self, sf: float):
+        self.sf = sf
+
+    def list_tables(self, schema: Optional[str] = None) -> List[str]:
+        return list(TABLES)
+
+    def table_schema(self, table: TableHandle) -> Schema:
+        if table.table not in _SCHEMAS:
+            raise KeyError(f"unknown tpcds table {table.table!r}")
+        return tpcds_schema(table.table)
+
+    _PRIMARY_KEYS = {
+        "store_sales": (),           # fact rows are not keyed by one column
+        "date_dim": ("d_date_sk",),
+        "item": ("i_item_sk",),
+        "store": ("s_store_sk",),
+        "customer_demographics": ("cd_demo_sk",),
+    }
+
+    def table_stats(self, table: TableHandle) -> TableStats:
+        t = table.table
+        n = float(_rows(t, self.sf))
+        cols: Dict[str, ColumnStats] = {}
+        for pk in self._PRIMARY_KEYS.get(t, ()):
+            cols[pk] = ColumnStats(distinct_count=n)
+        return TableStats(row_count=n, columns=cols,
+                          primary_key=self._PRIMARY_KEYS.get(t, ()))
+
+
+class _SplitManager(ConnectorSplitManager):
+    def __init__(self, sf: float):
+        self.sf = sf
+
+    def splits(self, table: TableHandle, desired: int = 1) -> List[Split]:
+        n = _rows(table.table, self.sf)
+        desired = max(1, min(desired, n))
+        bounds = np.linspace(1, n + 1, desired + 1, dtype=np.int64)
+        return [
+            Split(table, (int(bounds[i]), int(bounds[i + 1])))
+            for i in range(desired)
+            if bounds[i] < bounds[i + 1]
+        ]
+
+
+class TpcdsConnector(Connector):
+    name = "tpcds"
+
+    def __init__(self, sf: float = 0.01):
+        self.sf = sf
+        self._metadata = _Metadata(sf)
+        self._splits = _SplitManager(sf)
+        self._gen = _Gen(sf)
+
+    @property
+    def metadata(self) -> ConnectorMetadata:
+        return self._metadata
+
+    @property
+    def split_manager(self) -> ConnectorSplitManager:
+        return self._splits
+
+    def page_source(self, split: Split, columns: Sequence[str],
+                    pushdown=None, rows_per_batch: int = 1 << 17
+                    ) -> PageSource:
+        return TpcdsPageSource(self._gen, split, columns, rows_per_batch)
