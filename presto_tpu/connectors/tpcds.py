@@ -253,8 +253,12 @@ class _Gen:
                 out[c] = ((_h(key, 231)
                            % _U64(len(names))).astype(np.int32), names)
             elif c == "s_state":
-                out[c] = (_pick(key, 232, STATES),
-                          tuple(dict.fromkeys(STATES)))
+                # STATES holds duplicates (TN-heavy weighting); codes must
+                # index the deduped dictionary, not the weighted tuple
+                uniq = tuple(dict.fromkeys(STATES))
+                remap = np.array([uniq.index(s) for s in STATES],
+                                 dtype=np.int32)
+                out[c] = (remap[_pick(key, 232, STATES)], uniq)
             elif c == "s_number_employees":
                 out[c] = (_randint(key, 233, 200, 300).astype(np.int32),
                           None)

@@ -42,7 +42,9 @@ from ..ops.join import (
     expand_join, lookup_join, match_count_max, semi_join_mask,
 )
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
-from ..parallel.exchange import repartition_by_hash
+from ..parallel.exchange import (
+    partition_counts, repartition_by_hash, repartition_by_hash_compact,
+)
 from ..parallel.mesh import make_mesh
 from ..planner.plan import (
     AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
@@ -60,6 +62,10 @@ class DistributedExecutor(_Executor):
     exchange-bearing nodes (scan placement, aggregation, join, semi join,
     sort/top-n/distinct finalization) with SPMD implementations.
     """
+
+    compact_streams = False   # compact() on a mesh-sharded batch would
+    #                            gather it across devices; shard-local
+    #                            compaction happens in the exchange path
 
     def __init__(self, session: Session, rows_per_batch: int,
                  mesh: jax.sharding.Mesh):
@@ -101,6 +107,29 @@ class DistributedExecutor(_Executor):
             lambda b: jnp.sum(b.row_mask, keepdims=True).astype(jnp.int64), 1)
         counts = np.asarray(per(batch))
         return int(counts.max()) if counts.size else 0
+
+    def _repartitioner(self, key_cols: Sequence[int]):
+        """Quota-compacted hash exchange driver: one cheap collective
+        reads per-(src,dst) live counts, the host buckets the max into a
+        static quota, and the exchange ships exactly quota slots per peer
+        (wire cost ~C instead of the masked all_to_all's n*C; reference
+        operator/PartitionedOutputOperator.java PagePartitioner). The
+        jitted exchange is cached per quota bucket."""
+        keys = tuple(key_cols)
+        counts_fn = self._smap(
+            lambda b: partition_counts(b, keys, self.n), 1)
+        fns: Dict[int, object] = {}
+
+        def repart(batch: Batch) -> Batch:
+            quota = bucket_capacity(
+                max(int(np.asarray(counts_fn(batch)).max()), 1))
+            fn = fns.get(quota)
+            if fn is None:
+                fn = fns[quota] = self._smap(
+                    lambda b, _q=quota: repartition_by_hash_compact(
+                        b, keys, self.axis, self.n, _q), 1)
+            return fn(batch)
+        return repart
 
     # -- scan: split placement ------------------------------------------------
     def _TableScanNode(self, node: TableScanNode) -> Iterator[Batch]:
@@ -238,10 +267,9 @@ class DistributedExecutor(_Executor):
                 state = merged
         if state is None:
             return
+        state = self._repartitioner(key_idx)(state)
         final_fn = self._smap(
-            lambda b: grouped_aggregate(
-                repartition_by_hash(b, key_idx, self.axis, self.n),
-                key_idx, aggs, mode="final"), 1)
+            lambda b: grouped_aggregate(b, key_idx, aggs, mode="final"), 1)
         yield final_fn(state)
 
     def _global_agg(self, node: AggregationNode,
@@ -297,15 +325,10 @@ class DistributedExecutor(_Executor):
             build_side = self._replicate(_to_host(build))
         else:
             # FIXED_HASH: build repartitioned by join key over ICI once
-            repart_build = self._smap(
-                lambda b: repartition_by_hash(b, rkeys, self.axis, self.n), 1)
-            build_side = repart_build(build)
+            build_side = self._repartitioner(rkeys)(build)
 
         def local_probe(probe_l: Batch, build_l: Batch,
                         maxk: int) -> Batch:
-            if not replicated:
-                probe_l = repartition_by_hash(probe_l, lkeys, self.axis,
-                                              self.n)
             if node.build_unique:
                 out = lookup_join(probe_l, build_l, lkeys, rkeys,
                                   payload, payload_names, node.join_type)
@@ -319,14 +342,15 @@ class DistributedExecutor(_Executor):
         count_fn = None
         if not node.build_unique:
             def local_count(p: Batch, b: Batch) -> jnp.ndarray:
-                if not replicated:
-                    p = repartition_by_hash(p, lkeys, self.axis, self.n)
                 return match_count_max(p, b, lkeys, rkeys)[None]
             count_fn = self._smap(local_count, 2,
                                   replicated_in=(1,) if replicated else ())
 
+        repart_probe = None if replicated else self._repartitioner(lkeys)
         join_fns: Dict[int, object] = {}
         for probe in self.run(node.left):
+            if repart_probe is not None:
+                probe = repart_probe(probe)
             maxk = 1
             if count_fn is not None:
                 maxk = bucket_capacity(
@@ -422,10 +446,9 @@ class DistributedExecutor(_Executor):
         schema = _plan_schema(node)
         if parts:
             # colocate partitions via hash exchange, evaluate shard-locally
+            b = self._repartitioner(parts)(b)
             fn = self._smap(
-                lambda x: evaluate_window(
-                    repartition_by_hash(x, parts, self.axis, self.n),
-                    parts, keys, specs), 1)
+                lambda x: evaluate_window(x, parts, keys, specs), 1)
             out = fn(b)
         else:
             # single global partition: evaluate on the gathered batch,
@@ -439,10 +462,9 @@ class DistributedExecutor(_Executor):
         if b is None:
             return
         cols = list(range(len(node.fields)))
+        b = self._repartitioner(cols)(b)
         fn = self._smap(
-            lambda x: grouped_aggregate(
-                repartition_by_hash(x, cols, self.axis, self.n),
-                cols, [], mode="single"), 1)
+            lambda x: grouped_aggregate(x, cols, [], mode="single"), 1)
         yield fn(b)
 
     def _drain(self, node: PlanNode) -> Optional[Batch]:
@@ -501,8 +523,10 @@ class DistributedRunner:
         from ..connectors.tpch import TpchConnector
         from ..planner.optimizer import optimize
         if catalogs is None:
+            from ..connectors.tpcds import TpcdsConnector
             catalogs = CatalogManager()
             catalogs.register("tpch", TpchConnector(sf=tpch_sf))
+            catalogs.register("tpcds", TpcdsConnector(sf=tpch_sf))
         self.session = Session(catalogs=catalogs, catalog=catalog,
                                schema=schema)
         self.mesh = make_mesh(n_devices)

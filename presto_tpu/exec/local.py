@@ -25,14 +25,15 @@ from ..batch import Batch, Column, Schema, bucket_capacity, concat_batches
 from ..expr import ir
 from ..expr.compiler import compile_filter, compile_projection
 from ..expr.rewrite import rewrite as ir_rewrite
-from ..ops.aggregation import AggSpec, global_aggregate, grouped_aggregate
+from ..ops.aggregation import AggSpec
+from ..ops.jitcache import global_aggregate_jit as global_aggregate, grouped_aggregate_jit as grouped_aggregate
 from ..ops.join import (
     expand_join, lookup_join, match_count_max, semi_join_mask,
 )
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
 from ..planner.plan import (
-    AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
-    OutputNode, PlanNode, ProjectNode, SemiJoinNode, SortNode,
+    AggregationNode, DistinctNode, FilterNode, GroupIdNode, JoinNode,
+    LimitNode, OutputNode, PlanNode, ProjectNode, SemiJoinNode, SortNode,
     TableScanNode, TopNNode, UnionNode, ValuesNode,
 )
 from ..planner.planner import InitPlanRef, LogicalPlan, Session
@@ -51,6 +52,7 @@ def run_init_plans(ex, plan: LogicalPlan) -> None:
     first (lower indices), so binding the live list to the executor before
     the loop makes a nested init plan's InitPlanRef resolvable while the
     outer one runs."""
+    ex.mark_shared(list(plan.init_plans) + [plan.root])
     ex.init_values = init_values = []
     for p in plan.init_plans:
         rows = [r for b in ex.run(p) for r in b.to_pylist()]
@@ -108,12 +110,33 @@ class _Executor:
         self.session = session
         self.rows_per_batch = rows_per_batch
         self.init_values: List[object] = []
+        self._shared: set = set()
+        self._materialized: Dict[PlanNode, List[Batch]] = {}
         from ..memory import QueryMemoryPool
         self.pool = QueryMemoryPool(
             session.properties.get("query_max_memory"))
         self.spill_partitions = int(
             session.properties.get("spill_partitions", 16))
         session.last_memory_stats = self.pool.stats
+
+    def mark_shared(self, roots: Sequence[PlanNode]) -> None:
+        """Pre-scan for structurally repeated subplans (e.g. the shared
+        input of a GROUPING SETS union): their output is materialized once
+        and replayed — the executor-side equivalent of the reference's
+        single-pass GroupIdOperator over a shared source."""
+        from collections import Counter
+        counts: Counter = Counter()
+
+        def walk(n: PlanNode) -> None:
+            counts[n] += 1
+            if counts[n] > 1:
+                return
+            for c in n.children:
+                walk(c)
+
+        for r in roots:
+            walk(r)
+        self._shared = {n for n, c in counts.items() if c > 1}
 
     # -- expression preparation ---------------------------------------------
     def _resolve(self, e: ir.Expr) -> ir.Expr:
@@ -126,8 +149,34 @@ class _Executor:
 
     # -- dispatch -------------------------------------------------------------
     def run(self, node: PlanNode) -> Iterator[Batch]:
+        if node in self._materialized:
+            return iter(self._materialized[node])
         m = getattr(self, "_" + type(node).__name__)
+        if node in self._shared:
+            return self._run_memoized(node, m)
         return m(node)
+
+    def _run_memoized(self, node: PlanNode, m) -> Iterator[Batch]:
+        """Materialize a shared subplan's output once, within the memory
+        budget: each cached batch reserves from the query pool, and if the
+        pool can't hold the next batch the cache is abandoned (repeat
+        consumers re-execute instead of OOMing device memory)."""
+        import itertools
+
+        from .spill import batch_device_bytes
+        ctx = self.pool.context(f"memo-{type(node).__name__}")
+        it = m(node)
+        out: List[Batch] = []
+        for b in it:
+            if not ctx.pool.try_reserve(batch_device_bytes(b), ctx):
+                # over budget: abandon the cache; this consumer streams on
+                # and later consumers re-execute the subplan
+                ctx.release_all()
+                self._shared.discard(node)
+                return itertools.chain(out, [b], it)
+            out.append(b)
+        self._materialized[node] = out
+        return iter(out)
 
     def _OutputNode(self, node: OutputNode) -> Iterator[Batch]:
         return self.run(node.child)
@@ -154,11 +203,36 @@ class _Executor:
         yield Batch(Schema([]), [], mask)
 
     # -- streaming nodes ------------------------------------------------------
+    compact_streams = True   # DistributedExecutor turns this off: compact()
+    #                          on a mesh-sharded batch would gather it
+
+    def _compactor(self):
+        """Per-operator adaptive compaction (one host sync per checked
+        batch): the analogue of Presto's compacted filter output pages
+        (reference operator/project/PageProcessor.java). Selective
+        filters/joins leave mostly-dead lanes, and every downstream
+        sort-based kernel pays for capacity, not liveness. Checks batches
+        >16K capacity; after the first batch that doesn't shrink >=4x it
+        stops checking (selectivity is near-uniform across an operator's
+        batches), so a non-selective stream pays exactly one sync."""
+        state = {"check": self.compact_streams}
+
+        def maybe_compact(b: Batch) -> Batch:
+            if not state["check"] or b.capacity <= (1 << 14):
+                return b
+            tgt = bucket_capacity(b.host_count())
+            if tgt * 4 <= b.capacity:
+                return b.compact(tgt, check=False)
+            state["check"] = False
+            return b
+        return maybe_compact
+
     def _FilterNode(self, node: FilterNode) -> Iterator[Batch]:
         pred = self._resolve(node.predicate)
         fn = compile_filter(pred, _plan_schema(node.child))
+        compact = self._compactor()
         for b in self.run(node.child):
-            yield fn(b)
+            yield compact(fn(b))
 
     def _ProjectNode(self, node: ProjectNode) -> Iterator[Batch]:
         exprs = [self._resolve(e) for e in node.exprs]
@@ -179,6 +253,30 @@ class _Executor:
     def _UnionNode(self, node: UnionNode) -> Iterator[Batch]:
         for c in node.children:
             yield from self.run(c)
+
+    def _GroupIdNode(self, node: GroupIdNode) -> Iterator[Batch]:
+        """One replica batch per grouping set: absent keys get their
+        validity cleared (NULL), $group_id is a constant column
+        (reference operator/GroupIdOperator.java)."""
+        schema = _plan_schema(node)
+        for b in self.run(node.child):
+            dead = jnp.zeros_like(b.row_mask)
+            alive = jnp.ones_like(b.row_mask)
+            for g, s in enumerate(node.grouping_sets):
+                cols = []
+                for i, c in enumerate(b.columns):
+                    if i < node.n_keys and i not in s:
+                        # zero data too: the group-sort uses (null-rank,
+                        # data) as sort operands, so stale data under a
+                        # cleared validity would still split groups
+                        cols.append(Column(c.type, jnp.zeros_like(c.data),
+                                           dead, c.dictionary))
+                    else:
+                        cols.append(c)
+                cols.append(Column(
+                    T.BIGINT,
+                    jnp.full(b.capacity, g, dtype=jnp.int64), alive, None))
+                yield Batch(schema, cols, b.row_mask)
 
     # -- blocking nodes -------------------------------------------------------
     def _drain(self, node: PlanNode) -> Optional[Batch]:
@@ -307,6 +405,7 @@ class _Executor:
                 yield from self._partitioned_join(
                     node, build, payload, payload_names, residual_fn)
                 return
+            compact = self._compactor()
             for probe in self.run(node.left):
                 if build is None:
                     if node.join_type == "inner":
@@ -317,7 +416,7 @@ class _Executor:
                                       payload_names)
                 if residual_fn is not None:
                     out = residual_fn(out)
-                yield out
+                yield compact(out)
         finally:
             buf.close()
 

@@ -30,8 +30,10 @@ class LocalRunner:
                  catalog: str = "tpch", schema: str = "default",
                  tpch_sf: float = 0.01, rows_per_batch: int = 1 << 17):
         if catalogs is None:
+            from ..connectors.tpcds import TpcdsConnector
             catalogs = CatalogManager()
             catalogs.register("tpch", TpchConnector(sf=tpch_sf))
+            catalogs.register("tpcds", TpcdsConnector(sf=tpch_sf))
             catalogs.register("memory", MemoryConnector())
         self.session = Session(catalogs=catalogs, catalog=catalog,
                                schema=schema)

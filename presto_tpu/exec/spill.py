@@ -29,7 +29,8 @@ from ..batch import (
     unify_dictionaries, vocab_column,
 )
 from ..memory import QueryMemoryPool, batch_device_bytes
-from ..ops.aggregation import AggSpec, grouped_aggregate
+from ..ops.aggregation import AggSpec
+from ..ops.jitcache import grouped_aggregate_jit as grouped_aggregate
 from ..ops.sort import SortKey, sort_batch
 from ..parallel.exchange import hash_partition_ids
 

@@ -75,7 +75,8 @@ def _group_sort(batch: Batch, group_indices: Sequence[int]):
         if data.dtype == jnp.bool_:
             data = data.astype(jnp.int32)
         key_ops.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))  # nulls last
-        key_ops.append(data)
+        # neutralize NULL rows' data so stale values can't split NULL groups
+        key_ops.append(jnp.where(c.validity, data, jnp.zeros_like(data)))
     payload: List[jnp.ndarray] = [batch.row_mask]
     for c in batch.columns:
         payload.append(c.data)

@@ -73,6 +73,9 @@ def _sortable(col: Column, key: SortKey) -> List[jnp.ndarray]:
             data = ~data
     nulls_first = key.effective_nulls_first()
     null_rank = jnp.where(col.validity, 1, 0) if nulls_first else jnp.where(col.validity, 0, 1)
+    # NULL rows tie on null_rank; neutralize their data operand so stale
+    # values never order two NULLs differently from each other's payload
+    data = jnp.where(col.validity, data, jnp.zeros_like(data))
     return [null_rank.astype(jnp.int32), data]
 
 

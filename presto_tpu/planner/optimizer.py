@@ -503,6 +503,17 @@ def _prune(node: PlanNode, required: List[int]) -> Tuple[PlanNode, Dict[int, int
         return OutputNode(child=narrowed,
                           fields=tuple(node.fields[i] for i in req)), mapping
 
+    from .plan import GroupIdNode
+    if isinstance(node, GroupIdNode):
+        # all child columns stay live (keys feed the grouping sets, the
+        # rest are agg args), but recurse so the subtree below still prunes
+        child_req = list(range(len(node.child.fields)))
+        child, cmap = _prune(node.child, child_req)
+        child = _narrow(child, [cmap[i] for i in child_req],
+                        list(node.child.fields))
+        return (dataclasses.replace(node, child=child),
+                {i: i for i in range(len(node.fields))})
+
     # unknown node: don't prune through
     return node, {i: i for i in range(len(node.fields))}
 

@@ -257,6 +257,21 @@ class DistinctNode(PlanNode):
             object.__setattr__(self, "fields", self.child.fields)
 
 
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class GroupIdNode(PlanNode):
+    """Replicates each input row once per grouping set, nulling out group
+    keys absent from that set and appending a $group_id column (reference
+    plan/GroupIdNode.java + operator/GroupIdOperator.java) — the
+    single-pass lowering of GROUP BY GROUPING SETS. Input layout =
+    [group keys..., agg args...]; output = input fields + $group_id."""
+
+    child: PlanNode
+    grouping_sets: Tuple[Tuple[int, ...], ...]
+    n_keys: int
+    fields: Tuple[Field, ...]
+
+
 @dataclasses.dataclass(frozen=True)
 class UnionNode(PlanNode):
     children_: Tuple[PlanNode, ...]

@@ -636,6 +636,10 @@ class _Planner:
                           fields=tuple(pre_fields))
         out_fields = tuple(pre_fields[:len(group_exprs)]) + tuple(agg_fields)
         nk = len(group_exprs)
+        if spec.grouping_sets is not None:
+            return self._plan_grouping_sets(
+                spec, pre, pre_fields, nk, aggs, agg_fields, group_exprs,
+                select_items, seen)
         if any(a.distinct for a in aggs):
             # distinct rows of (keys, arg) first, then plain aggregation
             # (reference iterative/rule/
@@ -671,6 +675,108 @@ class _Planner:
             replacements[call] = ir.input_ref(
                 len(group_exprs) + j, agg_fields[j].type)
         return agg_node, replacements
+
+    def _plan_grouping_sets(self, spec, pre, pre_fields, nk, aggs,
+                            agg_fields, group_exprs, select_items, seen):
+        """GROUP BY ROLLUP/CUBE/GROUPING SETS, lowered single-pass via
+        GroupIdNode (reference plan/GroupIdNode.java +
+        operator/GroupIdOperator.java): replicate rows per grouping set
+        with absent keys nulled, aggregate once over (keys..., $group_id),
+        and compute GROUPING() values by SWITCH on $group_id. Empty
+        grouping sets (the ROLLUP grand-total row) go through a separate
+        global-aggregation branch so they still emit their row over empty
+        input, then UNION ALL."""
+        from .plan import GroupIdNode, UnionNode
+
+        if any(a.distinct for a in aggs):
+            raise AnalysisError(
+                "DISTINCT aggregates with grouping sets are not supported")
+        grouping_calls: List[A.FunctionCall] = []
+        exprs_to_scan = ([it.value for it in select_items]
+                         + ([spec.having] if spec.having else [])
+                         + [s.key for s in spec.order_by])
+        for c in _collect_calls_named(exprs_to_scan, "grouping"):
+            if c not in grouping_calls:
+                grouping_calls.append(c)
+
+        def gidx(e: A.Expression) -> int:
+            for i, g in enumerate(group_exprs):
+                if g == e:
+                    return i
+            raise AnalysisError(
+                "GROUPING() arguments must be grouping columns")
+
+        call_arg_idx = [[gidx(a) for a in c.args] for c in grouping_calls]
+
+        def grouping_val(s: Tuple[int, ...], idxs: List[int]) -> int:
+            m = len(idxs)
+            return sum((0 if idxs[a] in s else 1) << (m - 1 - a)
+                       for a in range(m))
+
+        nonempty = [s for s in spec.grouping_sets if s]
+        n_empty = sum(1 for s in spec.grouping_sets if not s)
+        out_fields = (tuple(pre_fields[:nk]) + tuple(agg_fields)
+                      + tuple(Field(f"_grouping{k}", T.BIGINT)
+                              for k in range(len(grouping_calls))))
+
+        branches: List[PlanNode] = []
+        if nonempty:
+            gid_field = Field("$group_id", T.BIGINT)
+            gid_node = GroupIdNode(
+                child=pre, grouping_sets=tuple(nonempty), n_keys=nk,
+                fields=tuple(pre_fields) + (gid_field,))
+            gid_idx = len(pre_fields)
+            agg_node = AggregationNode(
+                child=gid_node,
+                group_indices=tuple(range(nk)) + (gid_idx,),
+                aggs=tuple(aggs),
+                fields=(tuple(pre_fields[:nk]) + (gid_field,)
+                        + tuple(agg_fields)))
+            # agg layout: [keys..., $group_id, aggs...]
+            exprs: List[ir.Expr] = [
+                ir.input_ref(i, pre_fields[i].type) for i in range(nk)]
+            exprs += [ir.input_ref(nk + 1 + j, af.type)
+                      for j, af in enumerate(agg_fields)]
+            gid_ref = ir.input_ref(nk, T.BIGINT)
+            for idxs in call_arg_idx:
+                vals = [grouping_val(s, idxs) for s in nonempty]
+                if len(set(vals)) == 1:
+                    exprs.append(ir.lit(vals[0], T.BIGINT))
+                    continue
+                ops: List[ir.Expr] = []
+                for g, v in enumerate(vals[:-1]):
+                    ops.append(ir.call("eq", T.BOOLEAN, gid_ref,
+                                       ir.lit(g, T.BIGINT)))
+                    ops.append(ir.lit(v, T.BIGINT))
+                ops.append(ir.lit(vals[-1], T.BIGINT))
+                exprs.append(ir.special(ir.Form.SWITCH, T.BIGINT, *ops))
+            branches.append(ProjectNode(child=agg_node, exprs=tuple(exprs),
+                                        fields=out_fields))
+
+        for _ in range(n_empty):
+            g_agg = AggregationNode(
+                child=pre, group_indices=(), aggs=tuple(aggs),
+                fields=tuple(agg_fields))
+            exprs = [ir.lit(None, pre_fields[i].type) for i in range(nk)]
+            exprs += [ir.input_ref(j, af.type)
+                      for j, af in enumerate(agg_fields)]
+            for idxs in call_arg_idx:
+                exprs.append(ir.lit(grouping_val((), idxs), T.BIGINT))
+            branches.append(ProjectNode(child=g_agg, exprs=tuple(exprs),
+                                        fields=out_fields))
+
+        node: PlanNode = (branches[0] if len(branches) == 1 else
+                          UnionNode(children_=tuple(branches),
+                                    fields=out_fields))
+        replacements: Dict[A.Expression, ir.Expr] = {}
+        for i, g in enumerate(group_exprs):
+            replacements[g] = ir.input_ref(i, pre_fields[i].type)
+        for call, j in seen.items():
+            replacements[call] = ir.input_ref(nk + j, agg_fields[j].type)
+        for k, c in enumerate(grouping_calls):
+            replacements[c] = ir.input_ref(nk + len(agg_fields) + k,
+                                           T.BIGINT)
+        return node, replacements
 
     # -- windows --------------------------------------------------------------
     def _plan_windows(self, node: PlanNode, scope: Scope,
@@ -940,19 +1046,15 @@ def _and_all(conjuncts: List[A.Expression]) -> Optional[A.Expression]:
     return out
 
 
-def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:
-    found: List[A.FunctionCall] = []
+def _walk_ast(exprs: Sequence[A.Expression], visit) -> None:
+    """Generic AST walk (no descent into subquery bodies). ``visit``
+    returns True to stop descending below a node."""
 
     def walk(n):
         if isinstance(n, (A.ScalarSubquery, A.InSubquery, A.Exists)):
-            return  # subquery aggregates belong to the inner query
-        if isinstance(n, A.WindowFunction):
-            return  # sum(x) OVER (...) is a window, not a group aggregate
-        if isinstance(n, A.FunctionCall):
-            fn = _FUNCTION_ALIASES.get(n.name, n.name)
-            if fn in AGGREGATE_FUNCTIONS or n.is_star and fn == "count":
-                found.append(n)
-                return  # don't descend into agg args
+            return
+        if visit(n):
+            return
         if dataclasses.is_dataclass(n) and not isinstance(n, type):
             for f in dataclasses.fields(n):
                 v = getattr(n, f.name)
@@ -965,6 +1067,36 @@ def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:
     for e in exprs:
         if e is not None:
             walk(e)
+
+
+def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:
+    found: List[A.FunctionCall] = []
+
+    def visit(n):
+        if isinstance(n, A.WindowFunction):
+            return True  # sum(x) OVER (...) is a window, not a group agg
+        if isinstance(n, A.FunctionCall):
+            fn = _FUNCTION_ALIASES.get(n.name, n.name)
+            if fn in AGGREGATE_FUNCTIONS or n.is_star and fn == "count":
+                found.append(n)
+                return True  # don't descend into agg args
+        return False
+    _walk_ast(exprs, visit)
+    return found
+
+
+def _collect_calls_named(exprs: Sequence[A.Expression],
+                         name: str) -> List[A.FunctionCall]:
+    """All FunctionCall nodes with the given (unaliased) name, no descent
+    into subqueries."""
+    found: List[A.FunctionCall] = []
+
+    def visit(n):
+        if isinstance(n, A.FunctionCall) and n.name == name:
+            found.append(n)
+            return True
+        return False
+    _walk_ast(exprs, visit)
     return found
 
 

@@ -289,6 +289,9 @@ class QuerySpecification(Node):
     from_: Optional[Relation] = None
     where: Optional[Expression] = None
     group_by: Tuple[Expression, ...] = ()
+    # GROUP BY ROLLUP/CUBE/GROUPING SETS desugar to index tuples into
+    # group_by (reference sql/tree/GroupingSets.java); None = plain GROUP BY
+    grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
     having: Optional[Expression] = None
     order_by: Tuple[SortItem, ...] = ()
     limit: Optional[int] = None

@@ -254,16 +254,94 @@ class _Parser:
                 from_ = A.Join("implicit", from_, right)
         where = self.expression() if self.accept_kw("where") else None
         group_by: Tuple[A.Expression, ...] = ()
+        grouping_sets = None
         if self.accept_kw("group"):
             self.expect_kw("by")
-            exprs = [self.expression()]
-            while self.accept_op(","):
-                exprs.append(self.expression())
-            group_by = tuple(exprs)
+            group_by, grouping_sets = self._group_by()
         having = self.expression() if self.accept_kw("having") else None
         return A.QuerySpecification(
             select=tuple(items), distinct=distinct, from_=from_, where=where,
-            group_by=group_by, having=having)
+            group_by=group_by, having=having, grouping_sets=grouping_sets)
+
+    def _group_by(self):
+        """GROUP BY: plain expr list, or ROLLUP/CUBE/GROUPING SETS, which
+        desugar to (distinct exprs, index sets) — reference
+        sql/tree/GroupingSets.java / Rollup.java / Cube.java."""
+        def expr_list():
+            self.expect_op("(")
+            if self.accept_op(")"):
+                return []
+            out = [self.expression()]
+            while self.accept_op(","):
+                out.append(self.expression())
+            self.expect_op(")")
+            return out
+
+        def at_ident(word, then_op=None, then_ident=None):
+            t, t1 = self.peek(), self.peek(1)
+            if not (t.kind == "IDENT" and t.text == word):
+                return False
+            if then_op is not None:
+                return t1.kind == "OP" and t1.text == then_op
+            if then_ident is not None:
+                return t1.kind == "IDENT" and t1.text == then_ident
+            return True
+
+        def no_mixing():
+            if self.at_op(","):
+                t = self.peek()
+                raise SqlSyntaxError(
+                    "mixing ROLLUP/CUBE/GROUPING SETS with plain GROUP BY "
+                    "expressions is not supported", t.line, t.col)
+
+        if at_ident("rollup", then_op="("):
+            self.next()
+            exprs = expr_list()
+            no_mixing()
+            n = len(exprs)
+            sets = [tuple(range(k)) for k in range(n, -1, -1)]
+        elif at_ident("cube", then_op="("):
+            self.next()
+            exprs = expr_list()
+            no_mixing()
+            n = len(exprs)
+            sets = [tuple(i for i in range(n) if m >> i & 1)
+                    for m in range((1 << n) - 1, -1, -1)]
+        elif at_ident("grouping", then_ident="sets"):
+            self.next()
+            self.next()
+            self.expect_op("(")
+            raw_sets = []
+            exprs = []
+            while True:
+                if self.at_op("("):
+                    one = expr_list()
+                else:
+                    one = [self.expression()]
+                idxs = []
+                for e in one:
+                    if e not in exprs:
+                        exprs.append(e)
+                    idxs.append(exprs.index(e))
+                raw_sets.append(tuple(idxs))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            no_mixing()
+            sets = raw_sets
+        else:
+            exprs = [self.expression()]
+            while self.accept_op(","):
+                if (at_ident("rollup", then_op="(")
+                        or at_ident("cube", then_op="(")
+                        or at_ident("grouping", then_ident="sets")):
+                    t = self.peek()
+                    raise SqlSyntaxError(
+                        "mixing ROLLUP/CUBE/GROUPING SETS with plain GROUP "
+                        "BY expressions is not supported", t.line, t.col)
+                exprs.append(self.expression())
+            return tuple(exprs), None
+        return tuple(exprs), tuple(sets)
 
     def _order_by(self) -> Tuple[A.SortItem, ...]:
         if not self.accept_kw("order"):

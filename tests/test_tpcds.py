@@ -1,0 +1,199 @@
+"""TPC-DS end-to-end tests against a SQLite oracle (BASELINE config 4).
+
+Same ring-2 strategy as test_sql.py: the engine and the oracle see the
+identical generated data (connectors/tpcds.py); results must match.
+q27/q55 are the BASELINE.md config-4 queries (reference
+presto-benchto-benchmarks/.../sql/presto/tpcds/q27.sql, q55.sql); q27
+exercises GROUP BY ROLLUP + GROUPING() (reference
+sql/tree/GroupingSets.java, operator/GroupIdOperator.java).
+"""
+import sqlite3
+
+import pytest
+
+from presto_tpu.connectors.spi import CatalogManager, TableHandle
+from presto_tpu.connectors.tpcds import TABLES, TpcdsConnector, tpcds_schema
+from presto_tpu.exec.runner import LocalRunner
+
+from test_sql import _norm, _sql_val
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def runner():
+    catalogs = CatalogManager()
+    catalogs.register("tpcds", TpcdsConnector(sf=SF))
+    return LocalRunner(catalogs=catalogs, catalog="tpcds")
+
+
+@pytest.fixture(scope="module")
+def oracle(runner):
+    conn = sqlite3.connect(":memory:")
+    tpcds = runner.session.catalogs.get("tpcds")
+    for t in TABLES:
+        schema = tpcds_schema(t)
+        cols = ", ".join(schema.names)
+        conn.execute(f"create table {t} ({cols})")
+        placeholders = ", ".join("?" * len(schema))
+        th = TableHandle("tpcds", "default", t)
+        for split in tpcds.split_manager.splits(th, 1):
+            for b in tpcds.page_source(split, schema.names,
+                                       rows_per_batch=1 << 17).batches():
+                rows = [tuple(_sql_val(v) for v in r) for r in b.to_pylist()]
+                conn.executemany(
+                    f"insert into {t} values ({placeholders})", rows)
+    conn.commit()
+    return conn
+
+
+def compare(runner, oracle, sql, oracle_sql=None):
+    got = runner.execute(sql)
+    want = oracle.execute(oracle_sql or sql).fetchall()
+    has_order = "order by" in sql.lower()
+    g = _norm(got.rows, has_order)
+    w = _norm(want, has_order)
+    assert g == w, f"engine={g[:5]}... oracle={w[:5]}..."
+    return got
+
+
+Q55 = """
+select i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 28
+  and d_moy = 11
+  and d_year = 1999
+group by i_brand, i_brand_id
+order by ext_price desc, i_brand_id
+limit 100
+"""
+
+Q27 = """
+select i_item_id, s_state, grouping(s_state) g_state,
+       avg(ss_quantity) agg1,
+       avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3,
+       avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002
+  and s_state in ('TN', 'TN', 'TN', 'TN', 'TN', 'TN')
+group by rollup (i_item_id, s_state)
+order by i_item_id nulls last, s_state nulls last
+limit 100
+"""
+
+# SQLite has no ROLLUP/GROUPING(): emulate with UNION ALL of the three
+# grouping sets, exactly the relational form our planner lowers to.
+Q27_ORACLE = """
+with base as (
+  select i_item_id, s_state, ss_quantity, ss_list_price,
+         ss_coupon_amt, ss_sales_price
+  from store_sales, customer_demographics, date_dim, store, item
+  where ss_sold_date_sk = d_date_sk
+    and ss_item_sk = i_item_sk
+    and ss_store_sk = s_store_sk
+    and ss_cdemo_sk = cd_demo_sk
+    and cd_gender = 'M'
+    and cd_marital_status = 'S'
+    and cd_education_status = 'College'
+    and d_year = 2002
+    and s_state in ('TN', 'TN', 'TN', 'TN', 'TN', 'TN')
+)
+select * from (
+  select i_item_id, s_state, 0 g_state, avg(ss_quantity) agg1,
+         avg(ss_list_price) agg2, avg(ss_coupon_amt) agg3,
+         avg(ss_sales_price) agg4
+  from base group by i_item_id, s_state
+  union all
+  select i_item_id, null, 1, avg(ss_quantity), avg(ss_list_price),
+         avg(ss_coupon_amt), avg(ss_sales_price)
+  from base group by i_item_id
+  union all
+  select null, null, 1, avg(ss_quantity), avg(ss_list_price),
+         avg(ss_coupon_amt), avg(ss_sales_price)
+  from base
+)
+order by i_item_id nulls last, s_state nulls last
+limit 100
+"""
+
+
+def test_q55(runner, oracle):
+    res = compare(runner, oracle, Q55)
+    assert len(res.rows) > 0
+
+
+def test_q27(runner, oracle):
+    res = compare(runner, oracle, Q27, Q27_ORACLE)
+    assert len(res.rows) > 0
+    # the rollup must include per-(item,state), per-item, and grand rows
+    g_states = {r[2] for r in res.rows}
+    assert g_states == {0, 1}
+
+
+def test_scan_counts(runner, oracle):
+    for t in TABLES:
+        compare(runner, oracle, f"select count(*) from {t}")
+
+
+def test_star_join_small(runner, oracle):
+    compare(runner, oracle, """
+        select d_year, count(*) n, sum(ss_net_paid) paid
+        from store_sales, date_dim
+        where ss_sold_date_sk = d_date_sk
+        group by d_year
+        order by d_year
+    """)
+
+
+def test_cube(runner, oracle):
+    compare(runner, oracle, """
+        select d_year, d_qoy, count(*) n
+        from store_sales, date_dim
+        where ss_sold_date_sk = d_date_sk and d_year between 1999 and 2000
+        group by cube(d_year, d_qoy)
+        order by d_year nulls last, d_qoy nulls last
+    """, """
+        with base as (
+          select d_year, d_qoy from store_sales, date_dim
+          where ss_sold_date_sk = d_date_sk and d_year between 1999 and 2000
+        )
+        select * from (
+          select d_year, d_qoy, count(*) n from base group by d_year, d_qoy
+          union all
+          select d_year, null, count(*) from base group by d_year
+          union all
+          select null, d_qoy, count(*) from base group by d_qoy
+          union all
+          select null, null, count(*) from base
+        )
+        order by d_year nulls last, d_qoy nulls last
+    """)
+
+
+def test_grouping_sets(runner, oracle):
+    compare(runner, oracle, """
+        select s_state, s_store_name, count(*) n
+        from store group by grouping sets ((s_state), (s_store_name), ())
+        order by s_state nulls last, s_store_name nulls last, n
+    """, """
+        select * from (
+          select s_state, null s_store_name, count(*) n
+          from store group by s_state
+          union all
+          select null, s_store_name, count(*) from store group by s_store_name
+          union all
+          select null, null, count(*) from store
+        )
+        order by s_state nulls last, s_store_name nulls last, n
+    """)
