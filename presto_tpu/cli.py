@@ -1,0 +1,110 @@
+"""Interactive SQL shell over the statement protocol.
+
+Minimal terminal client in the spirit of the reference CLI (reference
+presto-cli/.../Console.java + AlignedTablePrinter): reads statements
+(``;``-terminated), runs them via the HTTP protocol, prints aligned
+tables. ``--execute`` runs one statement and exits; ``--server`` may be
+omitted to run an in-process server (handy on a TPU host).
+
+Usage:
+    python -m presto_tpu.cli [--server http://host:port]
+                             [--catalog tpch] [--schema default]
+                             [--execute SQL] [--sf 0.01]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .client import QueryFailed, StatementClient
+
+
+def format_aligned(columns, rows) -> str:
+    headers = [c[0] for c in columns]
+    cells = [["NULL" if v is None else str(v) for v in r] for r in rows]
+    widths = [len(h) for h in headers]
+    for r in cells:
+        for i, v in enumerate(r):
+            widths[i] = max(widths[i], len(v))
+    numeric = [t in ("bigint", "integer", "double", "real", "smallint",
+                     "tinyint") or t.startswith("decimal")
+               for _, t in columns]
+
+    def fmt_row(vals):
+        out = []
+        for v, w, num in zip(vals, widths, numeric):
+            out.append(v.rjust(w) if num else v.ljust(w))
+        return " | ".join(out)
+
+    lines = [fmt_row(headers),
+             "-+-".join("-" * w for w in widths)]
+    lines += [fmt_row(r) for r in cells]
+    return "\n".join(lines)
+
+
+def run_statement(client: StatementClient, sql: str,
+                  out=sys.stdout) -> None:
+    try:
+        res = client.execute(sql)
+    except QueryFailed as e:
+        print(f"Query failed: {e}", file=sys.stderr)
+        return
+    if res.columns:
+        print(format_aligned(res.columns, res.rows), file=out)
+    print(f"({len(res.rows)} row{'s' if len(res.rows) != 1 else ''})",
+          file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="presto-tpu")
+    ap.add_argument("--server", default=None,
+                    help="server URL; omitted = embedded in-process server")
+    ap.add_argument("--catalog", default="tpch")
+    ap.add_argument("--schema", default="default")
+    ap.add_argument("--user", default="presto")
+    ap.add_argument("--execute", "-e", default=None,
+                    help="run this statement and exit")
+    ap.add_argument("--sf", type=float, default=0.01,
+                    help="tpch scale factor for the embedded server")
+    args = ap.parse_args(argv)
+
+    embedded = None
+    url = args.server
+    if url is None:
+        from .exec.runner import LocalRunner
+        from .server import PrestoTpuServer
+        embedded = PrestoTpuServer(LocalRunner(tpch_sf=args.sf))
+        embedded.start()
+        url = f"http://127.0.0.1:{embedded.port}"
+        print(f"embedded server at {url}", file=sys.stderr)
+
+    client = StatementClient(url, user=args.user, catalog=args.catalog,
+                             schema=args.schema)
+    try:
+        if args.execute is not None:
+            for stmt in args.execute.split(";"):
+                if stmt.strip():
+                    run_statement(client, stmt)
+            return 0
+        buf = ""
+        while True:
+            try:
+                prompt = "presto-tpu> " if not buf else "        ...> "
+                line = input(prompt)
+            except EOFError:
+                break
+            buf += ("\n" if buf else "") + line
+            while ";" in buf:
+                stmt, buf = buf.split(";", 1)
+                if stmt.strip():
+                    if stmt.strip().lower() in ("quit", "exit"):
+                        return 0
+                    run_statement(client, stmt)
+        return 0
+    finally:
+        if embedded is not None:
+            embedded.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Statement protocol client: POST /v1/statement, follow nextUri.
+
+The Python analogue of the reference client (reference
+presto-client/.../StatementClientV1.java:86 — execute():147 POSTs the
+statement, advance():339 follows ``nextUri`` until it is absent; session
+mutations arrive via X-Presto-Set-Session / X-Presto-Clear-Session
+response headers, client/PrestoHeaders.java:30-31). Uses only the
+standard library (urllib) — the role OkHttp plays for the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import urllib.parse
+import urllib.request
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class QueryFailed(Exception):
+    def __init__(self, error: Dict):
+        super().__init__(error.get("message", "query failed"))
+        self.error = error
+
+
+@dataclasses.dataclass
+class ClientResult:
+    columns: List[Tuple[str, str]]          # (name, type display)
+    rows: List[List[object]]
+    query_id: str
+
+
+class StatementClient:
+    def __init__(self, base_url: str, user: str = "presto",
+                 catalog: Optional[str] = None,
+                 schema: Optional[str] = None,
+                 timeout: float = 3600.0):
+        self.base_url = base_url.rstrip("/")
+        self.user = user
+        self.catalog = catalog
+        self.schema = schema
+        self.timeout = timeout
+        self.session_properties: Dict[str, str] = {}
+
+    # -- protocol ------------------------------------------------------------
+    def _request(self, url: str, method: str = "GET",
+                 body: Optional[bytes] = None):
+        headers = {"X-Presto-User": self.user}
+        if self.catalog:
+            headers["X-Presto-Catalog"] = self.catalog
+        if self.schema:
+            headers["X-Presto-Schema"] = self.schema
+        if self.session_properties:
+            headers["X-Presto-Session"] = ",".join(
+                f"{k}={urllib.parse.quote(str(v))}"
+                for k, v in self.session_properties.items())
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            doc = json.loads(resp.read() or b"{}")
+            for header, value in resp.headers.items():
+                if header == "X-Presto-Set-Session" and "=" in value:
+                    k, v = value.split("=", 1)
+                    self.session_properties[k.strip()] = v.strip()
+                elif header == "X-Presto-Clear-Session":
+                    self.session_properties.pop(value.strip(), None)
+            return doc
+
+    def pages(self, sql: str) -> Iterator[Dict]:
+        """Yield raw QueryResults documents until the query drains."""
+        doc = self._request(f"{self.base_url}/v1/statement", "POST",
+                            sql.encode())
+        yield doc
+        while doc.get("nextUri"):
+            doc = self._request(doc["nextUri"])
+            yield doc
+        if doc.get("error"):
+            raise QueryFailed(doc["error"])
+
+    def execute(self, sql: str) -> ClientResult:
+        columns: List[Tuple[str, str]] = []
+        rows: List[List[object]] = []
+        qid = ""
+        for doc in self.pages(sql):
+            qid = doc.get("id", qid)
+            if doc.get("columns") and not columns:
+                columns = [(c["name"], c["type"]) for c in doc["columns"]]
+            rows.extend(doc.get("data") or [])
+        return ClientResult(columns=columns, rows=rows, query_id=qid)

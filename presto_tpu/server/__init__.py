@@ -1,0 +1,3 @@
+from .protocol import PrestoTpuServer
+
+__all__ = ["PrestoTpuServer"]

@@ -1,0 +1,315 @@
+"""HTTP statement protocol: the client-facing front door.
+
+Wire-compatible (for the paths a basic client uses) with the reference's
+statement protocol (reference presto-client/.../StatementClientV1.java:147
+POSTs /v1/statement then polls ``nextUri`` :339 until it disappears;
+dispatcher/QueuedStatementResource.java:146-167 and
+server/protocol/ExecutingStatementResource.java:147 serve it):
+
+- ``POST /v1/statement`` with the SQL body and X-Presto-* session headers
+  returns a QueryResults JSON document whose ``nextUri`` pages through
+  results;
+- ``GET  /v1/statement/executing/{id}/{slug}/{token}`` returns columns +
+  a data page + the next ``nextUri`` (absent on the final page);
+- ``DELETE /v1/statement/executing/{id}/{slug}/{token}`` cancels;
+- session mutations round-trip through response headers
+  (X-Presto-Set-Session / X-Presto-Clear-Session — reference
+  client/PrestoHeaders.java:30-31), keeping the server stateless about
+  client session state.
+
+Queries execute on a LocalRunner in a worker thread; pages stream from a
+bounded queue — the role of the coordinator's per-query output buffer
+(reference server/protocol/Query.java:99 pulling via ExchangeClient).
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import math
+import queue
+import secrets
+import threading
+import urllib.parse
+from decimal import Decimal
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+ROWS_PER_PAGE = 4096
+
+
+def _json_value(v):
+    if v is None or isinstance(v, (int, float, str, bool)):
+        if isinstance(v, float) and not math.isfinite(v):
+            return str(v)
+        return v
+    if hasattr(v, "item"):            # numpy scalar
+        return _json_value(v.item())
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    if isinstance(v, Decimal):
+        return str(v)
+    return str(v)
+
+
+class _Query:
+    """One running statement: executes in a thread, pages buffered."""
+
+    def __init__(self, qid: str, slug: str, sql: str, runner,
+                 session_overrides: Dict[str, str],
+                 exec_lock: threading.Lock):
+        self.id = qid
+        self.slug = slug
+        self.sql = sql
+        self._exec_lock = exec_lock
+        self.state = "QUEUED"
+        self.error: Optional[Dict] = None
+        self.columns: Optional[List[Dict]] = None
+        self.set_session: Dict[str, str] = {}
+        self.clear_session: List[str] = []
+        self._pages: "queue.Queue" = queue.Queue(maxsize=8)
+        self._next_token = 0
+        self._last_page: Optional[Tuple[int, Optional[List]]] = None
+        self._page_lock = threading.Lock()
+        self._cancelled = threading.Event()
+        self._runner = runner
+        self._overrides = session_overrides
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------------
+    def _run(self) -> None:
+        self.state = "RUNNING"
+        try:
+            # one statement at a time: the runner's session (and the
+            # single device) is shared — the role of queued dispatch
+            # (reference dispatcher/DispatchManager.java:134)
+            with self._exec_lock:
+                props = self._runner.session.properties
+                saved = {k: props.get(k) for k in self._overrides}
+                props.update(self._overrides)
+                try:
+                    res = self._runner.execute(self.sql)
+                finally:
+                    for k, v in saved.items():
+                        if v is None:
+                            props.pop(k, None)
+                        else:
+                            props[k] = v
+            self.columns = [
+                {"name": n, "type": t.display()}
+                for n, t in zip(res.names, res.types)
+            ]
+            sql_head = self.sql.lstrip().lower()
+            if sql_head.startswith("set session"):
+                stmt = self.sql.lstrip()[len("set session"):].strip()
+                if "=" in stmt:
+                    k, v = stmt.split("=", 1)
+                    self.set_session[k.strip()] = v.strip().strip("'")
+            elif sql_head.startswith("reset session"):
+                self.clear_session.append(
+                    self.sql.lstrip()[len("reset session"):].strip())
+            rows = res.rows
+            for i in range(0, max(len(rows), 1), ROWS_PER_PAGE):
+                if self._cancelled.is_set():
+                    break
+                page = [[_json_value(v) for v in r]
+                        for r in rows[i:i + ROWS_PER_PAGE]]
+                self._put_page(page)
+            self.state = "FINISHED"
+        except Exception as e:  # surfaced as QueryError, not a 500
+            self.state = "FAILED"
+            self.error = {
+                "message": str(e),
+                "errorCode": 1,
+                "errorName": type(e).__name__,
+                "errorType": "USER_ERROR",
+            }
+            self._put_page(None)
+        self._put_page(None)          # end-of-stream sentinel
+
+    def _put_page(self, page) -> None:
+        """Bounded put that gives up if the query is cancelled (a cancel
+        with no consumer left must not pin the producer thread)."""
+        while not self._cancelled.is_set():
+            try:
+                self._pages.put(page, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer ------------------------------------------------------------
+    def next_page(self, token: int):
+        """Page for ``token``; the last token may be re-requested (the
+        reference protocol's restartable token semantics). Serialized:
+        a client retry racing its own original request must not consume
+        two pages."""
+        with self._page_lock:
+            if self._last_page is not None and self._last_page[0] == token:
+                return self._last_page[1]
+            if token != self._next_token:
+                raise KeyError(f"token {token} is gone")
+            while True:
+                if self._cancelled.is_set():
+                    page = None       # end-of-stream; error carries cause
+                    break
+                try:
+                    page = self._pages.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    continue
+            self._last_page = (token, page)
+            self._next_token = token + 1
+            return page
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+        self.state = "FAILED"
+        self.error = {"message": "Query was canceled", "errorCode": 1,
+                      "errorName": "USER_CANCELED",
+                      "errorType": "USER_ERROR"}
+        while True:                   # unblock/starve the producer
+            try:
+                self._pages.get_nowait()
+            except queue.Empty:
+                break
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "presto-tpu"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # silence request logging
+        pass
+
+    @property
+    def _srv(self) -> "PrestoTpuServer":
+        return self.server.presto       # type: ignore[attr-defined]
+
+    def _reply(self, code: int, doc: Dict,
+               headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:
+        if self.path != "/v1/statement":
+            self._reply(404, {"error": "not found"})
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        sql = self.rfile.read(n).decode()
+        overrides = {}
+        for part in (self.headers.get("X-Presto-Session") or "").split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                overrides[k.strip()] = urllib.parse.unquote(v.strip())
+        q = self._srv.create_query(sql, overrides)
+        self._reply(200, self._results_doc(q, 0, first=True))
+
+    def do_GET(self) -> None:
+        m = self._match_executing()
+        if m is None:
+            self._reply(404, {"error": "not found"})
+            return
+        q, token = m
+        try:
+            page = q.next_page(token)
+        except KeyError as e:
+            self._reply(410, {"error": str(e)})
+            return
+        headers = {}
+        for k, v in q.set_session.items():
+            headers["X-Presto-Set-Session"] = f"{k}={v}"
+        for k in q.clear_session:
+            headers["X-Presto-Clear-Session"] = k
+        self._reply(200, self._results_doc(q, token, page=page),
+                    headers)
+
+    def do_DELETE(self) -> None:
+        m = self._match_executing()
+        if m is None:
+            self._reply(404, {"error": "not found"})
+            return
+        q, _ = m
+        q.cancel()
+        self._reply(200, {})
+
+    def _match_executing(self):
+        parts = self.path.strip("/").split("/")
+        # v1/statement/executing/{id}/{slug}/{token}
+        if len(parts) != 6 or parts[:3] != ["v1", "statement", "executing"]:
+            return None
+        q = self._srv.queries.get(parts[3])
+        if q is None or q.slug != parts[4]:
+            return None
+        return q, int(parts[5])
+
+    def _results_doc(self, q: _Query, token: int, first: bool = False,
+                     page=None) -> Dict:
+        base = f"http://{self.headers.get('Host', 'localhost')}"
+        doc: Dict = {
+            "id": q.id,
+            "infoUri": f"{base}/ui/query/{q.id}",
+            "stats": {"state": q.state},
+        }
+        if first:
+            doc["nextUri"] = (f"{base}/v1/statement/executing/"
+                              f"{q.id}/{q.slug}/0")
+            return doc
+        if q.columns is not None:
+            doc["columns"] = q.columns
+        if page is not None:
+            doc["data"] = page
+            doc["nextUri"] = (f"{base}/v1/statement/executing/"
+                              f"{q.id}/{q.slug}/{token + 1}")
+        elif q.error is not None:
+            doc["error"] = q.error
+        return doc
+
+
+class PrestoTpuServer:
+    """Embeddable statement server over a LocalRunner."""
+
+    def __init__(self, runner=None, host: str = "127.0.0.1", port: int = 0):
+        if runner is None:
+            from ..exec.runner import LocalRunner
+            runner = LocalRunner()
+        self.runner = runner
+        self.queries: Dict[str, _Query] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._exec_lock = threading.Lock()
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.presto = self      # type: ignore[attr-defined]
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+
+    def create_query(self, sql: str, overrides: Dict[str, str]) -> _Query:
+        with self._lock:
+            self._seq += 1
+            qid = (f"{datetime.date.today().strftime('%Y%m%d')}"
+                   f"_{self._seq:06d}")
+        q = _Query(qid, secrets.token_hex(8), sql, self.runner, overrides,
+                   self._exec_lock)
+        with self._lock:
+            self.queries[qid] = q
+            if len(self.queries) > 200:   # evict oldest drained queries
+                for old_id in list(self.queries):
+                    old = self.queries[old_id]
+                    if old is not q and old.state in ("FINISHED", "FAILED"):
+                        del self.queries[old_id]
+                    if len(self.queries) <= 100:
+                        break
+        return q
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

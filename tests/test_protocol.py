@@ -1,0 +1,89 @@
+"""Statement protocol over real HTTP (ring-3: real server, real sockets).
+
+The analogue of the reference's TestingPrestoServer-based protocol tests
+(reference presto-tests/.../DistributedQueryRunner.java boots real HTTP
+servers; presto-client/.../StatementClientV1.java:147,339 is the client
+loop being exercised here)."""
+import json
+import urllib.request
+
+import pytest
+
+from presto_tpu.client import QueryFailed, StatementClient
+from presto_tpu.exec.runner import LocalRunner
+from presto_tpu.server import PrestoTpuServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = PrestoTpuServer(LocalRunner(tpch_sf=0.001))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return StatementClient(f"http://127.0.0.1:{server.port}")
+
+
+def test_simple_query(server, client):
+    res = client.execute("select n_name, n_regionkey from nation "
+                         "order by n_name limit 3")
+    assert [c[0] for c in res.columns] == ["n_name", "n_regionkey"]
+    assert len(res.rows) == 3
+    assert res.rows[0][0] == "ALGERIA"
+    # results match the in-process runner
+    direct = server.runner.execute(
+        "select n_name, n_regionkey from nation order by n_name limit 3")
+    assert [list(r) for r in res.rows] == \
+        [[v if not hasattr(v, "item") else v.item() for v in r]
+         for r in direct.rows]
+
+
+def test_multi_page(server, client):
+    res = client.execute("select l_orderkey from lineitem")
+    direct = server.runner.execute("select count(*) from lineitem")
+    assert len(res.rows) == direct.rows[0][0]
+
+
+def test_error_surfaces_as_query_error(server, client):
+    with pytest.raises(QueryFailed) as ei:
+        client.execute("select bogus_column from nation")
+    assert "bogus_column" in str(ei.value)
+
+
+def test_session_roundtrip(server, client):
+    client.execute("set session join_distribution_type = 'broadcast'")
+    assert client.session_properties.get("join_distribution_type") \
+        == "broadcast"
+    # the override rides X-Presto-Session on later requests and is
+    # restored server-side after each statement
+    res = client.execute("show session")
+    client.execute("reset session join_distribution_type")
+    assert "join_distribution_type" not in client.session_properties
+
+
+def test_raw_protocol_shape(server):
+    """The wire documents look like the reference's QueryResults."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/v1/statement",
+        data=b"select 1", method="POST",
+        headers={"X-Presto-User": "test"})
+    with urllib.request.urlopen(req) as resp:
+        doc = json.loads(resp.read())
+    assert set(doc) >= {"id", "infoUri", "nextUri", "stats"}
+    with urllib.request.urlopen(doc["nextUri"]) as resp:
+        doc2 = json.loads(resp.read())
+    assert doc2["columns"][0]["type"] == "bigint"
+    assert doc2["data"] == [[1]]
+
+
+def test_cancel(server, client):
+    doc = StatementClient(f"http://127.0.0.1:{server.port}")
+    pages = doc.pages("select count(*) from lineitem")
+    first = next(pages)
+    req = urllib.request.Request(first["nextUri"], method="DELETE")
+    urllib.request.urlopen(req)
+    q = server.queries[first["id"]]
+    assert q.state == "FAILED"
