@@ -1,6 +1,2 @@
-from .operators import (  # noqa: F401
-    Operator, TableScanOperator, FilterProjectOperator, AggregationOperator,
-    OrderByOperator, TopNOperator, LimitOperator, HashBuildOperator,
-    LookupJoinOperator, ValuesOperator,
-)
-from .driver import Driver, Pipeline, run_pipeline  # noqa: F401
+from .local import QueryResult, execute_plan  # noqa: F401
+from .runner import LocalRunner  # noqa: F401

@@ -62,8 +62,8 @@ def run_init_plans(ex, plan: LogicalPlan) -> None:
 
 
 def execute_plan(plan: LogicalPlan, session: Session,
-                 rows_per_batch: int = 1 << 17) -> QueryResult:
-    ex = _Executor(session, rows_per_batch)
+                 rows_per_batch: int = 1 << 17, stats=None) -> QueryResult:
+    ex = _Executor(session, rows_per_batch, stats=stats)
     run_init_plans(ex, plan)
     root = plan.root
     out_batches = list(ex.run(root.child))
@@ -106,10 +106,12 @@ def mark_exists_mask(probe: Batch, build: Batch, probe_keys, build_keys,
 
 
 class _Executor:
-    def __init__(self, session: Session, rows_per_batch: int):
+    def __init__(self, session: Session, rows_per_batch: int,
+                 stats=None):
         self.session = session
         self.rows_per_batch = rows_per_batch
         self.init_values: List[object] = []
+        self.stats = stats
         self._shared: set = set()
         self._materialized: Dict[PlanNode, List[Batch]] = {}
         from ..memory import QueryMemoryPool
@@ -150,11 +152,16 @@ class _Executor:
     # -- dispatch -------------------------------------------------------------
     def run(self, node: PlanNode) -> Iterator[Batch]:
         if node in self._materialized:
+            # cache replay: the node's stats already recorded the one real
+            # execution — don't re-wrap or double-count
             return iter(self._materialized[node])
         m = getattr(self, "_" + type(node).__name__)
         if node in self._shared:
             return self._run_memoized(node, m)
-        return m(node)
+        it = m(node)
+        if self.stats is not None:
+            it = self.stats.wrap(node, it)
+        return it
 
     def _run_memoized(self, node: PlanNode, m) -> Iterator[Batch]:
         """Materialize a shared subplan's output once, within the memory
@@ -166,6 +173,8 @@ class _Executor:
         from .spill import batch_device_bytes
         ctx = self.pool.context(f"memo-{type(node).__name__}")
         it = m(node)
+        if self.stats is not None:
+            it = self.stats.wrap(node, it)
         out: List[Batch] = []
         for b in it:
             if not ctx.pool.try_reserve(batch_device_bytes(b), ctx):

@@ -59,9 +59,27 @@ class LocalRunner:
         if isinstance(stmt, A.Explain):
             if not isinstance(stmt.statement, A.Query):
                 raise ValueError("EXPLAIN requires a query")
+            import time as _time
+            t0 = _time.perf_counter()
             plan = optimize(plan_query(stmt.statement, self.session),
                             self.session)
-            text = print_plan(plan)
+            stats = None
+            if stmt.analyze:
+                # EXPLAIN ANALYZE: run the query with per-operator stats,
+                # draining batches without materializing client rows
+                # (reference operator/ExplainAnalyzeOperator.java)
+                from .local import _Executor, run_init_plans
+                from .stats import StatsCollector
+                stats = StatsCollector(count_rows=True)
+                stats.planning_s = _time.perf_counter() - t0
+                t1 = _time.perf_counter()
+                ex = _Executor(self.session, self.rows_per_batch,
+                               stats=stats)
+                run_init_plans(ex, plan)
+                for _ in ex.run(plan.root.child):
+                    pass
+                stats.total_wall_s = _time.perf_counter() - t1
+            text = print_plan(plan, stats)
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.split("\n")])
         if isinstance(stmt, A.ShowCatalogs):

@@ -1,0 +1,59 @@
+"""Per-operator execution statistics.
+
+The role of the reference's stats objects — OperatorStats/DriverStats
+recorded by OperationTimer inside the Driver loop (reference
+operator/Driver.java:380-385, operator/OperatorStats.java) and surfaced
+through EXPLAIN ANALYZE (operator/ExplainAnalyzeOperator.java): every
+plan-node iterator is wrapped to record wall time, batches, and (in
+analyze mode, where a device sync per batch is acceptable) live rows.
+
+Wall time is inclusive — a node's clock runs while it waits on its
+children — so the printer reports exclusive time by subtracting child
+inclusive times, mirroring how the reference separates operator wall
+from blocked time.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterator, Optional
+
+
+@dataclasses.dataclass
+class NodeStats:
+    wall_s: float = 0.0          # inclusive iterator time
+    batches: int = 0
+    rows: int = 0                # live rows (analyze mode only)
+    capacity: int = 0            # total batch capacity emitted
+
+
+class StatsCollector:
+    """Collects NodeStats keyed by plan-node object identity."""
+
+    def __init__(self, count_rows: bool = False):
+        self.count_rows = count_rows
+        self.by_node: Dict[int, NodeStats] = {}
+        self.total_wall_s: float = 0.0
+        self.planning_s: float = 0.0
+
+    def stats_for(self, node) -> Optional[NodeStats]:
+        return self.by_node.get(id(node))
+
+    def wrap(self, node, it: Iterator) -> Iterator:
+        st = self.by_node.setdefault(id(node), NodeStats())
+
+        def timed():
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    st.wall_s += time.perf_counter() - t0
+                    return
+                st.wall_s += time.perf_counter() - t0
+                st.batches += 1
+                st.capacity += b.capacity
+                if self.count_rows:
+                    st.rows += b.host_count()
+                yield b
+        return timed()

@@ -8,19 +8,26 @@ from __future__ import annotations
 from typing import List
 
 from .plan import (
-    AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
-    OutputNode, PlanNode, ProjectNode, SemiJoinNode, SortNode,
+    AggregationNode, DistinctNode, FilterNode, GroupIdNode, JoinNode,
+    LimitNode, OutputNode, PlanNode, ProjectNode, SemiJoinNode, SortNode,
     TableScanNode, TopNNode, UnionNode, ValuesNode,
 )
 from .planner import LogicalPlan
 
 
-def print_plan(plan: LogicalPlan) -> str:
+def print_plan(plan: LogicalPlan, stats=None) -> str:
+    """Text plan; with a StatsCollector, annotates each node with runtime
+    stats — EXPLAIN ANALYZE (reference planprinter/PlanPrinter.java
+    textDistributedPlan with ExplainAnalyzeOperator stats)."""
     lines: List[str] = []
-    _walk(plan.root, 0, lines)
+    _walk(plan.root, 0, lines, stats)
     for i, init in enumerate(plan.init_plans):
         lines.append(f"InitPlan[{i}]:")
-        _walk(init, 1, lines)
+        _walk(init, 1, lines, stats)
+    if stats is not None:
+        lines.append(
+            f"Total: {stats.total_wall_s * 1e3:,.0f}ms "
+            f"(planning {stats.planning_s * 1e3:,.0f}ms)")
     return "\n".join(lines)
 
 
@@ -56,12 +63,28 @@ def _label(n: PlanNode) -> str:
         return f"Union[{'distinct' if n.distinct else 'all'}]"
     if isinstance(n, ValuesNode):
         return f"Values[{len(n.rows)} rows]"
+    if isinstance(n, GroupIdNode):
+        return f"GroupId[sets={list(map(list, n.grouping_sets))}]"
     if isinstance(n, OutputNode):
         return f"Output => [{cols}]"
     return type(n).__name__
 
 
-def _walk(n: PlanNode, depth: int, lines: List[str]) -> None:
-    lines.append("  " * depth + "- " + _label(n))
+def _walk(n: PlanNode, depth: int, lines: List[str], stats=None) -> None:
+    suffix = ""
+    if stats is not None:
+        st = stats.stats_for(n)
+        if st is not None:
+            child_wall = sum(
+                (stats.stats_for(c).wall_s
+                 if stats.stats_for(c) is not None else 0.0)
+                for c in n.children)
+            self_ms = max(st.wall_s - child_wall, 0.0) * 1e3
+            suffix = (f"   [self {self_ms:,.1f}ms, wall "
+                      f"{st.wall_s * 1e3:,.1f}ms, {st.rows:,} rows, "
+                      f"{st.batches} batches]")
+        elif not isinstance(n, OutputNode):
+            suffix = "   [not executed]"
+    lines.append("  " * depth + "- " + _label(n) + suffix)
     for c in n.children:
-        _walk(c, depth + 1, lines)
+        _walk(c, depth + 1, lines, stats)

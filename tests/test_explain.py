@@ -1,0 +1,43 @@
+"""EXPLAIN / EXPLAIN ANALYZE surface.
+
+Reference parity: planprinter text plans + ExplainAnalyzeOperator runtime
+stats (reference sql/planner/planprinter/PlanPrinter.java,
+operator/ExplainAnalyzeOperator.java)."""
+import re
+
+import pytest
+
+from presto_tpu.exec.runner import LocalRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalRunner(tpch_sf=0.01)
+
+
+def test_explain_shows_plan(runner):
+    res = runner.execute("explain select count(*) from nation")
+    text = "\n".join(r[0] for r in res.rows)
+    assert "TableScan[tpch.default.nation]" in text
+    assert "Aggregate" in text
+    assert "ms" not in text          # no runtime stats without ANALYZE
+
+
+def test_explain_analyze_shows_stats(runner):
+    res = runner.execute(
+        "explain analyze select n_regionkey, count(*) from nation "
+        "group by n_regionkey")
+    text = "\n".join(r[0] for r in res.rows)
+    # per-operator wall/self/rows annotations
+    assert re.search(r"TableScan\[tpch.default.nation\].*"
+                     r"\[self [\d,.]+ms, wall [\d,.]+ms, 25 rows", text)
+    assert re.search(r"Aggregate.*5 rows", text)
+    assert re.search(r"Total: [\d,]+ms \(planning [\d,]+ms\)", text)
+
+
+def test_explain_analyze_join_rows(runner):
+    res = runner.execute(
+        "explain analyze select count(*) from nation, region "
+        "where n_regionkey = r_regionkey")
+    text = "\n".join(r[0] for r in res.rows)
+    assert re.search(r"Join\[inner.*25 rows", text)
