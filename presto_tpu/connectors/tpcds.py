@@ -320,8 +320,8 @@ class TpcdsPageSource(PageSource):
         self.columns = list(columns)
         self.rows_per_batch = rows_per_batch
 
-    def batches(self) -> Iterator[Batch]:
-        from .tpch import _to_batch
+    def host_chunks(self):
+        """(schema, generated column dict, n) per chunk, host-side only."""
         table = self.split.table.table
         schema = tpcds_schema(table)
         start, end = self.split.info
@@ -329,8 +329,12 @@ class TpcdsPageSource(PageSource):
         for a in range(start, end, self.rows_per_batch):
             b = min(a + self.rows_per_batch, end)
             keys = np.arange(a, b, dtype=np.int64)
-            data = genfn(keys, self.columns)
-            yield _to_batch(schema, self.columns, data, b - a)
+            yield schema, genfn(keys, self.columns), b - a
+
+    def batches(self) -> Iterator[Batch]:
+        from .tpch import _to_batch
+        for schema, data, n in self.host_chunks():
+            yield _to_batch(schema, self.columns, data, n)
 
 
 class _Metadata(ConnectorMetadata):

@@ -517,7 +517,10 @@ class TpchPageSource(PageSource):
         self.columns = list(columns)
         self.rows_per_batch = rows_per_batch
 
-    def batches(self) -> Iterator[Batch]:
+    def host_chunks(self):
+        """(schema, generated column dict, row count) per chunk, host-side
+        only — lets callers that want host arrays (bench staging, oracles)
+        skip the device round trip."""
         table = self.split.table.table
         schema = tpch_schema(table)
         if table == "lineitem":
@@ -532,15 +535,18 @@ class TpchPageSource(PageSource):
                 ln = np.arange(len(rep_ok)) - np.repeat(
                     np.cumsum(counts) - counts, counts)
                 data = self.gen.lineitem(rep_ok, ln, self.columns)
-                yield _to_batch(schema, self.columns, data, len(rep_ok))
+                yield schema, data, len(rep_ok)
             return
         start, end = self.split.info
         genfn = getattr(self.gen, table)
         for a in range(start, end, self.rows_per_batch):
             b = min(a + self.rows_per_batch, end)
             keys = np.arange(a, b, dtype=np.int64)
-            data = genfn(keys, self.columns)
-            yield _to_batch(schema, self.columns, data, b - a)
+            yield schema, genfn(keys, self.columns), b - a
+
+    def batches(self) -> Iterator[Batch]:
+        for schema, data, n in self.host_chunks():
+            yield _to_batch(schema, self.columns, data, n)
 
 
 def tpch_schema(table: str) -> Schema:

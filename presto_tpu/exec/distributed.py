@@ -139,6 +139,7 @@ class DistributedExecutor(_Executor):
         splits = conn.split_manager.splits(node.table, self.n)
         streams = [
             conn.page_source(s, list(node.columns),
+                             pushdown=node.pushdown or None,
                              rows_per_batch=self.rows_per_batch).batches()
             for s in splits
         ]

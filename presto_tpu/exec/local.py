@@ -62,12 +62,19 @@ def run_init_plans(ex, plan: LogicalPlan) -> None:
 
 
 def execute_plan(plan: LogicalPlan, session: Session,
-                 rows_per_batch: int = 1 << 17, stats=None) -> QueryResult:
+                 rows_per_batch: int = 1 << 17, stats=None,
+                 collect_rows: bool = True) -> QueryResult:
     ex = _Executor(session, rows_per_batch, stats=stats)
     run_init_plans(ex, plan)
     root = plan.root
-    out_batches = list(ex.run(root.child))
-    rows = [r for b in out_batches for r in b.to_pylist()]
+    rows: List[tuple] = []
+    if collect_rows:
+        out_batches = list(ex.run(root.child))
+        rows = [r for b in out_batches for r in b.to_pylist()]
+    else:
+        # EXPLAIN ANALYZE: drain for stats, skip row materialization
+        for _ in ex.run(root.child):
+            pass
     return QueryResult(names=[f.name for f in root.fields],
                        types=[f.type for f in root.fields], rows=rows)
 
@@ -192,11 +199,84 @@ class _Executor:
 
     # -- leaves ---------------------------------------------------------------
     def _TableScanNode(self, node: TableScanNode) -> Iterator[Batch]:
+        """Split-parallel scan with async host-side prefetch: worker
+        threads run the connector page sources (generation / file decode
+        / host->device staging) ahead of the consumer, so device compute
+        overlaps input production — the role of the reference's split
+        pipeline (execution/SqlTaskExecution.java:390 one driver per
+        split + BufferingSplitSource prefetch).
+
+        Delivery is in deterministic split order (per-split reorder
+        queues): physical row order feeds order-sensitive downstream
+        semantics (ROWS window frames with ties, LIMIT-without-ORDER),
+        so prefetch must not reshuffle it run to run."""
+        import queue as _queue
+        import threading
+
         conn = self.session.catalogs.get(node.catalog)
-        for split in conn.split_manager.splits(node.table, 1):
-            src = conn.page_source(split, list(node.columns),
-                                   rows_per_batch=self.rows_per_batch)
-            yield from src.batches()
+        pushdown = node.pushdown or None
+        n_threads = int(self.session.properties.get("scan_threads", 2))
+        splits = conn.split_manager.splits(
+            node.table, max(n_threads, 1))
+        if n_threads <= 1 or len(splits) <= 1:
+            for split in splits:
+                src = conn.page_source(split, list(node.columns),
+                                       pushdown=pushdown,
+                                       rows_per_batch=self.rows_per_batch)
+                yield from src.batches()
+            return
+
+        DONE = object()
+        stop = threading.Event()     # consumer gone (e.g. LIMIT satisfied)
+        # one bounded queue per split; the consumer drains them in split
+        # order while workers fill later splits ahead of it
+        queues = [_queue.Queue(maxsize=4) for _ in splits]
+        pending = _queue.Queue()
+        for i in range(len(splits)):
+            pending.put(i)
+
+        def put(q, item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    i = pending.get_nowait()
+                except _queue.Empty:
+                    return
+                try:
+                    src = conn.page_source(
+                        splits[i], list(node.columns), pushdown=pushdown,
+                        rows_per_batch=self.rows_per_batch)
+                    for b in src.batches():
+                        if not put(queues[i], b):
+                            return
+                except BaseException as e:  # surfaced on the consumer side
+                    put(queues[i], e)
+                    return
+                put(queues[i], DONE)
+
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(min(n_threads, len(splits)))]
+        for w in workers:
+            w.start()
+        try:
+            for q in queues:
+                while True:
+                    item = q.get()
+                    if item is DONE:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+        finally:
+            stop.set()
 
     def _ValuesNode(self, node: ValuesNode) -> Iterator[Batch]:
         data = {

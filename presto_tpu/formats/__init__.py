@@ -1,0 +1,1 @@
+"""File-format readers (reference presto-orc/, presto-parquet/)."""

@@ -1,0 +1,301 @@
+"""ORC file reader: host metadata/pruning, device column decode.
+
+Port of concept from the reference's from-scratch ORC reader (reference
+presto-orc/.../OrcReader.java:50 parses the tail;
+OrcRecordReader.java:70,366 iterates stripes and materializes columns
+via per-type stream readers; TupleDomainOrcPredicate.java:77 prunes
+stripes on min/max statistics). TPU-first split: stripe/footer parsing
+and pruning stay on host; the bulk value decode (RLEv2 bit-unpacking,
+IEEE byte assembly) runs as vectorized device kernels (orc_rle.py), and
+columns land directly as device-resident ``Column``s.
+
+IO is ranged: the tail parses from a bounded suffix read and each stripe
+reads exactly its byte range — no whole-file slurp.
+
+Supported today: struct root over boolean/byte/int/long/short/float/
+double/string/varchar/char/date columns, NONE or ZLIB compression,
+DIRECT/DIRECT_V2/DICTIONARY_V2 encodings, nulls via present streams,
+file- and stripe-level min/max pruning.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..batch import Batch, Column, Schema, bucket_capacity
+from .orc_meta import (
+    ColumnIntStats, OrcFileTail, StripeFooter, StripeInfo,
+    decompress_stream, parse_stripe_footer, read_tail, tail_size_needed,
+)
+from .orc_rle import (
+    decode_byte_rle, decode_present, decode_rle_v2_device,
+    decode_rle_v2_numpy,
+)
+
+_ORC_TO_ENGINE = {
+    "boolean": T.BOOLEAN,
+    "byte": T.TINYINT,
+    "short": T.SMALLINT,
+    "int": T.INTEGER,
+    "long": T.BIGINT,
+    "float": T.DOUBLE,
+    "double": T.DOUBLE,
+    "string": T.VARCHAR,
+    "varchar": T.VARCHAR,
+    "char": T.VARCHAR,
+    "date": T.DATE,
+}
+
+_TAIL_GUESS = 64 * 1024
+
+
+@dataclasses.dataclass
+class OrcColumn:
+    name: str
+    orc_id: int            # type id in the ORC schema tree
+    orc_kind: str
+    type: T.Type
+
+
+class OrcReader:
+    """One ORC file; column-projected, stripe-granular batch iterator."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(0, self._size - _TAIL_GUESS))
+            suffix = f.read()
+            needed = tail_size_needed(suffix)
+            if needed > len(suffix):
+                f.seek(self._size - needed)
+                suffix = f.read()
+        self.tail: OrcFileTail = read_tail(suffix)
+        root = self.tail.types[0]
+        if root.kind != "struct":
+            raise ValueError("only struct-rooted ORC files are supported")
+        self.columns: List[OrcColumn] = []
+        for name, tid in zip(root.field_names, root.subtypes):
+            t = self.tail.types[tid]
+            if t.kind not in _ORC_TO_ENGINE:
+                raise NotImplementedError(
+                    f"ORC column type {t.kind!r} is not supported")
+            engine_t = _ORC_TO_ENGINE[t.kind]
+            if t.kind in ("varchar", "char") and t.max_length:
+                engine_t = T.varchar(t.max_length)
+            self.columns.append(OrcColumn(name, tid, t.kind, engine_t))
+
+    def _read_range(self, offset: int, length: int) -> bytes:
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    @property
+    def schema(self) -> Schema:
+        return Schema([(c.name, c.type) for c in self.columns])
+
+    @property
+    def num_rows(self) -> int:
+        return self.tail.num_rows
+
+    # -- pruning -------------------------------------------------------------
+    def _excluded(self, stats: Dict[int, ColumnIntStats],
+                  min_max: Dict[str, Tuple[int, int]]) -> bool:
+        by_name = {c.name: c for c in self.columns}
+        for name, (lo, hi) in min_max.items():
+            c = by_name.get(name)
+            if c is None:
+                continue
+            st = stats.get(c.orc_id)
+            if st is None or st.min is None or st.max is None:
+                continue
+            # lo/hi of None = unbounded on that side
+            if ((lo is not None and st.max < lo)
+                    or (hi is not None and st.min > hi)):
+                return True
+        return False
+
+    def file_prunable(self, min_max: Dict[str, Tuple[int, int]]) -> bool:
+        return bool(min_max) and self._excluded(self.tail.int_stats,
+                                                min_max)
+
+    def stripe_prunable(self, stripe_index: int,
+                        min_max: Dict[str, Tuple[int, int]]) -> bool:
+        """Per-stripe min/max exclusion from the metadata section
+        (reference TupleDomainOrcPredicate.java:77 over
+        StripeStatistics)."""
+        if not min_max or stripe_index >= len(self.tail.stripe_stats):
+            return False
+        return self._excluded(self.tail.stripe_stats[stripe_index],
+                              min_max)
+
+    # -- stripe decode -------------------------------------------------------
+    def read_stripe(self, stripe: StripeInfo,
+                    names: Sequence[str]) -> Batch:
+        body = self._read_range(
+            stripe.offset,
+            stripe.index_length + stripe.data_length
+            + stripe.footer_length)
+        footer = parse_stripe_footer(
+            body[stripe.index_length + stripe.data_length:],
+            self.tail.compression)
+        n = stripe.num_rows
+        cap = bucket_capacity(n)
+        by_name = {c.name: c for c in self.columns}
+        cols: List[Column] = []
+        fields: List[Tuple[str, T.Type]] = []
+        for name in names:
+            c = by_name[name]
+            cols.append(self._decode_column(c, footer, body, n, cap))
+            fields.append((name, c.type))
+        mask = jnp.arange(cap) < n
+        return Batch(Schema(fields), cols, mask)
+
+    def batches(self, names: Optional[Sequence[str]] = None,
+                min_max: Optional[Dict[str, Tuple[int, int]]] = None
+                ) -> Iterator[Batch]:
+        names = list(names) if names is not None \
+            else [c.name for c in self.columns]
+        if min_max and self.file_prunable(min_max):
+            return
+        for si, stripe in enumerate(self.tail.stripes):
+            if min_max and self.stripe_prunable(si, min_max):
+                continue
+            yield self.read_stripe(stripe, names)
+
+    # -- column decoders -----------------------------------------------------
+    def _streams(self, footer: StripeFooter, body: bytes,
+                 orc_id: int) -> Dict[str, bytes]:
+        out = {}
+        for s in footer.streams:
+            if s.column == orc_id and s.kind in (
+                    "present", "data", "length", "dictionary_data",
+                    "secondary"):
+                raw = body[s.offset:s.offset + s.length]
+                out[s.kind] = decompress_stream(raw,
+                                                self.tail.compression)
+        return out
+
+    def _decode_column(self, c: OrcColumn, footer: StripeFooter,
+                       body: bytes, n: int, cap: int) -> Column:
+        enc = footer.encodings[c.orc_id]
+        streams = self._streams(footer, body, c.orc_id)
+        present = streams.get("present")
+        if present is not None:
+            validity_np = decode_present(present, n)
+        else:
+            validity_np = np.ones(n, dtype=bool)
+        n_values = int(validity_np.sum())
+        validity = np.zeros(cap, dtype=bool)
+        validity[:n] = validity_np
+
+        def scatter_i64(vals: jnp.ndarray) -> jnp.ndarray:
+            """Spread n_values decoded values to their row slots."""
+            if n_values == n:
+                return vals[:cap] if vals.shape[0] >= cap else jnp.pad(
+                    vals, (0, cap - vals.shape[0]))
+            pos = np.zeros(cap, dtype=np.int64)
+            pos[np.nonzero(validity)[0]] = np.arange(n_values)
+            return jnp.take(vals, jnp.asarray(pos), axis=0)
+
+        data = streams.get("data", b"")
+        if c.orc_kind in ("long", "int", "short", "date"):
+            vals = decode_rle_v2_device(data, n_values, signed=True,
+                                        capacity=bucket_capacity(
+                                            max(n_values, 1)))
+            out = scatter_i64(vals)
+            dt = c.type.storage_dtype
+            return Column(c.type, out.astype(dt), jnp.asarray(validity),
+                          None)
+        if c.orc_kind == "byte":
+            # sign-extend: ORC byte is a signed tinyint
+            vals = decode_byte_rle(data, n_values).view(np.int8) \
+                .astype(np.int64)
+            out = scatter_i64(jnp.asarray(vals))
+            return Column(c.type, out.astype(c.type.storage_dtype),
+                          jnp.asarray(validity), None)
+        if c.orc_kind == "boolean":
+            bits = decode_present(data, n_values)
+            out = scatter_i64(jnp.asarray(bits.astype(np.int64)))
+            return Column(c.type, out.astype(bool),
+                          jnp.asarray(validity), None)
+        if c.orc_kind in ("double", "float"):
+            width = 8 if c.orc_kind == "double" else 4
+            raw = np.frombuffer(data, dtype=np.uint8)[:n_values * width]
+            u8 = jnp.asarray(raw)
+            vals = _assemble_ieee(u8, n_values, width)
+            out = scatter_i64(vals)
+            return Column(c.type, out.astype(jnp.float64),
+                          jnp.asarray(validity), None)
+        if c.orc_kind in ("string", "varchar", "char"):
+            return self._decode_string(c, enc, footer, streams, cap,
+                                       validity, n_values, scatter_i64)
+        raise NotImplementedError(c.orc_kind)
+
+    def _decode_string(self, c, enc, footer: StripeFooter, streams, cap,
+                       validity, n_values, scatter_i64) -> Column:
+        if enc == "dictionary_v2":
+            dict_size = footer.dictionary_sizes[c.orc_id]
+            lengths = decode_rle_v2_numpy(
+                streams.get("length", b""), dict_size, signed=False)
+            blob = streams.get("dictionary_data", b"")
+            vocab: List[str] = []
+            pos = 0
+            for ln in lengths:
+                vocab.append(blob[pos:pos + int(ln)].decode(
+                    "utf-8", "replace"))
+                pos += int(ln)
+            codes = decode_rle_v2_device(
+                streams.get("data", b""), n_values, signed=False,
+                capacity=bucket_capacity(max(n_values, 1)))
+            out = scatter_i64(codes)
+            return Column(c.type, out.astype(jnp.int32),
+                          jnp.asarray(validity),
+                          tuple(vocab) or ("",))
+        if enc == "direct_v2":
+            lengths = decode_rle_v2_numpy(
+                streams.get("length", b""), n_values, signed=False)
+            blob = streams.get("data", b"")
+            values: List[str] = []
+            pos = 0
+            for ln in lengths:
+                values.append(blob[pos:pos + int(ln)].decode(
+                    "utf-8", "replace"))
+                pos += int(ln)
+            vocab_list = sorted(set(values))
+            lookup = {s: i for i, s in enumerate(vocab_list)}
+            codes_np = np.asarray([lookup[s] for s in values],
+                                  dtype=np.int64)
+            out = scatter_i64(jnp.asarray(codes_np))
+            return Column(c.type, out.astype(jnp.int32),
+                          jnp.asarray(validity),
+                          tuple(vocab_list) or ("",))
+        raise NotImplementedError(f"string encoding {enc!r}")
+
+
+@jax.jit
+def _assemble_ieee_f64(u8: jnp.ndarray) -> jnp.ndarray:
+    b = u8.reshape(-1, 8).astype(jnp.uint64)
+    shifts = (jnp.uint64(8) * jnp.arange(8, dtype=jnp.uint64))[None, :]
+    word = jnp.sum(b << shifts, axis=1)
+    return jax.lax.bitcast_convert_type(word, jnp.float64)
+
+
+@jax.jit
+def _assemble_ieee_f32(u8: jnp.ndarray) -> jnp.ndarray:
+    b = u8.reshape(-1, 4).astype(jnp.uint32)
+    shifts = (jnp.uint32(8) * jnp.arange(4, dtype=jnp.uint32))[None, :]
+    word = jnp.sum(b << shifts, axis=1)
+    return jax.lax.bitcast_convert_type(word, jnp.float32)
+
+
+def _assemble_ieee(u8: jnp.ndarray, n_values: int, width: int):
+    if width == 8:
+        return _assemble_ieee_f64(u8[:n_values * 8])
+    return _assemble_ieee_f32(u8[:n_values * 4]).astype(jnp.float64)

@@ -39,15 +39,111 @@ BROADCAST_ROW_LIMIT = 2_000_000
 
 
 def optimize(plan: LogicalPlan, session: Session) -> LogicalPlan:
-    root = _rewrite_joins(plan.root, session)
-    root, _ = _prune(root, list(range(len(root.fields))))
-    root = _implement_joins(root, session)
-    init = [
-        _implement_joins(_prune(_rewrite_joins(p, session),
-                                list(range(len(p.fields))))[0], session)
-        for p in plan.init_plans
-    ]
+    def pipeline(node: PlanNode) -> PlanNode:
+        node = _rewrite_joins(node, session)
+        node, _ = _prune(node, list(range(len(node.fields))))
+        node = _implement_joins(node, session)
+        return _attach_scan_pushdown(node)
+    root = pipeline(plan.root)
+    init = [pipeline(p) for p in plan.init_plans]
     return LogicalPlan(root, init)
+
+
+# ---------------------------------------------------------------------------
+# Scan pushdown: advisory min/max bounds for connector pruning
+# ---------------------------------------------------------------------------
+
+_BOUNDABLE = (T.BigintType, T.IntegerType, T.SmallintType, T.TinyintType,
+              T.DateType)
+
+
+def _attach_scan_pushdown(node: PlanNode) -> PlanNode:
+    """Filter directly over a scan: extract per-column [lo, hi] integer
+    bounds from its conjuncts and attach them to the scan (the
+    TupleDomain-lite handoff of reference
+    sql/planner/iterative/rule/PushPredicateIntoTableScan.java +
+    spi/predicate/TupleDomain.java). The filter stays — the bounds only
+    let connectors prune files/stripes on statistics."""
+    if (isinstance(node, FilterNode)
+            and isinstance(node.child, TableScanNode)):
+        bounds = _extract_bounds(node.predicate, node.child)
+        if bounds:
+            return dataclasses.replace(
+                node, child=dataclasses.replace(node.child,
+                                                pushdown=bounds))
+        return node
+    return node.with_children([_attach_scan_pushdown(c)
+                               for c in node.children])
+
+
+def _extract_bounds(pred: ir.Expr,
+                    scan: TableScanNode
+                    ) -> Tuple[Tuple[str, Optional[int], Optional[int]], ...]:
+    INF = (1 << 62)
+    bounds: Dict[str, List[int]] = {}
+
+    def note(idx: int, lo, hi) -> None:
+        t = scan.fields[idx].type
+        if not isinstance(t, _BOUNDABLE):
+            return
+        name = scan.columns[idx]
+        b = bounds.setdefault(name, [-INF, INF])
+        b[0] = max(b[0], lo if lo is not None else -INF)
+        b[1] = min(b[1], hi if hi is not None else INF)
+
+    def ref_of(e: ir.Expr):
+        if isinstance(e, ir.Cast):
+            e = e.arg
+        return e.index if isinstance(e, ir.InputRef) else None
+
+    def lit_of(e: ir.Expr):
+        if isinstance(e, ir.Cast):
+            e = e.arg
+        # only literals whose own domain is integer-like convert safely:
+        # a decimal/double literal's storage (unscaled / float) is NOT in
+        # the column's integer domain, and a wrong bound silently prunes
+        # live data
+        if (isinstance(e, ir.Literal) and e.value is not None
+                and isinstance(e.type, _BOUNDABLE)):
+            try:
+                return int(e.type.to_storage(e.value))
+            except (TypeError, ValueError):
+                return None
+        return None
+
+    for c in conjuncts(pred):
+        if isinstance(c, ir.SpecialForm) and c.form == ir.Form.BETWEEN:
+            i = ref_of(c.args[0])
+            lo, hi = lit_of(c.args[1]), lit_of(c.args[2])
+            if i is not None and lo is not None and hi is not None:
+                note(i, lo, hi)
+            continue
+        if not isinstance(c, ir.Call) or len(c.args) != 2:
+            continue
+        a, b = c.args
+        ia, ib = ref_of(a), ref_of(b)
+        la, lb = lit_of(a), lit_of(b)
+        op = c.name
+        if ia is not None and lb is not None:
+            idx, v = ia, lb
+        elif ib is not None and la is not None:
+            # flip the comparison: lit OP col == col FLIP(op) lit
+            idx, v = ib, la
+            op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                  "eq": "eq"}.get(op, "")
+        else:
+            continue
+        if op == "eq":
+            note(idx, v, v)
+        elif op in ("lt", "le"):
+            note(idx, None, v)
+        elif op in ("gt", "ge"):
+            note(idx, v, None)
+    # unbounded sides stay None: a finite sentinel would be compared
+    # against real column statistics and could prune live data
+    return tuple((n, lo if lo > -INF else None, hi if hi < INF else None)
+                 for n, (lo, hi) in sorted(bounds.items())
+                 if lo > -INF or hi < INF)
 
 
 # ---------------------------------------------------------------------------

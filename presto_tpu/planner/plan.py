@@ -61,6 +61,9 @@ class TableScanNode(PlanNode):
     table: TableHandle
     columns: Tuple[str, ...]
     fields: Tuple[Field, ...] = ()
+    # advisory per-column [lo, hi] bounds in storage domain for connector
+    # pruning (TupleDomain-lite): ((column_name, lo, hi), ...)
+    pushdown: Tuple[Tuple[str, Optional[int], Optional[int]], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
