@@ -1,0 +1,155 @@
+"""System connector: engine metadata as queryable tables.
+
+The role of the reference's system/information_schema connectors
+(reference presto-main/.../connector/system/ — system.runtime.{nodes,
+queries} tables — and connector/informationschema/
+InformationSchemaMetadata.java): catalogs, tables, columns, the node
+list, and the query log are ordinary tables served from live engine
+state, so observability rides the same SQL surface as data.
+
+Tables (schema "runtime"/"information_schema" flattened into one
+namespace like the rest of the engine's two-level names):
+
+- ``catalogs``  (catalog_name)
+- ``tables``    (table_catalog, table_name)
+- ``columns``   (table_catalog, table_name, column_name, ordinal,
+                 data_type)
+- ``queries``   (query_id, state, query, elapsed_ms) — the runner's log
+- ``nodes``     (node_id, coordinator, state)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from .. import types as T
+from ..batch import Batch, Schema
+from .spi import (
+    Connector, ConnectorMetadata, ConnectorSplitManager, PageSource,
+    Split, TableHandle, TableStats,
+)
+
+V = T.VARCHAR
+
+_SCHEMAS: Dict[str, List] = {
+    "catalogs": [("catalog_name", V)],
+    "tables": [("table_catalog", V), ("table_name", V)],
+    "columns": [("table_catalog", V), ("table_name", V),
+                ("column_name", V), ("ordinal", T.BIGINT),
+                ("data_type", V)],
+    "queries": [("query_id", V), ("state", V), ("query", V),
+                ("elapsed_ms", T.DOUBLE)],
+    "nodes": [("node_id", V), ("coordinator", T.BOOLEAN), ("state", V)],
+}
+
+
+@dataclasses.dataclass
+class QueryLogEntry:
+    query_id: str
+    state: str
+    query: str
+    elapsed_ms: float
+
+
+class _Metadata(ConnectorMetadata):
+    def __init__(self, conn: "SystemConnector"):
+        self.conn = conn
+
+    def list_tables(self, schema: Optional[str] = None) -> List[str]:
+        return list(_SCHEMAS)
+
+    def table_schema(self, table: TableHandle) -> Schema:
+        if table.table not in _SCHEMAS:
+            raise KeyError(f"unknown system table {table.table!r}")
+        return Schema(_SCHEMAS[table.table])
+
+    def table_stats(self, table: TableHandle) -> TableStats:
+        return TableStats(row_count=100.0, columns={}, primary_key=())
+
+
+class _SplitManager(ConnectorSplitManager):
+    def splits(self, table: TableHandle, desired: int = 1) -> List[Split]:
+        return [Split(table, ())]
+
+
+class _RowsPageSource(PageSource):
+    def __init__(self, schema: Schema, columns: Sequence[str],
+                 rows: List[tuple]):
+        self.schema = schema
+        self.columns = list(columns)
+        self.rows = rows
+
+    def batches(self) -> Iterator[Batch]:
+        idx = [self.schema.names.index(c) for c in self.columns]
+        data = {
+            self.schema.names[i]: (self.schema.types[i],
+                                   [r[i] for r in self.rows])
+            for i in idx
+        }
+        yield Batch.from_pydict(data)
+
+
+class SystemConnector(Connector):
+    name = "system"
+
+    def __init__(self, catalogs, query_log: Optional[List] = None):
+        self.catalogs = catalogs        # CatalogManager (live reference)
+        self.query_log: List[QueryLogEntry] = (
+            query_log if query_log is not None else [])
+        self._metadata = _Metadata(self)
+        self._splits = _SplitManager()
+
+    @property
+    def metadata(self) -> ConnectorMetadata:
+        return self._metadata
+
+    @property
+    def split_manager(self) -> ConnectorSplitManager:
+        return self._splits
+
+    def _rows(self, table: str) -> List[tuple]:
+        if table == "catalogs":
+            return [(c,) for c in self.catalogs.names()]
+        if table == "tables":
+            out = []
+            for cat in self.catalogs.names():
+                conn = self.catalogs.get(cat)
+                try:
+                    for t in conn.metadata.list_tables():
+                        out.append((cat, t))
+                except Exception:
+                    continue
+            return out
+        if table == "columns":
+            out = []
+            for cat in self.catalogs.names():
+                conn = self.catalogs.get(cat)
+                try:
+                    tables = conn.metadata.list_tables()
+                except Exception:
+                    continue
+                for t in tables:
+                    try:
+                        ts = conn.metadata.table_schema(
+                            TableHandle(cat, "default", t))
+                    except Exception:
+                        continue
+                    for i, f in enumerate(ts.fields):
+                        out.append((cat, t, f.name, i + 1,
+                                    f.type.display()))
+            return out
+        if table == "queries":
+            return [(q.query_id, q.state, q.query, q.elapsed_ms)
+                    for q in self.query_log]
+        if table == "nodes":
+            import jax
+            return [(f"device-{d.id}", d.id == 0, "active")
+                    for d in jax.devices()]
+        raise KeyError(table)
+
+    def page_source(self, split: Split, columns: Sequence[str],
+                    pushdown=None, rows_per_batch: int = 1 << 17
+                    ) -> PageSource:
+        table = split.table.table
+        return _RowsPageSource(Schema(_SCHEMAS[table]), columns,
+                               self._rows(table))

@@ -35,14 +35,36 @@ class LocalRunner:
             catalogs.register("tpch", TpchConnector(sf=tpch_sf))
             catalogs.register("tpcds", TpcdsConnector(sf=tpch_sf))
             catalogs.register("memory", MemoryConnector())
+        if "system" not in catalogs.names():
+            from ..connectors.system import SystemConnector
+            catalogs.register("system", SystemConnector(catalogs))
         self.session = Session(catalogs=catalogs, catalog=catalog,
                                schema=schema)
         self.rows_per_batch = rows_per_batch
+        self.query_log = catalogs.get("system").query_log
+        self._query_seq = 0
 
     # -- public API -----------------------------------------------------------
     def execute(self, sql: str) -> QueryResult:
+        import time as _time
+        from ..connectors.system import QueryLogEntry
         stmt = parse_statement(sql)
-        return self._execute_stmt(stmt)
+        self._query_seq += 1
+        qid = f"q_{self._query_seq:06d}"
+        entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0)
+        self.query_log.append(entry)
+        t0 = _time.perf_counter()
+        try:
+            out = self._execute_stmt(stmt)
+            entry.state = "FINISHED"
+            return out
+        except Exception:
+            entry.state = "FAILED"
+            raise
+        finally:
+            entry.elapsed_ms = (_time.perf_counter() - t0) * 1e3
+            if len(self.query_log) > 1000:
+                del self.query_log[:-500]
 
     def plan(self, sql: str, optimized: bool = True) -> LogicalPlan:
         stmt = parse_statement(sql)
@@ -68,16 +90,12 @@ class LocalRunner:
                 # EXPLAIN ANALYZE: run the query with per-operator stats,
                 # draining batches without materializing client rows
                 # (reference operator/ExplainAnalyzeOperator.java)
-                from .local import _Executor, run_init_plans
                 from .stats import StatsCollector
                 stats = StatsCollector(count_rows=True)
                 stats.planning_s = _time.perf_counter() - t0
                 t1 = _time.perf_counter()
-                ex = _Executor(self.session, self.rows_per_batch,
-                               stats=stats)
-                run_init_plans(ex, plan)
-                for _ in ex.run(plan.root.child):
-                    pass
+                execute_plan(plan, self.session, self.rows_per_batch,
+                             stats=stats, collect_rows=False)
                 stats.total_wall_s = _time.perf_counter() - t1
             text = print_plan(plan, stats)
             return QueryResult(["Query Plan"], [T.VARCHAR],

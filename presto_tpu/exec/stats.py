@@ -28,19 +28,21 @@ class NodeStats:
 
 
 class StatsCollector:
-    """Collects NodeStats keyed by plan-node object identity."""
+    """Collects NodeStats keyed by plan node (structural equality, the
+    same keying as the executor's shared-subplan cache, so a replayed
+    duplicate subtree reports the stats of its one real execution)."""
 
     def __init__(self, count_rows: bool = False):
         self.count_rows = count_rows
-        self.by_node: Dict[int, NodeStats] = {}
+        self.by_node: Dict[object, NodeStats] = {}
         self.total_wall_s: float = 0.0
         self.planning_s: float = 0.0
 
     def stats_for(self, node) -> Optional[NodeStats]:
-        return self.by_node.get(id(node))
+        return self.by_node.get(node)
 
     def wrap(self, node, it: Iterator) -> Iterator:
-        st = self.by_node.setdefault(id(node), NodeStats())
+        st = self.by_node.setdefault(node, NodeStats())
 
         def timed():
             while True:

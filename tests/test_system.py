@@ -1,0 +1,61 @@
+"""System connector: engine metadata via SQL (reference
+presto-main/.../connector/system/ + connector/informationschema/)."""
+import pytest
+
+from presto_tpu.exec.runner import LocalRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalRunner(tpch_sf=0.001)
+
+
+def test_catalogs(runner):
+    res = runner.execute(
+        "select catalog_name from system.default.catalogs "
+        "order by catalog_name")
+    names = [r[0] for r in res.rows]
+    assert {"tpch", "tpcds", "memory", "system"} <= set(names)
+
+
+def test_tables_and_columns(runner):
+    res = runner.execute(
+        "select table_name from system.default.tables "
+        "where table_catalog = 'tpch' order by table_name")
+    assert ("lineitem",) in [tuple(r) for r in res.rows]
+    res = runner.execute(
+        "select column_name, data_type from system.default.columns "
+        "where table_catalog = 'tpch' and table_name = 'nation' "
+        "order by ordinal")
+    assert res.rows[0][0] == "n_nationkey"
+    assert res.rows[0][1] == "bigint"
+
+
+def test_query_log(runner):
+    runner.execute("select 42")
+    res = runner.execute(
+        "select query_id, state, query from system.default.queries")
+    states = {r[2]: r[1] for r in res.rows}
+    assert states.get("select 42") == "FINISHED"
+    # the in-flight query shows as RUNNING
+    assert any(s == "RUNNING" for s in states.values())
+
+
+def test_query_log_failures(runner):
+    with pytest.raises(Exception):
+        runner.execute("select nope from nation")
+    res = runner.execute(
+        "select state from system.default.queries "
+        "where query = 'select nope from nation'")
+    assert res.rows and res.rows[0][0] == "FAILED"
+
+
+def test_joins_against_system(runner):
+    res = runner.execute("""
+        select c.table_name, count(*) n
+        from system.default.columns c
+        where c.table_catalog = 'tpch'
+        group by c.table_name order by c.table_name""")
+    by_table = dict((r[0], r[1]) for r in res.rows)
+    assert by_table["nation"] == 4
+    assert by_table["lineitem"] == 16
