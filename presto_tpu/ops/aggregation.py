@@ -28,7 +28,11 @@ from .. import types as T
 from ..batch import Batch, Column, Schema
 from ..types import Type
 
-_SUPPORTED = ("sum", "count", "count_star", "min", "max", "avg")
+_VARIANCE_FNS = ("var_samp", "var_pop", "stddev_samp",
+                 "stddev_pop")
+_SUPPORTED = ("sum", "count", "count_star", "min", "max", "avg",
+              "var_samp", "var_pop", "stddev_samp", "stddev_pop",
+              "bool_and", "bool_or")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +54,16 @@ class AggSpec:
             return [(f"{base}$cnt", T.BIGINT)]
         if self.fn == "avg":
             return [(f"{base}$sum", self._sum_type()), (f"{base}$cnt", T.BIGINT)]
+        if self.fn in _VARIANCE_FNS:
+            # central moments (mean, m2, count), not sum/sum-of-squares:
+            # sumsq - sum^2/n cancels catastrophically for large-mean
+            # low-variance data (reference
+            # aggregation/state/CentralMomentsState.java stores central
+            # moments for the same reason)
+            return [(f"{base}$mean", T.DOUBLE), (f"{base}$m2", T.DOUBLE),
+                    (f"{base}$cnt", T.BIGINT)]
+        if self.fn in ("bool_and", "bool_or"):
+            return [(f"{base}$val", T.INTEGER), (f"{base}$cnt", T.BIGINT)]
         return [(f"{base}$val", self._sum_type() if self.fn == "sum" else self.output_type),
                 (f"{base}$cnt", T.BIGINT)]
 
@@ -108,6 +122,7 @@ def _segment_aggs(
     group_id: jnp.ndarray,
     cap: int,
     from_states: bool,
+    col_dicts: Optional[Sequence[Optional[Tuple[str, ...]]]] = None,
 ) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
     """Per-aggregate (value_arrays...) segment reductions.
 
@@ -127,19 +142,50 @@ def _segment_aggs(
                 cnt = jax.ops.segment_sum(cnt_in, group_id, num_segments=cap)
                 results.append((cnt,))
                 continue
+            if agg.fn in _VARIANCE_FNS:
+                # merge partial (mean, m2, n) states: Chan's parallel
+                # combination generalized to k partials —
+                # M2 = sum(m2_i + n_i * (mean_i - mean)^2)
+                m_in = col_data[s_cols[0]]
+                m2_in = col_data[s_cols[1]]
+                cnt_raw = col_data[s_cols[2]]
+                live = mask & (cnt_raw > 0)
+                nw = jnp.where(live, cnt_raw, 0)
+                cnt = jax.ops.segment_sum(nw, group_id, num_segments=cap)
+                nf = nw.astype(jnp.float64)
+                n = jnp.maximum(cnt, 1).astype(jnp.float64)
+                wsum = jax.ops.segment_sum(
+                    nf * jnp.where(live, m_in, 0.0), group_id,
+                    num_segments=cap)
+                mean = wsum / n
+                dev = m_in - mean[group_id]
+                # corrected combine: (sum n_i*dev_i)^2/n cancels the
+                # weighted-sum rounding error in the computed mean
+                wdev = jax.ops.segment_sum(
+                    jnp.where(live, nf * dev, 0.0), group_id,
+                    num_segments=cap)
+                m2 = jax.ops.segment_sum(
+                    jnp.where(live, m2_in + nf * dev * dev, 0.0),
+                    group_id, num_segments=cap) - wdev * wdev / n
+                results.append((mean + wdev / n, m2, cnt))
+                continue
             val_in = col_data[s_cols[0]]
             cnt_raw = col_data[s_cols[1]]
             cnt_in = jnp.where(mask, cnt_raw, 0)
             cnt = jax.ops.segment_sum(cnt_in, group_id, num_segments=cap)
             live = mask & (cnt_raw > 0)
-            if agg.fn in ("sum", "avg"):
+            vocab = col_dicts[s_cols[0]] if col_dicts else None
+            if vocab is not None and agg.fn in ("min", "max"):
+                val = _rank_reduce(val_in, live, group_id, cap, vocab,
+                                   agg.fn)
+            elif agg.fn in ("sum", "avg"):
                 contrib = jnp.where(live, val_in, jnp.zeros_like(val_in))
                 val = jax.ops.segment_sum(contrib, group_id, num_segments=cap)
-            elif agg.fn == "min":
+            elif agg.fn in ("bool_and", "min"):
                 sent = _max_sentinel(val_in.dtype)
                 contrib = jnp.where(live, val_in, sent)
                 val = jax.ops.segment_min(contrib, group_id, num_segments=cap)
-            else:  # max
+            else:  # max / bool_or
                 sent = _min_sentinel(val_in.dtype)
                 contrib = jnp.where(live, val_in, sent)
                 val = jax.ops.segment_max(contrib, group_id, num_segments=cap)
@@ -156,6 +202,39 @@ def _segment_aggs(
         cnt = jax.ops.segment_sum(valid.astype(jnp.int64), group_id, num_segments=cap)
         if agg.fn == "count":
             results.append((cnt,))
+            continue
+        if agg.fn in _VARIANCE_FNS:
+            # corrected two-pass central moments: mean first, then squared
+            # deviations with the (sum dev)^2/n correction term that
+            # cancels the first-pass sum's rounding error — stable for
+            # any magnitude
+            x = data.astype(jnp.float64)
+            n = jnp.maximum(cnt, 1).astype(jnp.float64)
+            s = jax.ops.segment_sum(jnp.where(valid, x, 0.0), group_id,
+                                    num_segments=cap)
+            mean = s / n
+            dev = jnp.where(valid, x - mean[group_id], 0.0)
+            s1 = jax.ops.segment_sum(dev, group_id, num_segments=cap)
+            m2 = jax.ops.segment_sum(dev * dev, group_id,
+                                     num_segments=cap) - s1 * s1 / n
+            results.append((mean + s1 / n, m2, cnt))
+            continue
+        if agg.fn in ("bool_and", "bool_or"):
+            x = data.astype(jnp.int32)
+            if agg.fn == "bool_and":
+                contrib = jnp.where(valid, x, jnp.int32(1))
+                val = jax.ops.segment_min(contrib, group_id,
+                                          num_segments=cap)
+            else:
+                contrib = jnp.where(valid, x, jnp.int32(0))
+                val = jax.ops.segment_max(contrib, group_id,
+                                          num_segments=cap)
+            results.append((val, cnt))
+            continue
+        vocab = col_dicts[agg.input] if col_dicts else None
+        if vocab is not None and agg.fn in ("min", "max"):
+            val = _rank_reduce(data, valid, group_id, cap, vocab, agg.fn)
+            results.append((val, cnt))
             continue
         acc_t = agg.state_types()[0][1]
         acc_dtype = acc_t.storage_dtype
@@ -175,6 +254,40 @@ def _segment_aggs(
     return results
 
 
+def _rank_reduce(codes: jnp.ndarray, live: jnp.ndarray,
+                 group_id: jnp.ndarray, cap: int,
+                 vocab: Tuple[str, ...], fn: str) -> jnp.ndarray:
+    """min/max over dictionary codes in LEXICOGRAPHIC order: map codes to
+    ranks, segment-reduce, map the winning rank back to a code (reference
+    MinMaxHelpers over VARCHAR; codes are appearance-ordered, not
+    sorted)."""
+    from .sort import rank_codes, unrank_table
+    ranks = rank_codes(codes, vocab).astype(jnp.int64)
+    if fn == "min":
+        r = jax.ops.segment_min(
+            jnp.where(live, ranks, jnp.iinfo(jnp.int64).max), group_id,
+            num_segments=cap)
+    else:
+        r = jax.ops.segment_max(jnp.where(live, ranks, -1), group_id,
+                                num_segments=cap)
+    table = unrank_table(vocab)
+    safe = jnp.clip(r, 0, table.shape[0] - 1)
+    return jnp.take(table, safe, axis=0)
+
+
+def _rank_reduce_scalar(codes: jnp.ndarray, live: jnp.ndarray,
+                        vocab: Tuple[str, ...], fn: str) -> jnp.ndarray:
+    """Global (single-group) variant of _rank_reduce."""
+    from .sort import rank_codes, unrank_table
+    ranks = rank_codes(codes, vocab).astype(jnp.int64)
+    if fn == "min":
+        r = jnp.min(jnp.where(live, ranks, jnp.iinfo(jnp.int64).max))
+    else:
+        r = jnp.max(jnp.where(live, ranks, -1))
+    table = unrank_table(vocab)
+    return jnp.take(table, jnp.clip(r, 0, table.shape[0] - 1))
+
+
 def _max_sentinel(dtype):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.asarray(jnp.inf, dtype=dtype)
@@ -187,12 +300,28 @@ def _min_sentinel(dtype):
     return jnp.asarray(jnp.iinfo(dtype).min, dtype=dtype)
 
 
+def _variance_out(agg, mean, m2, cnt):
+    """(mean, m2, count) central-moment state -> variance/stddev."""
+    del mean
+    n = jnp.maximum(cnt, 1).astype(jnp.float64)
+    pop = agg.fn in ("var_pop", "stddev_pop")
+    den = n if pop else jnp.maximum(n - 1.0, 1.0)
+    var = jnp.maximum(m2, 0.0) / den
+    out = jnp.sqrt(var) if agg.fn.startswith("stddev") else var
+    valid = (cnt > 0) if pop else (cnt > 1)
+    return out, valid
+
+
 def _finalize(agg: AggSpec, parts: Tuple[jnp.ndarray, ...]) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """state -> (output data, output validity)."""
     if agg.fn in ("count", "count_star"):
         return parts[0], jnp.ones_like(parts[0], dtype=bool)
+    if agg.fn in _VARIANCE_FNS:
+        return _variance_out(agg, *parts)
     val, cnt = parts
     valid = cnt > 0
+    if agg.fn in ("bool_and", "bool_or"):
+        return val > 0, valid
     if agg.fn == "avg":
         if isinstance(agg.output_type, T.DecimalType):
             den = jnp.maximum(cnt, 1)
@@ -242,11 +371,27 @@ def grouped_aggregate(
     if from_states:
         n_keys = len(group_indices)
         state_data = s_data[n_keys:]
+        state_dicts = [c.dictionary for c in batch.columns[n_keys:]]
         seg = _segment_aggs(aggs, state_data, s_valid[n_keys:], s_mask,
-                            group_id, cap, from_states=True)
+                            group_id, cap, from_states=True,
+                            col_dicts=state_dicts)
     else:
         seg = _segment_aggs(aggs, s_data, s_valid, s_mask, group_id, cap,
-                            from_states=False)
+                            from_states=False,
+                            col_dicts=[c.dictionary for c in batch.columns])
+
+    def value_dict(agg: AggSpec):
+        """Dictionary for a string-valued min/max output/state column."""
+        if agg.fn not in ("min", "max") or agg.input is None:
+            return None
+        if from_states:
+            cursor = 0
+            for a in aggs:
+                if a is agg:
+                    break
+                cursor += len(a.state_types())
+            return batch.columns[len(group_indices) + cursor].dictionary
+        return batch.columns[agg.input].dictionary
 
     out_cols: List[Column] = list(key_cols)
     out_fields: List[Tuple[str, Type]] = [
@@ -254,10 +399,12 @@ def grouped_aggregate(
     ]
     if mode in ("partial", "merge"):
         for agg, parts in zip(aggs, seg):
+            vd = value_dict(agg)
             for (fname, ftype), arr in zip(agg.state_types(), parts):
                 out_fields.append((fname, ftype))
                 out_cols.append(Column(
-                    ftype, arr.astype(ftype.storage_dtype), out_mask, None))
+                    ftype, arr.astype(ftype.storage_dtype), out_mask,
+                    vd if ftype.is_string else None))
     else:
         for agg, parts in zip(aggs, seg):
             data, valid = _finalize(agg, parts)
@@ -265,7 +412,8 @@ def grouped_aggregate(
             out_fields.append((name, agg.output_type))
             out_cols.append(Column(
                 agg.output_type, data.astype(agg.output_type.storage_dtype),
-                valid & out_mask, None))
+                valid & out_mask,
+                value_dict(agg) if agg.output_type.is_string else None))
     return Batch(Schema(out_fields), out_cols, out_mask)
 
 
@@ -294,13 +442,40 @@ def global_aggregate(
             if agg.fn in ("count", "count_star"):
                 cnt = jnp.sum(jnp.where(mask, cols[0].data, 0))
                 parts: Tuple[jnp.ndarray, ...] = (cnt,)
+            elif agg.fn in _VARIANCE_FNS:
+                # corrected merge of (mean, m2, n) partials — see
+                # _segment_aggs
+                cnt_raw = cols[2].data
+                live = mask & (cnt_raw > 0)
+                nf = jnp.where(live, cnt_raw, 0).astype(jnp.float64)
+                cnt = jnp.sum(jnp.where(mask, cnt_raw, 0))
+                n = jnp.maximum(cnt, 1).astype(jnp.float64)
+                mean = jnp.sum(nf * jnp.where(live, cols[0].data, 0.0)) / n
+                dev = cols[0].data - mean
+                wdev = jnp.sum(jnp.where(live, nf * dev, 0.0))
+                m2 = jnp.sum(jnp.where(
+                    live, cols[1].data + nf * dev * dev,
+                    0.0)) - wdev * wdev / n
+                parts = (mean + wdev / n, m2, cnt)
             else:
                 cnt_raw = cols[1].data
                 live = mask & (cnt_raw > 0)
                 cnt = jnp.sum(jnp.where(mask, cnt_raw, 0))
                 v = cols[0].data
-                if agg.fn in ("sum", "avg"):
-                    val = jnp.sum(jnp.where(live, v, jnp.zeros_like(v)))
+                if (agg.fn in ("min", "max")
+                        and cols[0].dictionary is not None):
+                    val = _rank_reduce_scalar(v, live, cols[0].dictionary,
+                                              agg.fn)
+                elif agg.fn in ("sum", "avg", "bool_and", "bool_or"):
+                    if agg.fn == "bool_and":
+                        val = jnp.min(jnp.where(live, v,
+                                                _max_sentinel(v.dtype)))
+                    elif agg.fn == "bool_or":
+                        val = jnp.max(jnp.where(live, v,
+                                                _min_sentinel(v.dtype)))
+                    else:
+                        val = jnp.sum(jnp.where(live, v,
+                                                jnp.zeros_like(v)))
                 elif agg.fn == "min":
                     val = jnp.min(jnp.where(live, v, _max_sentinel(v.dtype)))
                 else:
@@ -315,6 +490,28 @@ def global_aggregate(
                 cnt = jnp.sum(valid.astype(jnp.int64))
                 if agg.fn == "count":
                     parts = (cnt,)
+                elif agg.fn in _VARIANCE_FNS:
+                    # corrected two-pass central moments (see
+                    # _segment_aggs)
+                    x = c.data.astype(jnp.float64)
+                    n = jnp.maximum(cnt, 1).astype(jnp.float64)
+                    mean = jnp.sum(jnp.where(valid, x, 0.0)) / n
+                    dev = jnp.where(valid, x - mean, 0.0)
+                    s1 = jnp.sum(dev)
+                    parts = (mean + s1 / n,
+                             jnp.sum(dev * dev) - s1 * s1 / n, cnt)
+                elif agg.fn in ("bool_and", "bool_or"):
+                    x = c.data.astype(jnp.int32)
+                    if agg.fn == "bool_and":
+                        val = jnp.min(jnp.where(valid, x, jnp.int32(1)))
+                    else:
+                        val = jnp.max(jnp.where(valid, x, jnp.int32(0)))
+                    parts = (val, cnt)
+                elif (agg.fn in ("min", "max")
+                      and c.dictionary is not None):
+                    val = _rank_reduce_scalar(c.data, valid, c.dictionary,
+                                              agg.fn)
+                    parts = (val, cnt)
                 else:
                     acc_dtype = agg.state_types()[0][1].storage_dtype
                     x = c.data.astype(acc_dtype)
@@ -325,11 +522,18 @@ def global_aggregate(
                     else:
                         val = jnp.max(jnp.where(valid, x, _min_sentinel(acc_dtype)))
                     parts = (val, cnt)
+        vd = None
+        if agg.fn in ("min", "max") and agg.input is not None:
+            if mode in ("final", "merge"):
+                vd = cols[0].dictionary
+            else:
+                vd = batch.columns[agg.input].dictionary
         if mode in ("partial", "merge"):
             for (fname, ftype), arr in zip(agg.state_types(), parts):
                 out_fields.append((fname, ftype))
-                out_cols.append(Column(ftype, pad(arr, ftype.storage_dtype),
-                                       out_mask, None))
+                out_cols.append(Column(
+                    ftype, pad(arr, ftype.storage_dtype), out_mask,
+                    vd if ftype.is_string else None))
         else:
             if agg.fn in ("count", "count_star"):
                 data, valid = parts[0], jnp.asarray(True)
@@ -340,13 +544,18 @@ def global_aggregate(
             dt = agg.output_type.storage_dtype
             out_cols.append(Column(
                 agg.output_type, pad(data, dt),
-                jnp.zeros(cap, dtype=bool).at[0].set(valid), None))
+                jnp.zeros(cap, dtype=bool).at[0].set(valid),
+                vd if agg.output_type.is_string else None))
     return Batch(Schema(out_fields), out_cols, out_mask)
 
 
 def _finalize_scalar(agg: AggSpec, parts):
+    if agg.fn in _VARIANCE_FNS:
+        return _variance_out(agg, *parts)
     val, cnt = parts
     valid = cnt > 0
+    if agg.fn in ("bool_and", "bool_or"):
+        return val > 0, valid
     if agg.fn == "avg":
         if isinstance(agg.output_type, T.DecimalType):
             den = jnp.maximum(cnt, 1)

@@ -612,7 +612,12 @@ class _Planner:
                 uniq_aggs.append(call)
         for j, call in enumerate(uniq_aggs):
             fn = _FUNCTION_ALIASES.get(call.name, call.name)
-            if fn not in ("count", "sum", "avg", "min", "max"):
+            # ARBITRARY allows any live value; max picks one branch-free
+            if fn in ("any_value", "arbitrary"):
+                fn = "max"
+            if fn not in ("count", "sum", "avg", "min", "max", "var_samp",
+                          "var_pop", "stddev_samp", "stddev_pop",
+                          "bool_and", "bool_or"):
                 raise AnalysisError(f"aggregate {fn}() not supported yet")
             if call.is_star or not call.args:
                 if fn != "count":
@@ -1197,6 +1202,10 @@ def _agg_output_type(fn: str, arg: T.Type) -> T.Type:
         if isinstance(arg, T.DecimalType):
             return arg
         return T.DOUBLE
+    if fn in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+        return T.DOUBLE
+    if fn in ("bool_and", "bool_or"):
+        return T.BOOLEAN
     # min/max
     return arg
 

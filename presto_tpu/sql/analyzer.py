@@ -43,6 +43,8 @@ AGGREGATE_FUNCTIONS = frozenset(
 _FUNCTION_ALIASES = {
     "substring": "substr", "mod": "modulus", "pow": "power",
     "ceiling": "ceil", "char_length": "length",
+    "stddev": "stddev_samp", "variance": "var_samp",
+    "var": "var_samp", "every": "bool_and",
 }
 
 _ARITH_OPS = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",

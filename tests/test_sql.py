@@ -157,3 +157,95 @@ def test_date_semantics(runner, oracle):
         "group by 1 order by 1",
         "select cast(substr(o_orderdate,1,4) as integer) y, count(*) c "
         "from orders group by 1 order by 1")
+
+
+def test_variance_large_mean(runner, oracle):
+    """Central-moment states must not cancel catastrophically: shifting
+    the data by 1e15 must leave stddev (nearly) unchanged.  The naive
+    sum/sum-of-squares state returns ~2x (or 0) here."""
+    res = runner.execute(
+        "select stddev(l_quantity + 1000000000000000.0) a, "
+        "stddev(l_quantity) b from lineitem")
+    a, b = res.rows[0]
+    assert a == pytest.approx(b, rel=1e-3)
+    res = runner.execute(
+        "select l_returnflag, stddev(l_quantity + 1000000000000000.0) a, "
+        "stddev(l_quantity) b from lineitem group by 1 order by 1")
+    for _, a, b in res.rows:
+        assert a == pytest.approx(b, rel=1e-3)
+
+
+def test_variance_family(runner, oracle):
+    """stddev/variance vs numpy (SQLite has no stddev built in)."""
+    import numpy as np
+    res = runner.execute(
+        "select l_returnflag, count(*) n, var_samp(l_quantity) vs, "
+        "var_pop(l_quantity) vp, stddev(l_quantity) ss, "
+        "stddev_pop(l_quantity) sp from lineitem "
+        "group by l_returnflag order by l_returnflag")
+    raw = oracle.execute(
+        "select l_returnflag, l_quantity from lineitem").fetchall()
+    by_flag = {}
+    for f, q in raw:
+        by_flag.setdefault(f, []).append(q)
+    for flag, n, vs, vp, ss, sp in res.rows:
+        a = np.asarray(by_flag[flag], dtype=float)
+        assert n == len(a)
+        assert vs == pytest.approx(a.var(ddof=1), rel=1e-9)
+        assert vp == pytest.approx(a.var(), rel=1e-9)
+        assert ss == pytest.approx(a.std(ddof=1), rel=1e-9)
+        assert sp == pytest.approx(a.std(), rel=1e-9)
+
+
+def test_bool_and_or(runner, oracle):
+    compare(runner, oracle, """
+        select o_orderstatus, count(*) from orders
+        group by o_orderstatus order by o_orderstatus""")
+    res = runner.execute("""
+        select o_orderpriority,
+               bool_and(o_totalprice > 1000) ba,
+               bool_or(o_totalprice > 400000) bo
+        from orders group by o_orderpriority order by o_orderpriority""")
+    want = {}
+    for pri, price in oracle.execute(
+            "select o_orderpriority, o_totalprice from orders"):
+        a, o = want.setdefault(pri, [True, False])
+        want[pri] = [a and price > 1000, o or price > 400000]
+    for pri, ba, bo in res.rows:
+        assert [bool(ba), bool(bo)] == want[pri]
+
+
+def test_global_variance(runner, oracle):
+    import numpy as np
+    res = runner.execute(
+        "select stddev(l_extendedprice), var_pop(l_discount) "
+        "from lineitem")
+    vals = oracle.execute(
+        "select l_extendedprice, l_discount from lineitem").fetchall()
+    p = np.asarray([v[0] for v in vals])
+    d = np.asarray([v[1] for v in vals])
+    assert res.rows[0][0] == pytest.approx(p.std(ddof=1), rel=1e-9)
+    assert res.rows[0][1] == pytest.approx(d.var(), rel=1e-9)
+
+
+def test_arbitrary(runner, oracle):
+    res = runner.execute(
+        "select n_regionkey, arbitrary(n_name) a, any_value(n_name) v "
+        "from nation group by n_regionkey order by n_regionkey")
+    names = {}
+    for rk, nm in oracle.execute(
+            "select n_regionkey, n_name from nation"):
+        names.setdefault(rk, set()).add(nm)
+    for rk, a, v in res.rows:
+        assert a in names[rk] and v in names[rk]
+
+
+def test_min_max_varchar(runner, oracle):
+    """Lexicographic min/max over dictionary columns, grouped + global
+    (codes are appearance-ordered, so raw-code reduction would be
+    wrong)."""
+    compare(runner, oracle, """
+        select n_regionkey, min(n_name) mn, max(n_name) mx
+        from nation group by n_regionkey order by n_regionkey""")
+    compare(runner, oracle,
+            "select min(p_type), max(p_container) from part")
