@@ -38,13 +38,19 @@ import time
 
 import numpy as np
 
-# Persistent XLA compilation cache: the tunneled-TPU compile RTT dominates
-# cold runs (a cold TPC-DS pipeline compiles for minutes); the cache makes
-# driver re-runs warm. Must be set before jax initializes.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: the tunneled-TPU compile RTT
+    dominates cold runs (a cold TPC-DS pipeline compiles for minutes);
+    the cache makes driver re-runs warm. The env-var form is ignored by
+    this backend, so set it through the config API (works any time
+    before the first compilation)."""
+    import jax
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 def _epoch_day(y, m, d) -> int:
@@ -56,23 +62,41 @@ D_Q3 = _epoch_day(1995, 3, 15)
 
 
 def _stage(conn, table, cols, rows_per_batch, device: bool):
-    """Generate a table's chunks once; host copies always, device copies
-    optionally (np.array copies: a zero-copy view of a CPU-backend jax
-    buffer could be invalidated once the device pipeline reuses it)."""
+    """Generate a table's chunks once. Host copies keep the chunked shape
+    (one chunk = one Presto page for the NumPy baseline); the device copy
+    is ONE concatenated batch per table — a single large transfer per
+    column instead of hundreds of small ones (the tunnel's per-transfer
+    latency would otherwise dominate staging), and one big kernel launch
+    instead of many (larger batches use the device better anyway)."""
+    from presto_tpu.batch import Batch
     from presto_tpu.connectors.spi import TableHandle
 
     th = TableHandle("tpch", "t", table)
     split = conn.split_manager.splits(th, 1)[0]
-    dev, host, n = [], [], 0
+    host, n = [], 0
     schema = None
-    for b in conn.page_source(split, cols, rows_per_batch=rows_per_batch
-                              ).batches():
-        schema = b.schema
-        if device:
-            dev.append(b)
-        host.append(tuple(np.array(c.data) for c in b.columns)
-                    + (np.array(b.row_mask),))
-        n += int(np.sum(host[-1][-1]))
+    dicts = None
+    # generate host-side (host_chunks): staging must not round-trip the
+    # tunnel per chunk; the device copy below is one transfer per column
+    ps = conn.page_source(split, cols, rows_per_batch=rows_per_batch)
+    for chunk_schema, data, cn in ps.host_chunks():
+        schema = chunk_schema.select(list(cols))
+        arrays = []
+        dicts = []
+        for name in cols:
+            arr, vocab = data[name]
+            assert vocab != "text", "free-text columns not staged"
+            arrays.append(np.asarray(arr))
+            dicts.append(tuple(vocab) if vocab is not None else None)
+        mask_np = np.ones(cn, dtype=bool)
+        host.append(tuple(arrays) + (mask_np,))
+        n += cn
+    dev = []
+    if device:
+        arrays = [np.concatenate([h[i] for h in host])
+                  for i in range(len(cols))]
+        dev = [Batch.from_arrays(schema, arrays, dictionaries=dicts,
+                                 num_rows=n)]
     return dev, host, n, schema
 
 
@@ -443,23 +467,22 @@ class _CachingConnector:
 
 def _np_cols(conn, table, cols, decode=()):
     """One table's columns as host numpy arrays (dict columns decoded to
-    object arrays when listed in ``decode``)."""
+    object arrays when listed in ``decode``), generated host-side."""
     from presto_tpu.connectors.spi import TableHandle
 
     th = TableHandle("tpcds", "default", table)
     parts = {c: [] for c in cols}
     n = 0
     for split in conn.split_manager.splits(th, 1):
-        for b in conn.page_source(split, cols,
-                                  rows_per_batch=1 << 20).batches():
-            live = np.asarray(b.row_mask)
-            for c, col in zip(cols, b.columns):
-                data = np.asarray(col.data)[live]
-                if c in decode and col.dictionary is not None:
-                    vocab = np.asarray(col.dictionary, dtype=object)
-                    data = vocab[data]
-                parts[c].append(data)
-            n += int(live.sum())
+        ps = conn.page_source(split, cols, rows_per_batch=1 << 20)
+        for _, data, cn in ps.host_chunks():
+            for c in cols:
+                arr, vocab = data[c]
+                arr = np.asarray(arr)
+                if c in decode and vocab is not None and vocab != "text":
+                    arr = np.asarray(tuple(vocab), dtype=object)[arr]
+                parts[c].append(arr)
+            n += cn
     return {c: np.concatenate(v) for c, v in parts.items()}, n
 
 
@@ -622,11 +645,18 @@ def bench_q27(sf: float):
 
 
 def main() -> None:
+    import sys
+
+    _enable_compile_cache()
     sf_q6 = float(os.environ.get("BENCH_SF_Q6",
                                  os.environ.get("BENCH_SF", "1")))
     sf_q1 = float(os.environ.get("BENCH_SF_Q1", "10"))
     sf_q3 = float(os.environ.get("BENCH_SF_Q3", "10"))
     sf_ds = float(os.environ.get("BENCH_SF_DS", "1"))
+    # hard wall-clock budget: skip remaining configs rather than risk the
+    # whole run (and every completed number) being killed by a timeout
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "3000"))
+    t_start = time.perf_counter()
 
     results = []
     for name, sf, fn, prefix in (
@@ -635,7 +665,16 @@ def main() -> None:
             ("q3", sf_q3, bench_q3, "tpch"),
             ("q55", sf_ds, bench_q55, "tpcds"),
             ("q27", sf_ds, bench_q27, "tpcds")):
+        elapsed = time.perf_counter() - t_start
+        if results and elapsed > budget_s:
+            print(f"[bench] budget exhausted ({elapsed:.0f}s); "
+                  f"skipping {name}", file=sys.stderr)
+            continue
+        print(f"[bench] {name} sf={sf:g} starting at {elapsed:.0f}s",
+              file=sys.stderr, flush=True)
         total, dev_s, np_s = fn(sf)
+        print(f"[bench] {name} done: {round(total / dev_s):,} rows/s "
+              f"(vs {np_s / dev_s:.2f})", file=sys.stderr, flush=True)
         results.append({
             "metric": f"{prefix}_sf{sf:g}_{name}_rows_per_sec",
             "value": round(total / dev_s),
@@ -643,7 +682,8 @@ def main() -> None:
             "vs_baseline": round(np_s / dev_s, 3),
         })
 
-    headline = dict(next(r for r in results if "_q1_" in r["metric"]))
+    headline = dict(next((r for r in results if "_q1_" in r["metric"]),
+                         results[0]))
     headline["sub_metrics"] = [r for r in results
                                if r["metric"] != headline["metric"]]
     print(json.dumps(headline))
