@@ -16,7 +16,7 @@ ExchangeClient-fed init semantics without a network hop.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -37,6 +37,15 @@ from ..planner.plan import (
     TableScanNode, TopNNode, UnionNode, ValuesNode,
 )
 from ..planner.planner import InitPlanRef, LogicalPlan, Session
+
+
+def bool_property(session: Session, name: str, default: bool) -> bool:
+    """Session properties arrive as strings from SET SESSION / HTTP
+    headers; parse the usual spellings instead of trusting truthiness."""
+    v = session.properties.get(name, default)
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "off", "no", "")
+    return bool(v)
 
 
 @dataclasses.dataclass
@@ -83,6 +92,43 @@ def _plan_schema(node: PlanNode) -> Schema:
     return Schema([(f.name, f.type) for f in node.fields])
 
 
+_DYN_TYPES = (T.BigintType, T.IntegerType, T.SmallintType, T.TinyintType,
+              T.DateType)
+
+
+def _dynamic_bounds(build: Batch, build_keys: Sequence[int],
+                    probe_keys: Sequence[int]
+                    ) -> List[Tuple[int, int, int]]:
+    """Build-side [min, max] per integer-like join key (one host sync;
+    the build side is already fully drained when this runs). Returns
+    [(probe_key_index, lo, hi), ...]."""
+    import numpy as np
+    out: List[Tuple[int, int, int]] = []
+    mask = np.asarray(build.row_mask)
+    for bk, pk in zip(build_keys, probe_keys):
+        col = build.columns[bk]
+        if not isinstance(col.type, _DYN_TYPES):
+            continue
+        live = mask & np.asarray(col.validity)
+        if not live.any():
+            continue
+        data = np.asarray(col.data)[live]
+        out.append((pk, int(data.min()), int(data.max())))
+    return out
+
+
+def _apply_dynamic_bounds(probe: Batch,
+                          dyn: List[Tuple[int, int, int]]) -> Batch:
+    """Device-side probe prefilter: drop rows whose key cannot match any
+    build row (outside [lo, hi] or NULL — inner-join semantics). Shrinks
+    the join kernel's input; the scan-level pushdown handles IO."""
+    keep = probe.row_mask
+    for pk, lo, hi in dyn:
+        c = probe.columns[pk]
+        keep = keep & c.validity & (c.data >= lo) & (c.data <= hi)
+    return Batch(probe.schema, probe.columns, keep)
+
+
 def mark_exists_mask(probe: Batch, build: Batch, probe_keys, build_keys,
                      residual, negated: bool, max_matches: int):
     """Correlated-EXISTS mark: probe row passes iff ANY build row with
@@ -120,7 +166,10 @@ class _Executor:
         self.init_values: List[object] = []
         self.stats = stats
         self._shared: set = set()
+        self._ever_shared: set = set()
         self._materialized: Dict[PlanNode, List[Batch]] = {}
+        # runtime (dynamic-filter) scan bounds: scan node -> [(col, lo, hi)]
+        self.dynamic_pushdown: Dict[PlanNode, List[Tuple]] = {}
         from ..memory import QueryMemoryPool
         self.pool = QueryMemoryPool(
             session.properties.get("query_max_memory"))
@@ -146,6 +195,10 @@ class _Executor:
         for r in roots:
             walk(r)
         self._shared = {n for n, c in counts.items() if c > 1}
+        # never-discarded copy: dynamic-filter pushdown must see a
+        # subtree as multi-consumer even after its memo was abandoned
+        # under memory pressure (run() discards from _shared then)
+        self._ever_shared = set(self._shared)
 
     # -- expression preparation ---------------------------------------------
     def _resolve(self, e: ir.Expr) -> ir.Expr:
@@ -215,6 +268,20 @@ class _Executor:
 
         conn = self.session.catalogs.get(node.catalog)
         pushdown = node.pushdown or None
+        dyn = self.dynamic_pushdown.get(node)
+        if dyn:
+            # intersect per column: connectors keep one bound per name,
+            # so appending would let a wider dynamic bound shadow a
+            # tighter WHERE-derived one
+            merged: Dict[str, List] = {}
+            for name, lo, hi in list(pushdown or ()) + dyn:
+                b = merged.setdefault(name, [lo, hi])
+                if lo is not None:
+                    b[0] = lo if b[0] is None else max(b[0], lo)
+                if hi is not None:
+                    b[1] = hi if b[1] is None else min(b[1], hi)
+            pushdown = tuple((n, lo, hi)
+                             for n, (lo, hi) in merged.items())
         n_threads = int(self.session.properties.get("scan_threads", 2))
         splits = conn.split_manager.splits(
             node.table, max(n_threads, 1))
@@ -434,20 +501,35 @@ class _Executor:
                 raise NotImplementedError(
                     "DISTINCT aggregates are not supported yet")
         group = list(node.group_indices)
+        # fragment steps (reference plan/AggregationNode.Step): SINGLE
+        # raw->rows; PARTIAL raw->states (shipped to an exchange); FINAL
+        # states->rows.  step never changes the kernels, only which side
+        # of the state boundary this node covers.
+        step = node.step
         if not group:
             parts: List[Batch] = []
             for b in self.run(node.child):
-                parts.append(global_aggregate(b, aggs, mode="partial"))
+                parts.append(global_aggregate(b, aggs, mode="partial")
+                             if step != "final" else b)
                 if len(parts) >= 64:
                     parts = [global_aggregate(concat_batches(parts), aggs,
                                               mode="merge")]
             if not parts:
+                # no input still finalizes to one row (count=0): final
+                # mode reduces a 0-row state batch; other steps reduce a
+                # 0-row raw batch into an explicit empty partial
                 empty = Batch.from_arrays(
                     _plan_schema(node.child),
                     [[] for _ in node.child.fields], num_rows=0)
-                parts = [global_aggregate(empty, aggs, mode="partial")]
+                parts = [empty if step == "final"
+                         else global_aggregate(empty, aggs,
+                                               mode="partial")]
             states = (concat_batches(parts) if len(parts) > 1 else parts[0])
-            yield global_aggregate(states, aggs, mode="final")
+            if step == "partial":
+                yield global_aggregate(states, aggs, mode="merge") \
+                    if len(parts) > 1 else states
+            else:
+                yield global_aggregate(states, aggs, mode="final")
             return
         # grouped: partial per input batch, hierarchical merge (spillable
         # state, hash-partitioned by group keys under memory pressure),
@@ -459,8 +541,9 @@ class _Executor:
         try:
             for b in self.run(node.child):
                 buf.add_partial(
-                    grouped_aggregate(b, group, aggs, mode="partial"))
-            yield from buf.results()
+                    b if step == "final"
+                    else grouped_aggregate(b, group, aggs, mode="partial"))
+            yield from buf.results(final=step != "partial")
         finally:
             buf.close()
 
@@ -494,6 +577,14 @@ class _Executor:
                 yield from self._partitioned_join(
                     node, build, payload, payload_names, residual_fn)
                 return
+            dyn = None
+            if (node.join_type == "inner" and build is not None
+                    and bool_property(self.session,
+                                      "enable_dynamic_filtering", True)):
+                dyn = _dynamic_bounds(build, node.right_keys,
+                                      node.left_keys)
+                if dyn:
+                    self._push_dynamic_bounds(node.left, dyn)
             compact = self._compactor()
             for probe in self.run(node.left):
                 if build is None:
@@ -501,6 +592,8 @@ class _Executor:
                         continue
                     out = self._null_extend(probe, node)
                 else:
+                    if dyn:
+                        probe = _apply_dynamic_bounds(probe, dyn)
                     out = self._probe(node, probe, build, payload,
                                       payload_names)
                 if residual_fn is not None:
@@ -508,6 +601,43 @@ class _Executor:
                 yield compact(out)
         finally:
             buf.close()
+
+    def _push_dynamic_bounds(self, probe: PlanNode,
+                             dyn: List[Tuple[int, int, int]]) -> None:
+        """Runtime scan pushdown: if the probe chain maps the join keys
+        straight to scan columns (identity projections only), hand the
+        build side's [lo, hi] to the scan so connectors prune on stats
+        (reference sql/DynamicFilters.java:43 + the probe-side filter of
+        LocalDynamicFiltersCollector; v319 collects build-side values and
+        filters the probe scan the same way)."""
+        mapping = {i: i for i in range(len(probe.fields))}
+        node = probe
+        while True:
+            if node in self._ever_shared:
+                return      # replayed subtree feeds other consumers too
+            if isinstance(node, FilterNode):
+                node = node.child
+                continue
+            if isinstance(node, ProjectNode):
+                new_map = {}
+                for out_i, in_i in mapping.items():
+                    e = node.exprs[in_i]
+                    if isinstance(e, ir.InputRef):
+                        new_map[out_i] = e.index
+                mapping = new_map
+                node = node.child
+                continue
+            break
+        if not isinstance(node, TableScanNode) or not mapping:
+            return
+        extra = []
+        for key_idx, lo, hi in dyn:
+            scan_i = mapping.get(key_idx)
+            if scan_i is None:
+                continue
+            extra.append((node.columns[scan_i], lo, hi))
+        if extra:
+            self.dynamic_pushdown.setdefault(node, []).extend(extra)
 
     def _partitioned_join(self, node: JoinNode, store, payload,
                           payload_names, residual_fn) -> Iterator[Batch]:

@@ -265,7 +265,10 @@ class AggSpillBuffer:
         self.spilled = True
         return freed
 
-    def results(self) -> Iterator[Batch]:
+    def results(self, final: bool = True) -> Iterator[Batch]:
+        """Final rows (default) or merged partial states (``final=False``,
+        the PARTIAL-step output shipped to a downstream exchange)."""
+        mode = "final" if final else "merge"
         self.ctx.pin()   # consumers hold the yielded state from here on
         if not self.spilled:
             if not self.device:
@@ -273,7 +276,7 @@ class AggSpillBuffer:
             states = (self.device[0] if len(self.device) == 1
                       else concat_batches(self.device))
             yield grouped_aggregate(states, self.key_idx, self.aggs,
-                                    mode="final")
+                                    mode=mode)
             return
         for p in range(self.n_partitions):
             part = None if self.store is None else \
@@ -281,7 +284,7 @@ class AggSpillBuffer:
             if part is None:
                 continue
             yield grouped_aggregate(part, self.key_idx, self.aggs,
-                                    mode="final")
+                                    mode=mode)
 
     def close(self) -> None:
         self.ctx.close()

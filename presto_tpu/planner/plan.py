@@ -72,6 +72,17 @@ class ValuesNode(PlanNode):
     rows: Tuple[Tuple[object, ...], ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class RemoteSourceNode(PlanNode):
+    """Leaf of a plan fragment: pages pulled from every task of an
+    upstream fragment (reference plan/RemoteSourceNode.java +
+    operator/ExchangeOperator.java). ``fragment_ids`` lists the upstream
+    fragments feeding this exchange (several for UNION)."""
+
+    fragment_ids: Tuple[int, ...]
+    fields: Tuple[Field, ...]
+
+
 @_one_child
 @dataclasses.dataclass(frozen=True)
 class FilterNode(PlanNode):

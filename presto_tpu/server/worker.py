@@ -1,0 +1,462 @@
+"""Worker node: executes plan fragments shipped by a coordinator.
+
+The role of the reference's worker half (reference
+presto-main/.../execution/SqlTaskManager.java:85,356 task CRUD keyed by
+TaskId; server/TaskResource.java:83,124,240,299,311 REST surface;
+execution/buffer/ output buffers with token/ack semantics;
+operator/ExchangeClient.java pull exchange). TPU-native split: each task
+runs a fragment on the local device engine (exec/local._Executor) over
+its assigned splits; exchange pages travel as the binary page wire
+format (exec/pages) over HTTP — the DCN data plane — while all
+device-side compute inside a task stays XLA.
+
+REST surface (mirrors reference TaskResource):
+
+- ``PUT    /v1/task/{id}``                     create + start a task
+- ``GET    /v1/task/{id}``                     status JSON
+- ``GET    /v1/task/{id}/results/{buf}/{tok}`` long-poll pages; the
+  token acknowledges everything below it (reread-on-retry semantics,
+  reference execution/buffer/ClientBuffer token protocol)
+- ``DELETE /v1/task/{id}``                     abort
+- ``GET    /v1/info``                          node state + heartbeat
+- ``PUT    /v1/info/state``                    "SHUTTING_DOWN" drains
+  active tasks, then refuses new ones (reference
+  server/GracefulShutdownHandler.java:43,73)
+"""
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..batch import Batch
+from ..connectors.spi import CatalogManager, Split
+from ..exec import local as local_exec
+from ..exec.pages import deserialize_page, serialize_page, \
+    serialize_partitioned
+from ..planner import codec
+from ..planner.planner import Session
+from ..sql.analyzer import AnalysisError
+
+PAGES_CONTENT_TYPE = "application/x-presto-tpu-pages"
+
+
+def frame_pages(pages: List[bytes]) -> bytes:
+    """Length-prefix each page into one body."""
+    return b"".join(struct.pack("<I", len(p)) + p for p in pages)
+
+
+def unframe_pages(body: bytes) -> List[bytes]:
+    pages, off = [], 0
+    while off < len(body):
+        (n,) = struct.unpack_from("<I", body, off)
+        pages.append(body[off + 4:off + 4 + n])
+        off += 4 + n
+    return pages
+
+
+class OutputBuffer:
+    """Per-task partitioned output with token/ack reread semantics."""
+
+    def __init__(self, n_buffers: int):
+        self.n = n_buffers
+        self.pages: List[List[Tuple[int, bytes]]] = \
+            [[] for _ in range(n_buffers)]
+        self.next_token = [0] * n_buffers
+        self.finished = False
+        self.failed: Optional[str] = None
+        self.cond = threading.Condition()
+
+    def add(self, buffer_id: int, page: bytes) -> None:
+        with self.cond:
+            self.pages[buffer_id].append(
+                (self.next_token[buffer_id], page))
+            self.next_token[buffer_id] += 1
+            self.cond.notify_all()
+
+    def add_broadcast(self, page: bytes) -> None:
+        with self.cond:
+            for b in range(self.n):
+                self.pages[b].append((self.next_token[b], page))
+                self.next_token[b] += 1
+            self.cond.notify_all()
+
+    def finish(self) -> None:
+        with self.cond:
+            self.finished = True
+            self.cond.notify_all()
+
+    def fail(self, message: str) -> None:
+        with self.cond:
+            self.failed = message
+            self.cond.notify_all()
+
+    def get(self, buffer_id: int, token: int, max_wait_s: float,
+            max_bytes: int = 8 << 20):
+        """Ack pages below ``token``, long-poll for pages at/after it.
+        Returns (pages, next_token, complete)."""
+        deadline = time.monotonic() + max_wait_s
+        with self.cond:
+            # ack: drop everything the client has by token
+            q = self.pages[buffer_id]
+            self.pages[buffer_id] = [e for e in q if e[0] >= token]
+            while True:
+                if self.failed is not None:
+                    raise RuntimeError(self.failed)
+                avail = [e for e in self.pages[buffer_id]
+                         if e[0] >= token]
+                if avail:
+                    out, size = [], 0
+                    for t, p in avail:
+                        out.append(p)
+                        size += len(p)
+                        if size >= max_bytes:
+                            break
+                    nxt = token + len(out)
+                    return out, nxt, False
+                if self.finished:
+                    return [], token, True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], token, False
+                self.cond.wait(remaining)
+
+
+class ExchangeClient:
+    """Pulls pages from every task of an upstream fragment (reference
+    operator/ExchangeClient.java:55 + HttpPageBufferClient.java:88):
+    one prefetch thread per upstream location, merged into one queue."""
+
+    def __init__(self, locations: List[str], buffer_id: int,
+                 timeout_s: float = 300.0):
+        import queue as _q
+        self.locations = locations
+        self.buffer_id = buffer_id
+        self.timeout_s = timeout_s
+        self.queue: "_q.Queue" = _q.Queue(maxsize=64)
+        self.stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._pull, args=(u,), daemon=True)
+            for u in locations
+        ]
+
+    def _pull(self, url: str) -> None:
+        token = 0
+        deadline = time.monotonic() + self.timeout_s
+        try:
+            while not self.stop.is_set():
+                req = urllib.request.Request(
+                    f"{url}/results/{self.buffer_id}/{token}?max_wait=2")
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        body = resp.read()
+                        complete = resp.headers.get(
+                            "X-Buffer-Complete") == "true"
+                        token = int(resp.headers.get("X-Next-Token",
+                                                     token))
+                except Exception as e:  # retry until deadline
+                    if time.monotonic() > deadline:
+                        self.queue.put(e)
+                        return
+                    time.sleep(0.2)
+                    continue
+                deadline = time.monotonic() + self.timeout_s
+                for page in unframe_pages(body):
+                    self.queue.put(page)
+                if complete:
+                    break
+        finally:
+            self.queue.put(None)   # this upstream is drained
+
+    def batches(self) -> Iterator[Batch]:
+        for t in self._threads:
+            t.start()
+        done = 0
+        try:
+            while done < len(self._threads):
+                item = self.queue.get()
+                if item is None:
+                    done += 1
+                    continue
+                if isinstance(item, Exception):
+                    raise item
+                yield deserialize_page(item)
+        finally:
+            self.stop.set()
+
+
+class _TaskExecutor(local_exec._Executor):
+    """Local device engine bound to one task: scans read only the task's
+    assigned splits; RemoteSourceNodes pull from upstream tasks."""
+
+    def __init__(self, session: Session, rows_per_batch: int,
+                 splits: List[Split],
+                 sources: Dict[int, List[str]], partition: int):
+        super().__init__(session, rows_per_batch)
+        self.assigned_splits = splits
+        self.sources = sources
+        self.partition = partition
+
+    def _TableScanNode(self, node) -> Iterator[Batch]:
+        conn = self.session.catalogs.get(node.catalog)
+        for split in self.assigned_splits:
+            src = conn.page_source(split, list(node.columns),
+                                   pushdown=node.pushdown or None,
+                                   rows_per_batch=self.rows_per_batch)
+            yield from src.batches()
+
+    def _RemoteSourceNode(self, node) -> Iterator[Batch]:
+        locations: List[str] = []
+        for fid in node.fragment_ids:
+            locations.extend(self.sources.get(fid, ()))
+        client = ExchangeClient(locations, self.partition)
+        schema = local_exec._plan_schema(node)
+        for b in client.batches():
+            # positional contract: upstream emits the same field layout
+            yield Batch(schema, b.columns, b.row_mask)
+
+
+class Task:
+    """One fragment execution (reference execution/SqlTask.java +
+    TaskStateMachine states PLANNED/RUNNING/FINISHED/FAILED/ABORTED)."""
+
+    def __init__(self, task_id: str, doc: dict, catalogs: CatalogManager):
+        self.task_id = task_id
+        self.state = "PLANNED"
+        self.error: Optional[str] = None
+        self.root = codec.decode(doc["fragment"])
+        self.output_kind = doc["output"]["kind"]
+        self.output_keys = list(doc["output"].get("keys", ()))
+        self.buffer = OutputBuffer(int(doc["output"]["n_buffers"]))
+        self.splits = [codec.decode(s) for s in doc.get("splits", [])]
+        self.sources = {int(k): list(v)
+                        for k, v in doc.get("sources", {}).items()}
+        self.partition = int(doc.get("partition", 0))
+        session_doc = doc.get("session", {})
+        self.session = Session(
+            catalogs=catalogs,
+            catalog=session_doc.get("catalog", "tpch"),
+            schema=session_doc.get("schema", "default"),
+            properties=dict(session_doc.get("properties", {})))
+        self.init_values = list(codec.decode(doc.get("init_values", [])))
+        self.rows_per_batch = int(doc.get("rows_per_batch", 1 << 17))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self.state = "RUNNING"
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            ex = _TaskExecutor(self.session, self.rows_per_batch,
+                               self.splits, self.sources, self.partition)
+            ex.init_values = self.init_values
+            ex.mark_shared([self.root])
+            for batch in ex.run(self.root):
+                if batch.host_count() == 0:
+                    continue
+                if self.output_kind == "partition":
+                    pages = serialize_partitioned(
+                        batch, self.output_keys, self.buffer.n)
+                    for b, page in enumerate(pages):
+                        if page is not None:
+                            self.buffer.add(b, page)
+                elif self.output_kind == "broadcast":
+                    self.buffer.add_broadcast(serialize_page(batch))
+                else:   # single
+                    self.buffer.add(0, serialize_page(batch))
+            self.buffer.finish()
+            self.state = "FINISHED"
+        except Exception as e:   # noqa: BLE001 - reported to coordinator
+            self.error = f"{type(e).__name__}: {e}"
+            self.state = "FAILED"
+            self.buffer.fail(self.error)
+
+    def abort(self) -> None:
+        if self.state in ("PLANNED", "RUNNING"):
+            self.state = "ABORTED"
+            self.buffer.fail("task aborted")
+
+    def status(self) -> dict:
+        return {"taskId": self.task_id, "state": self.state,
+                "error": self.error}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # quiet
+        pass
+
+    @property
+    def worker(self) -> "WorkerServer":
+        return self.server.worker    # type: ignore[attr-defined]
+
+    def _json(self, code: int, doc: dict) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        parts = self.path.split("?")[0].strip("/").split("/")
+        if parts[:2] == ["v1", "info"]:
+            self._json(200, self.worker.info())
+            return
+        if parts[:2] == ["v1", "task"] and len(parts) == 3:
+            task = self.worker.tasks.get(parts[2])
+            if task is None:
+                self._json(404, {"error": "no such task"})
+                return
+            self._json(200, task.status())
+            return
+        if (parts[:2] == ["v1", "task"] and len(parts) == 6
+                and parts[3] == "results"):
+            task = self.worker.tasks.get(parts[2])
+            if task is None:
+                self._json(404, {"error": "no such task"})
+                return
+            buf, token = int(parts[4]), int(parts[5])
+            wait = 2.0
+            if "max_wait=" in self.path:
+                wait = float(self.path.split("max_wait=")[1].split("&")[0])
+            try:
+                pages, nxt, complete = task.buffer.get(buf, token, wait)
+            except RuntimeError as e:
+                self._json(500, {"error": str(e)})
+                return
+            body = frame_pages(pages)
+            self.send_response(200)
+            self.send_header("Content-Type", PAGES_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Next-Token", str(nxt))
+            self.send_header("X-Buffer-Complete",
+                             "true" if complete else "false")
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self._json(404, {"error": "not found"})
+
+    def do_PUT(self) -> None:
+        parts = self.path.strip("/").split("/")
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n) if n else b""
+        if parts[:2] == ["v1", "info"] and parts[2:] == ["state"]:
+            state = json.loads(body) if body else ""
+            if state == "SHUTTING_DOWN":
+                self.worker.begin_shutdown()
+                self._json(200, {"state": "SHUTTING_DOWN"})
+            else:
+                self._json(400, {"error": f"bad state {state!r}"})
+            return
+        if parts[:2] == ["v1", "task"] and len(parts) == 3:
+            if self.worker.shutting_down:
+                self._json(503, {"error": "worker is shutting down"})
+                return
+            try:
+                task = self.worker.create_task(parts[2],
+                                               json.loads(body))
+            except (KeyError, ValueError, AnalysisError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            self._json(200, task.status())
+            return
+        self._json(404, {"error": "not found"})
+
+    def do_DELETE(self) -> None:
+        parts = self.path.strip("/").split("/")
+        if parts[:2] == ["v1", "task"] and len(parts) == 3:
+            task = self.worker.tasks.pop(parts[2], None)
+            if task is not None:
+                task.abort()
+            self._json(200, {"aborted": task is not None})
+            return
+        self._json(404, {"error": "not found"})
+
+
+class WorkerServer:
+    def __init__(self, catalogs: Optional[CatalogManager] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 node_id: Optional[str] = None, tpch_sf: float = 0.01):
+        if catalogs is None:
+            from ..connectors.memory import MemoryConnector
+            from ..connectors.system import SystemConnector
+            from ..connectors.tpcds import TpcdsConnector
+            from ..connectors.tpch import TpchConnector
+            catalogs = CatalogManager()
+            catalogs.register("tpch", TpchConnector(sf=tpch_sf))
+            catalogs.register("tpcds", TpcdsConnector(sf=tpch_sf))
+            catalogs.register("memory", MemoryConnector())
+            catalogs.register("system", SystemConnector(catalogs))
+        self.catalogs = catalogs
+        self.tasks: Dict[str, Task] = {}
+        self.started_at = time.time()
+        self.shutting_down = False
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.worker = self   # type: ignore[attr-defined]
+        self.port = self.httpd.server_address[1]
+        self.node_id = node_id or f"worker-{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+
+    def create_task(self, task_id: str, doc: dict) -> Task:
+        task = Task(task_id, doc, self.catalogs)
+        self.tasks[task_id] = task
+        task.start()
+        return task
+
+    def info(self) -> dict:
+        return {
+            "nodeId": self.node_id,
+            "state": "SHUTTING_DOWN" if self.shutting_down else "ACTIVE",
+            "uptime_s": time.time() - self.started_at,
+            "tasks": {s: sum(1 for t in self.tasks.values()
+                             if t.state == s)
+                      for s in ("RUNNING", "FINISHED", "FAILED")},
+        }
+
+    def begin_shutdown(self) -> None:
+        """Drain: refuse new tasks, wait for active ones, then stop."""
+        self.shutting_down = True
+
+        def drain():
+            while any(t.state in ("PLANNED", "RUNNING")
+                      for t in self.tasks.values()):
+                time.sleep(0.2)
+            self.stop()
+        threading.Thread(target=drain, daemon=True).start()
+
+
+def main() -> None:
+    import argparse
+    p = argparse.ArgumentParser(description="presto_tpu worker node")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--tpch-sf", type=float, default=0.01)
+    p.add_argument("--node-id", default=None)
+    args = p.parse_args()
+    w = WorkerServer(host=args.host, port=args.port,
+                     node_id=args.node_id, tpch_sf=args.tpch_sf)
+    print(json.dumps({"nodeId": w.node_id, "port": w.port}), flush=True)
+    w.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()
