@@ -56,11 +56,12 @@ class _Query:
 
     def __init__(self, qid: str, slug: str, sql: str, runner,
                  session_overrides: Dict[str, str],
-                 exec_lock: threading.Lock):
+                 admission=None, user: str = ""):
+        self.user = user
         self.id = qid
         self.slug = slug
         self.sql = sql
-        self._exec_lock = exec_lock
+        self._admission = admission
         self.state = "QUEUED"
         self.error: Optional[Dict] = None
         self.columns: Optional[List[Dict]] = None
@@ -78,23 +79,23 @@ class _Query:
 
     # -- producer ------------------------------------------------------------
     def _run(self) -> None:
-        self.state = "RUNNING"
         try:
-            # one statement at a time: the runner's session (and the
-            # single device) is shared — the role of queued dispatch
-            # (reference dispatcher/DispatchManager.java:134)
-            with self._exec_lock:
-                props = self._runner.session.properties
-                saved = {k: props.get(k) for k in self._overrides}
-                props.update(self._overrides)
-                try:
-                    res = self._runner.execute(self.sql)
-                finally:
-                    for k, v in saved.items():
-                        if v is None:
-                            props.pop(k, None)
-                        else:
-                            props[k] = v
+            # admission: block in QUEUED until the resource group grants
+            # a run slot (reference dispatcher/DispatchManager.java:134 +
+            # resourcegroups/InternalResourceGroup run/queue decision)
+            if self._admission is not None:
+                while not self._admission.wait(0.1):
+                    if self._cancelled.is_set():
+                        self._admission.release()
+                        return
+            self.state = "RUNNING"
+            try:
+                res = self._runner.execute(
+                    self.sql, properties=dict(self._overrides),
+                    user=self.user)
+            finally:
+                if self._admission is not None:
+                    self._admission.release()
             self.columns = [
                 {"name": n, "type": t.display()}
                 for n, t in zip(res.names, res.types)
@@ -200,6 +201,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/v1/statement":
             self._reply(404, {"error": "not found"})
             return
+        if not self._authenticate():
+            return
         n = int(self.headers.get("Content-Length", 0))
         sql = self.rfile.read(n).decode()
         overrides = {}
@@ -207,10 +210,26 @@ class _Handler(BaseHTTPRequestHandler):
             if "=" in part:
                 k, v = part.split("=", 1)
                 overrides[k.strip()] = urllib.parse.unquote(v.strip())
-        q = self._srv.create_query(sql, overrides)
+        from .resource_groups import QueryQueueFullError
+        try:
+            q = self._srv.create_query(
+                sql, overrides,
+                user=getattr(self, "_auth_user", None)
+                or self.headers.get("X-Presto-User", ""),
+                source=self.headers.get("X-Presto-Source", ""))
+        except QueryQueueFullError as e:
+            self._reply(429, {"error": {"message": str(e),
+                                        "errorName": "QUERY_QUEUE_FULL",
+                                        "errorType": "INSUFFICIENT_RESOURCES"}})
+            return
         self._reply(200, self._results_doc(q, 0, first=True))
 
     def do_GET(self) -> None:
+        if not self._authenticate():
+            return
+        if self.path.rstrip("/") == "/v1/resourceGroup":
+            self._reply(200, {"groups": self._srv.resource_groups.info()})
+            return
         m = self._match_executing()
         if m is None:
             self._reply(404, {"error": "not found"})
@@ -230,6 +249,8 @@ class _Handler(BaseHTTPRequestHandler):
                     headers)
 
     def do_DELETE(self) -> None:
+        if not self._authenticate():
+            return
         m = self._match_executing()
         if m is None:
             self._reply(404, {"error": "not found"})
@@ -237,6 +258,33 @@ class _Handler(BaseHTTPRequestHandler):
         q, _ = m
         q.cancel()
         self._reply(200, {})
+
+    def _authenticate(self) -> bool:
+        """HTTP Basic against the installed password authenticator
+        (reference server/security/AuthenticationFilter.java); no
+        authenticator = open server, header-asserted identity."""
+        auth = self._srv.authenticator
+        if auth is None:
+            return True
+        import base64
+        header = self.headers.get("Authorization", "")
+        if header.startswith("Basic "):
+            try:
+                raw = base64.b64decode(header[6:]).decode()
+                user, _, password = raw.partition(":")
+            except Exception:
+                user, password = "", ""
+            if auth.authenticate(user, password):
+                self._auth_user = user
+                return True
+        body = json.dumps({"error": "Unauthorized"}).encode()
+        self.send_response(401)
+        self.send_header("WWW-Authenticate",
+                         'Basic realm="presto-tpu"')
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        return False
 
     def _match_executing(self):
         parts = self.path.strip("/").split("/")
@@ -274,7 +322,11 @@ class _Handler(BaseHTTPRequestHandler):
 class PrestoTpuServer:
     """Embeddable statement server over a LocalRunner."""
 
-    def __init__(self, runner=None, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, runner=None, host: str = "127.0.0.1", port: int = 0,
+                 resource_groups: Optional[Dict] = None,
+                 authenticator=None):
+        from .resource_groups import ResourceGroupManager
+        self.authenticator = authenticator
         if runner is None:
             from ..exec.runner import LocalRunner
             runner = LocalRunner()
@@ -282,20 +334,25 @@ class PrestoTpuServer:
         self.queries: Dict[str, _Query] = {}
         self._seq = 0
         self._lock = threading.Lock()
-        self._exec_lock = threading.Lock()
+        # admission: the default config keeps one query running at a
+        # time (the single shared device); pass a rootGroups/selectors
+        # dict for real concurrency tiers
+        self.resource_groups = ResourceGroupManager(resource_groups)
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.presto = self      # type: ignore[attr-defined]
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
 
-    def create_query(self, sql: str, overrides: Dict[str, str]) -> _Query:
+    def create_query(self, sql: str, overrides: Dict[str, str],
+                     user: str = "", source: str = "") -> _Query:
         with self._lock:
             self._seq += 1
             qid = (f"{datetime.date.today().strftime('%Y%m%d')}"
                    f"_{self._seq:06d}")
+        admission = self.resource_groups.submit(user=user, source=source)
         q = _Query(qid, secrets.token_hex(8), sql, self.runner, overrides,
-                   self._exec_lock)
+                   admission, user=user)
         with self._lock:
             self.queries[qid] = q
             if len(self.queries) > 200:   # evict oldest drained queries

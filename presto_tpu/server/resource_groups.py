@@ -1,0 +1,213 @@
+"""Hierarchical resource groups: admission control for the statement server.
+
+The role of the reference's resource-group subsystem (reference
+presto-main/.../execution/resourcegroups/InternalResourceGroup.java —
+hierarchical concurrency/queue limits, weighted-fair dequeue across
+subgroups via WeightedFairQueue.java;
+InternalResourceGroupManager.java + the file-based configuration of
+presto-resource-group-managers). Queries run in LEAF groups; a query is
+eligible to start only while every group on its path is under its own
+``hard_concurrency_limit``; full queues reject new work
+(QUERY_QUEUE_FULL).
+
+Configuration mirrors the file manager's JSON shape::
+
+    {"rootGroups": [
+        {"name": "global", "hardConcurrencyLimit": 4, "maxQueued": 100,
+         "subGroups": [
+            {"name": "adhoc", "hardConcurrencyLimit": 2,
+             "schedulingWeight": 1},
+            {"name": "etl", "hardConcurrencyLimit": 3,
+             "schedulingWeight": 3}]}],
+     "selectors": [
+        {"user": "etl-.*", "group": "global.etl"},
+        {"group": "global.adhoc"}]}
+
+Dequeue is deterministic weighted-fair: among sibling subgroups with
+queued queries, the one with the lowest running/weight ratio goes first.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional
+
+
+class QueryQueueFullError(RuntimeError):
+    pass
+
+
+class Admission:
+    """Handle for one submitted query: wait() blocks until a run slot is
+    granted; release() frees it (must be called exactly once)."""
+
+    def __init__(self, group: "ResourceGroup"):
+        self.group = group
+        self._granted = threading.Event()
+        self._released = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._granted.wait(timeout)
+
+    @property
+    def granted(self) -> bool:
+        return self._granted.is_set()
+
+    def release(self) -> None:
+        with self.group.manager.lock:
+            if self._released:
+                return
+            self._released = True
+            if self.granted:
+                g = self.group
+                while g is not None:
+                    g.running -= 1
+                    g = g.parent
+            else:
+                # abandoned while QUEUED (cancel before grant): leave no
+                # ghost entry for the dispatcher to grant a slot to
+                try:
+                    self.group.queue.remove(self)
+                except ValueError:
+                    pass
+        self.group.manager._dispatch()
+
+
+class ResourceGroup:
+    def __init__(self, manager: "ResourceGroupManager", name: str,
+                 parent: Optional["ResourceGroup"],
+                 hard_concurrency_limit: int = 1,
+                 max_queued: int = 100, scheduling_weight: int = 1):
+        self.manager = manager
+        self.name = name
+        self.parent = parent
+        self.path = name if parent is None else f"{parent.path}.{name}"
+        self.hard_concurrency_limit = hard_concurrency_limit
+        self.max_queued = max_queued
+        self.scheduling_weight = max(scheduling_weight, 1)
+        self.children: Dict[str, ResourceGroup] = {}
+        self.queue: List[Admission] = []
+        self.running = 0
+
+    # -- accounting (manager.lock held) --------------------------------------
+    def queued_total(self) -> int:
+        return len(self.queue) + sum(c.queued_total()
+                                     for c in self.children.values())
+
+    def can_run_more(self) -> bool:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            if g.running >= g.hard_concurrency_limit:
+                return False
+            g = g.parent
+        return True
+
+    def _pick_queued(self) -> Optional["ResourceGroup"]:
+        """Deepest-first weighted-fair choice of a descendant leaf-queue
+        with work, honoring every level's concurrency limit."""
+        if self.running >= self.hard_concurrency_limit:
+            return None
+        candidates = [c._pick_queued() for c in self.children.values()]
+        candidates = [c for c in candidates if c is not None]
+        if self.queue:
+            candidates.append(self)
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda g: (g.running / g.scheduling_weight,
+                                  g.path))
+
+    def info(self) -> dict:
+        return {
+            "id": self.path,
+            "hardConcurrencyLimit": self.hard_concurrency_limit,
+            "maxQueued": self.max_queued,
+            "schedulingWeight": self.scheduling_weight,
+            "numRunning": self.running,
+            "numQueued": len(self.queue),
+            "subGroups": [c.info() for c in self.children.values()],
+        }
+
+
+class ResourceGroupManager:
+    def __init__(self, config: Optional[dict] = None):
+        self.lock = threading.Lock()
+        self.roots: Dict[str, ResourceGroup] = {}
+        self.selectors: List[dict] = []
+        config = config or {
+            "rootGroups": [{"name": "global", "hardConcurrencyLimit": 1,
+                            "maxQueued": 200}],
+            "selectors": [{"group": "global"}],
+        }
+        for spec in config.get("rootGroups", []):
+            self._build(spec, None)
+        self.selectors = list(config.get("selectors", []))
+
+    def _build(self, spec: dict, parent: Optional[ResourceGroup]) -> None:
+        g = ResourceGroup(
+            self, spec["name"], parent,
+            hard_concurrency_limit=int(
+                spec.get("hardConcurrencyLimit", 1)),
+            max_queued=int(spec.get("maxQueued", 100)),
+            scheduling_weight=int(spec.get("schedulingWeight", 1)))
+        if parent is None:
+            self.roots[g.name] = g
+        else:
+            parent.children[g.name] = g
+        for sub in spec.get("subGroups", []):
+            self._build(sub, g)
+
+    # -- selection -----------------------------------------------------------
+    def _group_for(self, user: str, source: str) -> ResourceGroup:
+        for sel in self.selectors:
+            if "user" in sel and not re.fullmatch(sel["user"], user or ""):
+                continue
+            if "source" in sel and not re.fullmatch(sel["source"],
+                                                    source or ""):
+                continue
+            return self._resolve(sel["group"])
+        # no selector matched: first root
+        return next(iter(self.roots.values()))
+
+    def _resolve(self, path: str) -> ResourceGroup:
+        parts = path.split(".")
+        g = self.roots[parts[0]]
+        for p in parts[1:]:
+            g = g.children[p]
+        return g
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, user: str = "", source: str = "") -> Admission:
+        with self.lock:
+            group = self._group_for(user, source)
+            if group.queued_total() >= group.max_queued:
+                raise QueryQueueFullError(
+                    f"Too many queued queries for {group.path!r}")
+            adm = Admission(group)
+            group.queue.append(adm)
+        self._dispatch()
+        return adm
+
+    def _dispatch(self) -> None:
+        with self.lock:
+            while True:
+                started = False
+                for root in self.roots.values():
+                    g = root._pick_queued()
+                    if g is None or not g.queue:
+                        continue
+                    if not g.can_run_more():
+                        continue
+                    adm = g.queue.pop(0)
+                    walk: Optional[ResourceGroup] = g
+                    while walk is not None:
+                        walk.running += 1
+                        walk = walk.parent
+                    adm._granted.set()
+                    started = True
+                if not started:
+                    return
+
+    def info(self) -> List[dict]:
+        with self.lock:
+            return [g.info() for g in self.roots.values()]

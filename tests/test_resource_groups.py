@@ -1,0 +1,148 @@
+"""Resource groups: admission, queueing, weighted-fair dispatch
+(reference execution/resourcegroups/InternalResourceGroup.java)."""
+import threading
+import time
+
+import pytest
+
+from presto_tpu.server.resource_groups import (
+    QueryQueueFullError, ResourceGroupManager,
+)
+
+
+def test_serial_default():
+    m = ResourceGroupManager()
+    a = m.submit(user="alice")
+    b = m.submit(user="bob")
+    assert a.granted and not b.granted
+    a.release()
+    assert b.granted
+    b.release()
+
+
+def test_concurrency_limit_and_queue():
+    m = ResourceGroupManager({
+        "rootGroups": [{"name": "g", "hardConcurrencyLimit": 2,
+                        "maxQueued": 2}],
+        "selectors": [{"group": "g"}]})
+    adms = [m.submit() for _ in range(4)]
+    assert [a.granted for a in adms] == [True, True, False, False]
+    with pytest.raises(QueryQueueFullError):
+        m.submit()
+    adms[0].release()
+    assert adms[2].granted and not adms[3].granted
+    for a in adms[1:3]:
+        a.release()
+    assert adms[3].granted
+    adms[3].release()
+
+
+def test_parent_limit_gates_children():
+    m = ResourceGroupManager({
+        "rootGroups": [{"name": "root", "hardConcurrencyLimit": 1,
+                        "maxQueued": 10,
+                        "subGroups": [
+                            {"name": "a", "hardConcurrencyLimit": 5},
+                            {"name": "b", "hardConcurrencyLimit": 5}]}],
+        "selectors": [{"user": "a.*", "group": "root.a"},
+                      {"group": "root.b"}]})
+    a1 = m.submit(user="alice")
+    b1 = m.submit(user="bob")
+    assert a1.granted and not b1.granted   # root caps total at 1
+    a1.release()
+    assert b1.granted
+    b1.release()
+
+
+def test_weighted_fair_prefers_underweighted():
+    m = ResourceGroupManager({
+        "rootGroups": [{"name": "root", "hardConcurrencyLimit": 2,
+                        "maxQueued": 10,
+                        "subGroups": [
+                            {"name": "small", "hardConcurrencyLimit": 2,
+                             "schedulingWeight": 1},
+                            {"name": "big", "hardConcurrencyLimit": 2,
+                             "schedulingWeight": 3}]}],
+        "selectors": [{"source": "s", "group": "root.small"},
+                      {"group": "root.big"}]})
+    s1 = m.submit(source="s")
+    g1 = m.submit()
+    assert s1.granted and g1.granted
+    s2 = m.submit(source="s")
+    g2 = m.submit()
+    # small releases -> small has 0 running (ratio 0/1), big has 1
+    # (ratio 1/3): small is further below its fair share, so its queued
+    # query gets the freed slot
+    s1.release()
+    assert s2.granted and not g2.granted
+    # big releases -> ratios small 1/1 vs big 0/3: big goes next
+    g1.release()
+    assert g2.granted
+    for a in (s2, g2):
+        a.release()
+
+
+def test_selector_matching():
+    m = ResourceGroupManager({
+        "rootGroups": [{"name": "r", "hardConcurrencyLimit": 10,
+                        "subGroups": [
+                            {"name": "etl", "hardConcurrencyLimit": 5},
+                            {"name": "adhoc", "hardConcurrencyLimit": 5}]}],
+        "selectors": [{"user": "etl-.*", "group": "r.etl"},
+                      {"group": "r.adhoc"}]})
+    a = m.submit(user="etl-nightly")
+    b = m.submit(user="jane")
+    assert a.group.path == "r.etl"
+    assert b.group.path == "r.adhoc"
+    a.release(); b.release()
+
+
+def test_release_of_queued_admission_frees_no_slot():
+    """Cancelling a QUEUED query must remove it from the queue without
+    granting (and leaking) a run slot."""
+    m = ResourceGroupManager()      # concurrency 1
+    a = m.submit()
+    b = m.submit()
+    assert a.granted and not b.granted
+    b.release()                     # cancel while queued
+    a.release()
+    c = m.submit()                  # the slot is free, not leaked
+    assert c.granted
+    c.release()
+    info = m.info()[0]
+    assert info["numRunning"] == 0 and info["numQueued"] == 0
+
+
+def test_server_queues_second_query():
+    """Server-level: with the default serial group, a second statement
+    stays QUEUED until the first finishes."""
+    from presto_tpu.server.protocol import PrestoTpuServer
+
+    class SlowRunner:
+        def __init__(self):
+            self.gate = threading.Event()
+            from presto_tpu.exec.local import QueryResult
+            self._result = QueryResult(["x"], [], [(1,)])
+
+        def execute(self, sql, properties=None, user=""):
+            if sql == "slow":
+                self.gate.wait(20)
+            return self._result
+
+    runner = SlowRunner()
+    srv = PrestoTpuServer(runner=runner)
+    q1 = srv.create_query("slow", {})
+    q2 = srv.create_query("fast", {})
+    deadline = time.time() + 10
+    while q1.state != "RUNNING" and time.time() < deadline:
+        time.sleep(0.02)
+    assert q1.state == "RUNNING"
+    time.sleep(0.3)
+    assert q2.state == "QUEUED"
+    runner.gate.set()
+    deadline = time.time() + 10
+    while q2.state != "FINISHED" and time.time() < deadline:
+        time.sleep(0.02)
+    assert q1.state == "FINISHED" and q2.state == "FINISHED"
+    info = srv.resource_groups.info()
+    assert info[0]["numRunning"] == 0 and info[0]["numQueued"] == 0
