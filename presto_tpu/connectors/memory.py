@@ -78,6 +78,18 @@ class MemoryConnector(Connector):
         return MemoryPageSource(list(self.tables.get(split.table.table, [])),
                                 columns)
 
+    # -- transactions (reference spi ConnectorTransactionHandle role) -------
+    def transaction_snapshot(self):
+        """Cheap structural snapshot: batches are immutable, so shallow
+        list copies capture the whole state."""
+        return ({t: list(bs) for t, bs in self.tables.items()},
+                dict(self.schemas))
+
+    def transaction_restore(self, snap) -> None:
+        tables, schemas = snap
+        self.tables = {t: list(bs) for t, bs in tables.items()}
+        self.schemas = dict(schemas)
+
     # -- write surface (reference spi/connector/ConnectorPageSink.java) ------
     def create_table(self, name: str, schema: Schema,
                      if_not_exists: bool = False) -> None:

@@ -358,6 +358,22 @@ class ResetSession(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class StartTransaction(Node):
+    isolation: str = "READ COMMITTED"
+    read_only: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Commit(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollback(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class CreateTableAsSelect(Node):
     name: Tuple[str, ...]
     query: Query

@@ -43,6 +43,7 @@ KEYWORDS = frozenset("""
     create table drop insert into values if show session set reset explain
     analyze describe catalogs schemas tables columns functions
     over partition rows range preceding following unbounded current row
+    start transaction commit rollback work isolation level only
 """.split())
 
 # Keywords that can still be used as identifiers in non-ambiguous positions
@@ -52,6 +53,7 @@ NON_RESERVED = frozenset("""
     tables columns functions session analyze show if first last nulls
     count sum avg min max coalesce nullif interval
     over partition rows range preceding following unbounded current row
+    start transaction commit rollback work isolation level only
 """.split())
 
 

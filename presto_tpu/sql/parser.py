@@ -125,6 +125,37 @@ class _Parser:
             self.next()
             self.expect_kw("session")
             return A.ResetSession(".".join(self.qualified_name()))
+        if self.at_kw("start"):
+            self.next()
+            self.expect_kw("transaction")
+            isolation, read_only = "READ COMMITTED", False
+            while True:
+                if self.accept_kw("isolation"):
+                    self.expect_kw("level")
+                    w1 = self.next().text.lower()
+                    isolation = (w1 if w1 == "serializable"
+                                 else f"{w1} {self.next().text}").upper()
+                elif (self.peek().text == "read"
+                      and self.peek().kind in ("IDENT", "KEYWORD")):
+                    self.next()
+                    read_only = self.accept_kw("only")
+                    if not read_only:
+                        t = self.next()
+                        if t.text != "write":
+                            raise SqlSyntaxError(
+                                f"expected ONLY or WRITE, found "
+                                f"{t.text!r}", t.line, t.col)
+                elif not self.accept_op(","):
+                    break
+            return A.StartTransaction(isolation, read_only)
+        if self.at_kw("commit"):
+            self.next()
+            self.accept_kw("work")
+            return A.Commit()
+        if self.at_kw("rollback"):
+            self.next()
+            self.accept_kw("work")
+            return A.Rollback()
         if self.at_kw("create"):
             return self._create()
         if self.at_kw("drop"):

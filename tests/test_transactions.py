@@ -1,0 +1,83 @@
+"""Transactions over the memory catalog (reference
+transaction/InMemoryTransactionManager.java)."""
+import pytest
+
+from presto_tpu.exec.runner import LocalRunner
+from presto_tpu.transaction import TransactionError
+
+
+@pytest.fixture()
+def runner():
+    return LocalRunner(tpch_sf=0.001)
+
+
+def _count(runner, table):
+    return runner.execute(
+        f"select count(*) from memory.default.{table}").rows[0][0]
+
+
+def test_commit_keeps_writes(runner):
+    runner.execute("start transaction")
+    runner.execute("create table memory.default.t as "
+                   "select n_nationkey k from nation")
+    runner.execute("insert into memory.default.t "
+                   "select r_regionkey from region")
+    assert _count(runner, "t") == 30      # read-your-writes inside tx
+    runner.execute("commit")
+    assert _count(runner, "t") == 30
+
+
+def test_rollback_restores_snapshot(runner):
+    runner.execute("create table memory.default.base as "
+                   "select r_regionkey k from region")
+    runner.execute("start transaction")
+    runner.execute("insert into memory.default.base "
+                   "select n_nationkey from nation")
+    runner.execute("create table memory.default.scratch as "
+                   "select 1 x")
+    assert _count(runner, "base") == 30
+    runner.execute("rollback")
+    assert _count(runner, "base") == 5    # insert undone
+    with pytest.raises(Exception):
+        _count(runner, "scratch")         # create undone
+
+
+def test_drop_rolled_back(runner):
+    runner.execute("create table memory.default.keep as select 1 x")
+    runner.execute("start transaction")
+    runner.execute("drop table memory.default.keep")
+    runner.execute("rollback")
+    assert _count(runner, "keep") == 1
+
+
+def test_read_only_rejects_writes(runner):
+    runner.execute("start transaction read only")
+    with pytest.raises(TransactionError, match="read-only"):
+        runner.execute("create table memory.default.x as select 1 a")
+    runner.execute("rollback")
+
+
+def test_isolation_level_parses(runner):
+    res = runner.execute(
+        "start transaction isolation level serializable, read write")
+    assert res.rows[0][0].startswith("tx_")
+    runner.execute("commit")
+    runner.execute("start transaction isolation level repeatable read")
+    runner.execute("commit")
+
+
+def test_nested_begin_rejected(runner):
+    runner.execute("start transaction")
+    with pytest.raises(TransactionError, match="already in progress"):
+        runner.execute("start transaction")
+    runner.execute("rollback")
+
+
+def test_commit_without_tx_rejected(runner):
+    with pytest.raises(TransactionError, match="no transaction"):
+        runner.execute("commit")
+
+
+def test_autocommit_unaffected(runner):
+    runner.execute("create table memory.default.ac as select 1 a")
+    assert _count(runner, "ac") == 1
