@@ -1,0 +1,49 @@
+"""Query event listeners.
+
+The role of the reference's event-listener plugin point (reference
+eventlistener/EventListenerManager.java + event/QueryMonitor.java
+publishing spi/eventlistener/QueryCompletedEvent.java): the runner
+publishes created/completed events to registered listeners; audit
+loggers, metrics sinks, and the verifier's query log all hang off this.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCompletedEvent:
+    query_id: str
+    query: str
+    user: str
+    state: str                  # FINISHED | FAILED
+    elapsed_ms: float
+    error: Optional[str] = None
+    create_time: float = 0.0    # epoch seconds
+
+
+class EventListenerManager:
+    def __init__(self) -> None:
+        self._listeners: List[Callable[[QueryCompletedEvent], None]] = []
+
+    def register(self,
+                 listener: Callable[[QueryCompletedEvent], None]) -> None:
+        self._listeners.append(listener)
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        for listener in self._listeners:
+            try:
+                listener(event)
+            except Exception:   # listeners must not break queries
+                pass
+
+
+def completed_event(query_id: str, query: str, user: str, state: str,
+                    started_at: float,
+                    error: Optional[str] = None) -> QueryCompletedEvent:
+    return QueryCompletedEvent(
+        query_id=query_id, query=query, user=user, state=state,
+        elapsed_ms=(time.perf_counter() - started_at) * 1e3,
+        error=error, create_time=time.time())

@@ -40,31 +40,54 @@ class LocalRunner:
             catalogs.register("system", SystemConnector(catalogs))
         self.session = Session(catalogs=catalogs, catalog=catalog,
                                schema=schema)
+        from ..transaction import TransactionManager
+        from ..events import EventListenerManager
+        from ..server.security import AccessControl
+        self.transactions = TransactionManager()
+        self.events = EventListenerManager()
+        self.access_control = AccessControl()    # allow-all until rules set
         self.rows_per_batch = rows_per_batch
         self.query_log = catalogs.get("system").query_log
         self._query_seq = 0
+        import threading
+        self._state_lock = threading.Lock()
 
     # -- public API -----------------------------------------------------------
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str,
+                properties: Optional[Dict[str, object]] = None,
+                user: str = "") -> QueryResult:
+        """Run one statement. ``properties`` overlays per-query session
+        properties without mutating the shared session (needed for
+        concurrent queries under resource groups; the reference builds a
+        per-query Session the same way, Session.java +
+        QuerySessionSupplier). ``user`` scopes access-control checks and
+        query events."""
         import time as _time
         from ..connectors.system import QueryLogEntry
+        from ..events import completed_event
         stmt = parse_statement(sql)
-        self._query_seq += 1
-        qid = f"q_{self._query_seq:06d}"
-        entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0)
-        self.query_log.append(entry)
+        with self._state_lock:
+            self._query_seq += 1
+            qid = f"q_{self._query_seq:06d}"
+            entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0)
+            self.query_log.append(entry)
         t0 = _time.perf_counter()
+        error: Optional[str] = None
         try:
-            out = self._execute_stmt(stmt)
+            out = self._execute_stmt(stmt, properties, user)
             entry.state = "FINISHED"
             return out
-        except Exception:
+        except Exception as e:
             entry.state = "FAILED"
+            error = str(e)
             raise
         finally:
             entry.elapsed_ms = (_time.perf_counter() - t0) * 1e3
-            if len(self.query_log) > 1000:
-                del self.query_log[:-500]
+            with self._state_lock:
+                if len(self.query_log) > 1000:
+                    del self.query_log[:-500]
+            self.events.query_completed(completed_event(
+                qid, sql.strip(), user, entry.state, t0, error))
 
     def plan(self, sql: str, optimized: bool = True) -> LogicalPlan:
         stmt = parse_statement(sql)
@@ -74,17 +97,30 @@ class LocalRunner:
         return optimize(plan, self.session) if optimized else plan
 
     # -- statement dispatch ---------------------------------------------------
-    def _execute_stmt(self, stmt: A.Node) -> QueryResult:
+    def _execute_stmt(self, stmt: A.Node,
+                      properties: Optional[Dict[str, object]] = None,
+                      user: str = "") -> QueryResult:
+        import dataclasses as _dc
+        session = self.session
+        secured = bool(self.access_control.catalog_rules)
+        if properties or secured:
+            catalogs = session.catalogs
+            if secured:
+                from ..server.security import SecuredCatalogs
+                catalogs = SecuredCatalogs(catalogs, user,
+                                           self.access_control)
+            session = _dc.replace(
+                session, catalogs=catalogs,
+                properties={**session.properties, **(properties or {})})
         if isinstance(stmt, A.Query):
-            plan = optimize(plan_query(stmt, self.session), self.session)
-            return execute_plan(plan, self.session, self.rows_per_batch)
+            plan = optimize(plan_query(stmt, session), session)
+            return execute_plan(plan, session, self.rows_per_batch)
         if isinstance(stmt, A.Explain):
             if not isinstance(stmt.statement, A.Query):
                 raise ValueError("EXPLAIN requires a query")
             import time as _time
             t0 = _time.perf_counter()
-            plan = optimize(plan_query(stmt.statement, self.session),
-                            self.session)
+            plan = optimize(plan_query(stmt.statement, session), session)
             stats = None
             if stmt.analyze:
                 # EXPLAIN ANALYZE: run the query with per-operator stats,
@@ -94,7 +130,7 @@ class LocalRunner:
                 stats = StatsCollector(count_rows=True)
                 stats.planning_s = _time.perf_counter() - t0
                 t1 = _time.perf_counter()
-                execute_plan(plan, self.session, self.rows_per_batch,
+                execute_plan(plan, session, self.rows_per_batch,
                              stats=stats, collect_rows=False)
                 stats.total_wall_s = _time.perf_counter() - t1
             text = print_plan(plan, stats)
@@ -102,9 +138,9 @@ class LocalRunner:
                                [(line,) for line in text.split("\n")])
         if isinstance(stmt, A.ShowCatalogs):
             return QueryResult(["Catalog"], [T.VARCHAR],
-                               [(c,) for c in self.session.catalogs.names()])
+                               [(c,) for c in session.catalogs.names()])
         if isinstance(stmt, A.ShowTables):
-            conn = self.session.catalogs.get(self.session.catalog)
+            conn = session.catalogs.get(session.catalog)
             return QueryResult(
                 ["Table"], [T.VARCHAR],
                 [(t,) for t in conn.metadata.list_tables()])
@@ -112,7 +148,7 @@ class LocalRunner:
             name = stmt.table
             catalog = self.session.catalog if len(name) < 3 else name[-3]
             schema = self.session.schema if len(name) < 2 else name[-2]
-            conn = self.session.catalogs.get(catalog)
+            conn = session.catalogs.get(catalog)
             ts = conn.metadata.table_schema(
                 TableHandle(catalog, schema, name[-1]))
             return QueryResult(
@@ -130,38 +166,56 @@ class LocalRunner:
         if isinstance(stmt, A.ResetSession):
             self.session.properties.pop(stmt.name, None)
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.StartTransaction):
+            tx_id = self.transactions.begin(stmt.isolation,
+                                            stmt.read_only, user=user)
+            return QueryResult(["result"], [T.VARCHAR], [(tx_id,)])
+        if isinstance(stmt, A.Commit):
+            self.transactions.commit(user=user)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.Rollback):
+            self.transactions.rollback(user=user)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
         if isinstance(stmt, A.CreateTableAsSelect):
-            return self._ctas(stmt)
+            return self._ctas(stmt, session, user)
         if isinstance(stmt, A.InsertInto):
-            return self._insert(stmt)
+            return self._insert(stmt, session, user)
         if isinstance(stmt, A.DropTable):
-            conn, table = self._writable(stmt.name)
+            conn, table = self._writable(stmt.name, user)
             conn.drop_table(table, if_exists=stmt.if_exists)
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
         raise NotImplementedError(
             f"statement {type(stmt).__name__} is not supported yet")
 
     # -- write path (reference TableWriterOperator + finishInsert) ----------
-    def _writable(self, name):
+    def _writable(self, name, user: str = ""):
         catalog = self.session.catalog if len(name) < 3 else name[-3]
+        self.access_control.check_can_access_catalog(user, catalog)
         conn = self.session.catalogs.get(catalog)
         if not hasattr(conn, "create_table"):
             raise ValueError(f"catalog {catalog!r} is not writable")
+        # inside an explicit transaction: snapshot before the first write
+        # so ROLLBACK can restore (auto-commit outside one)
+        self.transactions.touch_for_write(catalog, conn, user=user)
         return conn, name[-1]
 
-    def _run_to_batches(self, query: A.Query):
+    def _run_to_batches(self, query: A.Query, session=None):
         from ..batch import Schema
         from .local import _Executor, run_init_plans
-        plan = optimize(plan_query(query, self.session), self.session)
-        ex = _Executor(self.session, self.rows_per_batch)
+        session = session or self.session
+        plan = optimize(plan_query(query, session), session)
+        ex = _Executor(session, self.rows_per_batch)
         run_init_plans(ex, plan)
         root = plan.root
         schema = Schema([(f.name, f.type) for f in root.fields])
         return schema, ex.run(root.child)
 
-    def _ctas(self, stmt: A.CreateTableAsSelect) -> QueryResult:
-        conn, table = self._writable(stmt.name)
-        schema, batches = self._run_to_batches(stmt.query)
+    def _ctas(self, stmt: A.CreateTableAsSelect, session=None,
+              user: str = "") -> QueryResult:
+        conn, table = self._writable(stmt.name, user)
+        # the source query plans against the SECURED per-query session:
+        # INSERT ... SELECT must not read catalogs the user cannot SELECT
+        schema, batches = self._run_to_batches(stmt.query, session)
         if table in conn.tables and stmt.if_not_exists:
             return QueryResult(["rows"], [T.BIGINT], [(0,)])
         conn.create_table(table, schema, if_not_exists=stmt.if_not_exists)
@@ -170,9 +224,10 @@ class LocalRunner:
             n += conn.append(table, Batch(schema, b.columns, b.row_mask))
         return QueryResult(["rows"], [T.BIGINT], [(n,)])
 
-    def _insert(self, stmt: A.InsertInto) -> QueryResult:
-        conn, table = self._writable(stmt.name)
-        schema, batches = self._run_to_batches(stmt.query)
+    def _insert(self, stmt: A.InsertInto, session=None,
+                user: str = "") -> QueryResult:
+        conn, table = self._writable(stmt.name, user)
+        schema, batches = self._run_to_batches(stmt.query, session)
         n = 0
         for b in batches:
             n += conn.append(table, Batch(schema, b.columns, b.row_mask))

@@ -1,0 +1,103 @@
+"""Security: password authentication + catalog access control.
+
+The roles of the reference's security surface reduced to its two
+load-bearing pieces (reference server/security/AuthenticationFilter.java
++ PasswordAuthenticatorManager with the file-based authenticator of
+presto-password-authenticators/.../file/FileAuthenticator.java, and
+security/AccessControlManager.java + spi/security/SystemAccessControl
+with the catalog rules of the file-based access controller):
+
+- ``PasswordAuthenticator``: user -> password map (or a ``user:password``
+  lines file); the statement server challenges with HTTP Basic when one
+  is installed.
+- ``AccessControl``: catalog-level allow/deny rules evaluated per user,
+  same shape as the reference's file-based catalog rules::
+
+      {"catalogs": [
+          {"user": "admin", "catalog": ".*", "allow": true},
+          {"catalog": "system", "allow": false},
+          {"allow": true}]}
+
+  First matching rule wins (user/catalog are full-match regexes,
+  both optional).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+
+class AccessDeniedError(PermissionError):
+    pass
+
+
+class PasswordAuthenticator:
+    def __init__(self, users: Optional[Dict[str, str]] = None,
+                 path: Optional[str] = None):
+        self.users = dict(users or {})
+        if path:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if line and ":" in line and not line.startswith("#"):
+                        u, p = line.split(":", 1)
+                        self.users[u] = p
+
+    def authenticate(self, user: str, password: str) -> bool:
+        import hmac
+        expected = self.users.get(user)
+        return expected is not None and hmac.compare_digest(
+            expected, password)
+
+
+class AccessControl:
+    """First-match catalog rules; default-deny when rules exist, the
+    permissive allow-all when constructed with no rules."""
+
+    def __init__(self, rules: Optional[dict] = None):
+        self.catalog_rules: List[dict] = \
+            list((rules or {}).get("catalogs", []))
+
+    def can_access_catalog(self, user: str, catalog: str) -> bool:
+        if not self.catalog_rules:
+            return True
+        for rule in self.catalog_rules:
+            if "user" in rule and not re.fullmatch(rule["user"],
+                                                   user or ""):
+                continue
+            if "catalog" in rule and not re.fullmatch(rule["catalog"],
+                                                      catalog):
+                continue
+            return bool(rule.get("allow", True))
+        return False
+
+    def check_can_access_catalog(self, user: str, catalog: str) -> None:
+        if not self.can_access_catalog(user, catalog):
+            raise AccessDeniedError(
+                f"Access Denied: user {user!r} cannot access catalog "
+                f"{catalog!r}")
+
+    def filter_catalogs(self, user: str, catalogs: List[str]) -> List[str]:
+        return [c for c in catalogs if self.can_access_catalog(user, c)]
+
+
+class SecuredCatalogs:
+    """CatalogManager view that enforces access control on every
+    resolution — the planner/executor path needs no security knowledge
+    (the reference injects this the same way: MetadataManager resolves
+    through AccessControl-checked connectors)."""
+
+    def __init__(self, inner, user: str, access_control: AccessControl):
+        self._inner = inner
+        self._user = user
+        self._ac = access_control
+
+    def get(self, name: str):
+        self._ac.check_can_access_catalog(self._user, name)
+        return self._inner.get(name)
+
+    def names(self) -> List[str]:
+        return self._ac.filter_catalogs(self._user, self._inner.names())
+
+    def register(self, name: str, connector) -> None:
+        self._inner.register(name, connector)

@@ -1,0 +1,124 @@
+"""Security (password auth + catalog access control) and query events
+(reference server/security/, security/AccessControlManager.java,
+eventlistener/EventListenerManager.java)."""
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu.exec.runner import LocalRunner
+from presto_tpu.server.security import (
+    AccessControl, AccessDeniedError, PasswordAuthenticator,
+)
+
+
+def test_password_authenticator():
+    auth = PasswordAuthenticator({"alice": "secret"})
+    assert auth.authenticate("alice", "secret")
+    assert not auth.authenticate("alice", "wrong")
+    assert not auth.authenticate("bob", "secret")
+
+
+def test_access_control_rules():
+    ac = AccessControl({"catalogs": [
+        {"user": "admin", "catalog": ".*", "allow": True},
+        {"catalog": "system", "allow": False},
+        {"allow": True}]})
+    assert ac.can_access_catalog("admin", "system")
+    assert not ac.can_access_catalog("jane", "system")
+    assert ac.can_access_catalog("jane", "tpch")
+    assert ac.filter_catalogs("jane", ["tpch", "system"]) == ["tpch"]
+
+
+def test_runner_enforces_catalog_rules():
+    r = LocalRunner(tpch_sf=0.001)
+    r.access_control = AccessControl({"catalogs": [
+        {"user": "admin", "allow": True},
+        {"catalog": "tpch", "allow": True},
+        {"allow": False}]})
+    assert r.execute("select count(*) from nation",
+                     user="jane").rows == [(25,)]
+    with pytest.raises(AccessDeniedError):
+        r.execute("select * from system.default.catalogs", user="jane")
+    rows = r.execute("select * from system.default.catalogs",
+                     user="admin").rows
+    assert ("tpch",) in [tuple(x) for x in rows]
+    # SHOW CATALOGS is filtered, not failed
+    shown = [x[0] for x in r.execute("show catalogs", user="jane").rows]
+    assert shown == ["tpch"]
+    with pytest.raises(AccessDeniedError):
+        r.execute("create table memory.default.t as select 1 a",
+                  user="jane")
+
+
+def test_ctas_insert_source_is_secured():
+    """INSERT INTO allowed-catalog SELECT FROM denied-catalog must fail:
+    the source query plans against the secured session too."""
+    r = LocalRunner(tpch_sf=0.001)
+    r.access_control = AccessControl({"catalogs": [
+        {"catalog": "memory", "allow": True},
+        {"allow": False}]})
+    with pytest.raises(AccessDeniedError):
+        r.execute("create table memory.default.steal as "
+                  "select * from tpch.default.nation", user="bob")
+
+
+def test_per_user_transactions():
+    """One user's BEGIN must not scope (or roll back) another user's
+    autocommit writes."""
+    r = LocalRunner(tpch_sf=0.001)
+    r.execute("start transaction", user="alice")
+    r.execute("create table memory.default.bobt as select 1 a",
+              user="bob")
+    r.execute("rollback", user="alice")
+    assert r.execute("select count(*) from memory.default.bobt",
+                     user="bob").rows == [(1,)]
+
+
+def test_server_basic_auth():
+    from presto_tpu.server.protocol import PrestoTpuServer
+    srv = PrestoTpuServer(
+        runner=LocalRunner(tpch_sf=0.001),
+        authenticator=PasswordAuthenticator({"alice": "pw"}))
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}/v1/statement"
+    req = urllib.request.Request(url, data=b"select 1", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 401
+    assert "Basic" in e.value.headers.get("WWW-Authenticate", "")
+    cred = base64.b64encode(b"alice:pw").decode()
+    req = urllib.request.Request(url, data=b"select 1", method="POST",
+                                 headers={"Authorization":
+                                          f"Basic {cred}"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        doc = json.loads(resp.read())
+    assert "nextUri" in doc
+    # every endpoint is guarded, not just POST
+    with pytest.raises(urllib.error.HTTPError) as e2:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/resourceGroup", timeout=10)
+    assert e2.value.code == 401
+    srv.stop()
+
+
+def test_query_completed_events():
+    r = LocalRunner(tpch_sf=0.001)
+    seen = []
+    r.events.register(seen.append)
+    r.execute("select count(*) from region", user="jane")
+    with pytest.raises(Exception):
+        r.execute("select nope from region")
+    assert len(seen) == 2
+    ok, bad = seen
+    assert ok.state == "FINISHED" and ok.user == "jane"
+    assert ok.elapsed_ms > 0 and "region" in ok.query
+    assert bad.state == "FAILED" and bad.error
+
+
+def test_broken_listener_does_not_break_queries():
+    r = LocalRunner(tpch_sf=0.001)
+    r.events.register(lambda e: 1 / 0)
+    assert r.execute("select 1").rows == [(1,)]

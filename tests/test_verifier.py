@@ -1,0 +1,39 @@
+"""Verifier: control-vs-test comparison harness (reference
+presto-verifier/.../Validator.java:68)."""
+from presto_tpu.exec.runner import LocalRunner
+from presto_tpu.verifier import Verifier
+
+
+def test_match_and_mismatch():
+    control = LocalRunner(tpch_sf=0.001)
+    same = LocalRunner(tpch_sf=0.001)
+    bigger = LocalRunner(tpch_sf=0.01)
+    v = Verifier(control, same)
+    results = v.run([
+        "select count(*) from nation",
+        "select n_regionkey, count(*) from nation group by 1 order by 1",
+        "select sum(l_extendedprice * l_discount) from lineitem",
+    ])
+    assert [r.status for r in results] == ["MATCH"] * 3
+    assert all(r.control_ms > 0 and r.test_ms > 0 for r in results)
+    # row-content mismatch (different scale factor)
+    bad = Verifier(control, bigger).verify_one(
+        "select count(*) from lineitem")
+    assert bad.status == "MISMATCH" and "row 0" in bad.detail
+    # order-insensitive: reversed ORDER BY still matches
+    v2 = Verifier(control, same)
+    a = v2.verify_one("select n_name from nation order by 1")
+    assert a.status == "MATCH"
+
+
+def test_failures_classified():
+    control = LocalRunner(tpch_sf=0.001)
+
+    class Broken:
+        def execute(self, sql):
+            raise RuntimeError("boom")
+
+    assert Verifier(Broken(), control).verify_one(
+        "select 1").status == "CONTROL_FAILED"
+    r = Verifier(control, Broken()).verify_one("select 1")
+    assert r.status == "TEST_FAILED" and "boom" in r.detail
