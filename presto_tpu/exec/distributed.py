@@ -234,8 +234,8 @@ class DistributedExecutor(_Executor):
         for a in node.aggs:
             if a.distinct:
                 raise NotImplementedError(
-                    "DISTINCT aggregates are not supported yet")
-        aggs = [AggSpec(a.fn, a.arg, a.output_type, a.name)
+                    "DISTINCT aggregates must be lowered by the planner")
+        aggs = [AggSpec(a.fn, a.arg, a.output_type, a.name, mask=a.mask)
                 for a in node.aggs]
         group = list(node.group_indices)
         if not group:
@@ -467,6 +467,26 @@ class DistributedExecutor(_Executor):
         fn = self._smap(
             lambda x: grouped_aggregate(x, cols, [], mode="single"), 1)
         yield fn(b)
+
+    def _MarkDistinctNode(self, node) -> Iterator[Batch]:
+        """Colocate rows by the distinct tuple, then flag shard-locally:
+        equal tuples land on one shard, so first-occurrence is global."""
+        import jax.numpy as jnp
+        from ..ops.aggregation import mark_distinct_flags
+        from .local import _plan_schema as plan_schema
+        b = self._drain(node.child)
+        if b is None:
+            return
+        b = self._repartitioner(list(node.cols))(b)
+        schema = plan_schema(node)
+
+        def local_mark(x: Batch) -> Batch:
+            flags = mark_distinct_flags(x, list(node.cols))
+            from ..batch import Column
+            from .. import types as T
+            col = Column(T.BOOLEAN, flags, x.row_mask, None)
+            return Batch(schema, list(x.columns) + [col], x.row_mask)
+        yield self._smap(local_mark, 1)(b)
 
     def _drain(self, node: PlanNode) -> Optional[Batch]:
         batches = list(self.run(node))

@@ -478,6 +478,19 @@ class _Executor:
         out = evaluate_window(b, list(node.partition_indices), keys, specs)
         yield Batch(_plan_schema(node), out.columns, out.row_mask)
 
+    def _MarkDistinctNode(self, node) -> Iterator[Batch]:
+        """Drain + sort-based first-occurrence flags (the window/sort
+        drain pattern; reference MarkDistinctOperator keeps a hash set
+        across pages instead)."""
+        from ..ops.aggregation import mark_distinct_flags
+        b = self._drain(node.child)
+        if b is None:
+            return
+        flags = mark_distinct_flags(b, list(node.cols))
+        mark_col = Column(T.BOOLEAN, flags, b.row_mask, None)
+        yield Batch(_plan_schema(node), list(b.columns) + [mark_col],
+                    b.row_mask)
+
     def _DistinctNode(self, node: DistinctNode) -> Iterator[Batch]:
         from .spill import AggSpillBuffer
         cols = list(range(len(node.fields)))
@@ -493,13 +506,13 @@ class _Executor:
 
     def _AggregationNode(self, node: AggregationNode) -> Iterator[Batch]:
         aggs = [
-            AggSpec(a.fn, a.arg, a.output_type, a.name)
+            AggSpec(a.fn, a.arg, a.output_type, a.name, mask=a.mask)
             for a in node.aggs
         ]
         for a in node.aggs:
             if a.distinct:
                 raise NotImplementedError(
-                    "DISTINCT aggregates are not supported yet")
+                    "DISTINCT aggregates must be lowered by the planner")
         group = list(node.group_indices)
         # fragment steps (reference plan/AggregationNode.Step): SINGLE
         # raw->rows; PARTIAL raw->states (shipped to an exchange); FINAL

@@ -43,6 +43,10 @@ class AggSpec:
     input: Optional[int]          # column index in the input batch
     output_type: Type
     name: str = ""                # output column name
+    # mask channel: rows where this boolean column is false don't feed
+    # this aggregate (reference AggregationNode.Aggregation mask — the
+    # MarkDistinct lowering of DISTINCT aggregates)
+    mask: Optional[int] = None
 
     def __post_init__(self):
         assert self.fn in _SUPPORTED, self.fn
@@ -73,6 +77,33 @@ class AggSpec:
             return self.output_type if not isinstance(self.output_type, T.DecimalType) \
                 else T.DecimalType(18, self.output_type.scale)
         return self.output_type
+
+
+def mark_distinct_flags(batch: Batch,
+                        cols: Sequence[int]) -> jnp.ndarray:
+    """True at the first live occurrence of each distinct tuple of
+    ``cols`` (reference operator/MarkDistinctOperator.java +
+    MarkDistinctHash — hash-set membership replaced by sort + boundary +
+    scatter-back, the branch-free device shape). Dead rows are False."""
+    ops: List[jnp.ndarray] = [
+        jnp.where(batch.row_mask, 0, 1).astype(jnp.int32)]
+    for ci in cols:
+        c = batch.columns[ci]
+        data = c.data
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+        ops.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))
+        ops.append(jnp.where(c.validity, data, jnp.zeros_like(data)))
+    idx = jnp.arange(batch.capacity, dtype=jnp.int64)
+    out = jax.lax.sort(ops + [idx], num_keys=len(ops), is_stable=True)
+    s_live = out[0] == 0
+    s_idx = out[-1]
+    diff = jnp.zeros_like(s_live)
+    for op in out[1:len(ops)]:
+        diff = diff | (op != jnp.roll(op, 1))
+    first = jnp.zeros_like(s_live).at[0].set(True)
+    boundary = s_live & (diff | first)
+    return jnp.zeros(batch.capacity, dtype=bool).at[s_idx].set(boundary)
 
 
 def _group_sort(batch: Batch, group_indices: Sequence[int]):
@@ -199,6 +230,8 @@ def _segment_aggs(
             continue
         data = col_data[agg.input]
         valid = col_valid[agg.input] & mask
+        if agg.mask is not None:
+            valid = valid & col_data[agg.mask].astype(bool)
         cnt = jax.ops.segment_sum(valid.astype(jnp.int64), group_id, num_segments=cap)
         if agg.fn == "count":
             results.append((cnt,))
@@ -487,6 +520,9 @@ def global_aggregate(
             else:
                 c = batch.columns[agg.input]
                 valid = c.validity & mask
+                if agg.mask is not None:
+                    valid = valid & \
+                        batch.columns[agg.mask].data.astype(bool)
                 cnt = jnp.sum(valid.astype(jnp.int64))
                 if agg.fn == "count":
                     parts = (cnt,)

@@ -227,6 +227,20 @@ class _Fragmenter:
         src = self.cut(partial, loc, OutputSpec("single"))
         return dataclasses.replace(node, child=src), "single"
 
+    def _MarkDistinctNode(self, node):
+        """First-occurrence flags need all rows of a group in one task:
+        partition by the group keys (or gather when there are none)."""
+        child, loc = self.visit(node.child)
+        if loc in ("single", "any"):
+            return dataclasses.replace(node, child=child), loc
+        if node.partition_cols:
+            src = self.cut(child, loc,
+                           OutputSpec("partition",
+                                      tuple(node.partition_cols)))
+            return dataclasses.replace(node, child=src), "fixed"
+        src = self.cut(child, loc, OutputSpec("single"))
+        return dataclasses.replace(node, child=src), "single"
+
     def _WindowNode(self, node: WindowNode):
         child, loc = self.visit(node.child)
         if loc in ("single", "any"):

@@ -599,6 +599,17 @@ def _prune(node: PlanNode, required: List[int]) -> Tuple[PlanNode, Dict[int, int
         return OutputNode(child=narrowed,
                           fields=tuple(node.fields[i] for i in req)), mapping
 
+    from .plan import MarkDistinctNode
+    if isinstance(node, MarkDistinctNode):
+        # mask channels read (keys, arg): keep all child columns live but
+        # recurse so the subtree below still prunes
+        child_req = list(range(len(node.child.fields)))
+        child, cmap = _prune(node.child, child_req)
+        child = _narrow(child, [cmap[i] for i in child_req],
+                        list(node.child.fields))
+        return (dataclasses.replace(node, child=child),
+                {i: i for i in range(len(node.fields))})
+
     from .plan import GroupIdNode
     if isinstance(node, GroupIdNode):
         # all child columns stay live (keys feed the grouping sets, the

@@ -113,6 +113,9 @@ class PlanAgg:
     output_type: T.Type
     name: str
     distinct: bool = False
+    # mask channel produced by MarkDistinctNode (reference
+    # AggregationNode.Aggregation mask symbol)
+    mask: Optional[int] = None
 
 
 @_one_child
@@ -269,6 +272,22 @@ class DistinctNode(PlanNode):
     def __post_init__(self):
         if not self.fields:
             object.__setattr__(self, "fields", self.child.fields)
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
+class MarkDistinctNode(PlanNode):
+    """Appends one boolean column that is true at the first occurrence
+    of each distinct tuple of ``cols`` (reference plan/MarkDistinctNode
+    + operator/MarkDistinctOperator.java) — the mask-channel lowering of
+    mixed DISTINCT aggregates. ``partition_cols`` (the group keys) tell
+    distributed executors how to colocate rows so first-occurrence is
+    global, not per-shard."""
+
+    child: PlanNode
+    cols: Tuple[int, ...]
+    partition_cols: Tuple[int, ...]
+    fields: Tuple[Field, ...]
 
 
 @_one_child

@@ -612,6 +612,13 @@ class _Planner:
                 uniq_aggs.append(call)
         for j, call in enumerate(uniq_aggs):
             fn = _FUNCTION_ALIASES.get(call.name, call.name)
+            distinct = call.distinct
+            if fn == "approx_distinct":
+                # exact distinct-count is a valid approximation; the
+                # reference trades exactness for fixed memory via HLL
+                # (operator/aggregation/ApproximateCountDistinct +
+                # state/HyperLogLogState.java)
+                fn, distinct = "count", True
             # ARBITRARY allows any live value; max picks one branch-free
             if fn in ("any_value", "arbitrary"):
                 fn = "max"
@@ -634,7 +641,7 @@ class _Planner:
             pre_fields.append(Field(f"_aggarg{j}", arg.type))
             out_t = _agg_output_type(fn, arg.type)
             aggs.append(PlanAgg(fn, arg_index, out_t, f"_agg{j}",
-                                distinct=call.distinct))
+                                distinct=distinct))
             agg_fields.append(Field(f"_agg{j}", out_t))
 
         pre = ProjectNode(child=node, exprs=tuple(pre_exprs),
@@ -646,28 +653,52 @@ class _Planner:
                 spec, pre, pre_fields, nk, aggs, agg_fields, group_exprs,
                 select_items, seen)
         if any(a.distinct for a in aggs):
-            # distinct rows of (keys, arg) first, then plain aggregation
-            # (reference iterative/rule/
-            # SingleDistinctAggregationToGroupBy.java)
             args = {a.arg for a in aggs}
-            if not all(a.distinct for a in aggs) or len(args) != 1 \
-                    or None in args:
-                raise AnalysisError(
-                    "mixed or multi-argument DISTINCT aggregates are not "
-                    "supported yet")
-            arg0 = aggs[0].arg
-            sel = list(range(nk)) + [arg0]
-            dproj = ProjectNode(
-                child=pre,
-                exprs=tuple(ir.input_ref(i, pre_fields[i].type)
-                            for i in sel),
-                fields=tuple(pre_fields[i] for i in sel))
-            dnode = DistinctNode(child=dproj)
-            aggs = [dataclasses.replace(a, arg=nk, distinct=False)
-                    for a in aggs]
-            agg_node = AggregationNode(
-                child=dnode, group_indices=tuple(range(nk)),
-                aggs=tuple(aggs), fields=out_fields)
+            if all(a.distinct for a in aggs) and len(args) == 1 \
+                    and None not in args:
+                # all-distinct, one argument: distinct rows of
+                # (keys, arg) first, then plain aggregation (reference
+                # iterative/rule/SingleDistinctAggregationToGroupBy.java)
+                arg0 = aggs[0].arg
+                sel = list(range(nk)) + [arg0]
+                dproj = ProjectNode(
+                    child=pre,
+                    exprs=tuple(ir.input_ref(i, pre_fields[i].type)
+                                for i in sel),
+                    fields=tuple(pre_fields[i] for i in sel))
+                dnode = DistinctNode(child=dproj)
+                aggs = [dataclasses.replace(a, arg=nk, distinct=False)
+                        for a in aggs]
+                agg_node = AggregationNode(
+                    child=dnode, group_indices=tuple(range(nk)),
+                    aggs=tuple(aggs), fields=out_fields)
+            else:
+                # mixed / multi-argument: one MarkDistinct mask channel
+                # per distinct argument (reference MarkDistinctNode +
+                # AggregationNode mask symbols via
+                # rule/MultipleDistinctAggregationToMarkDistinct.java)
+                from .plan import MarkDistinctNode
+                if any(a.distinct and a.arg is None for a in aggs):
+                    raise AnalysisError(
+                        "count(DISTINCT *) is not valid")
+                child: PlanNode = pre
+                fields = list(pre_fields)
+                mask_idx: Dict[int, int] = {}
+                for arg in sorted({a.arg for a in aggs if a.distinct}):
+                    mark = Field(f"$distinct{arg}", T.BOOLEAN)
+                    child = MarkDistinctNode(
+                        child=child,
+                        cols=tuple(range(nk)) + (arg,),
+                        partition_cols=tuple(range(nk)),
+                        fields=tuple(fields) + (mark,))
+                    mask_idx[arg] = len(fields)
+                    fields.append(mark)
+                aggs = [dataclasses.replace(a, distinct=False,
+                                            mask=mask_idx[a.arg])
+                        if a.distinct else a for a in aggs]
+                agg_node = AggregationNode(
+                    child=child, group_indices=tuple(range(nk)),
+                    aggs=tuple(aggs), fields=out_fields)
         else:
             agg_node = AggregationNode(
                 child=pre, group_indices=tuple(range(nk)),

@@ -75,6 +75,8 @@ BASICS = [
     "(select 1 from lineitem where l_orderkey = o_orderkey) "
     "group by 1 order by 1",
     "select stddev(l_quantity), var_pop(l_extendedprice) from lineitem",
+    "select o_orderstatus, count(distinct o_custkey) c, count(*) n "
+    "from orders group by 1 order by 1",
 ]
 
 

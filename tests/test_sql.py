@@ -159,6 +159,35 @@ def test_date_semantics(runner, oracle):
         "from orders group by 1 order by 1")
 
 
+def test_distinct_aggregates(runner, oracle):
+    """Single, mixed, and multi-argument DISTINCT aggregates (the
+    MarkDistinct mask-channel lowering)."""
+    compare(runner, oracle,
+            "select count(distinct o_custkey) from orders")
+    compare(runner, oracle,
+            "select o_orderstatus, count(distinct o_custkey) c, "
+            "count(*) n, sum(o_totalprice) s from orders "
+            "group by 1 order by 1")
+    compare(runner, oracle,
+            "select count(distinct l_suppkey), count(distinct l_partkey),"
+            " count(*) from lineitem")
+    compare(runner, oracle,
+            "select l_returnflag, sum(distinct l_quantity) sq, "
+            "avg(l_quantity) a from lineitem group by 1 order by 1")
+    compare(runner, oracle,
+            "select o_orderpriority, count(distinct o_orderstatus) "
+            "from orders group by 1 order by 1")
+
+
+def test_approx_distinct(runner, oracle):
+    """approx_distinct answers exactly (a valid approximation)."""
+    got = runner.execute(
+        "select approx_distinct(o_custkey) from orders").rows
+    want = oracle.execute(
+        "select count(distinct o_custkey) from orders").fetchall()
+    assert int(got[0][0]) == want[0][0]
+
+
 def test_variance_large_mean(runner, oracle):
     """Central-moment states must not cancel catastrophically: shifting
     the data by 1e15 must leave stddev (nearly) unchanged.  The naive
