@@ -305,6 +305,9 @@ class DistributedExecutor(_Executor):
             return
         residual = (self._resolve(node.residual)
                     if node.residual is not None else None)
+        # plain (unchecked) filter: it runs INSIDE the shard_map'd probe
+        # step, where a host-side error collector would leak tracers; a
+        # residual row error here degrades to dropped-row semantics
         residual_fn = (compile_filter(residual, _plan_schema(node))
                        if residual is not None else None)
         if residual_fn is not None and node.join_type == "left":
@@ -568,6 +571,8 @@ class DistributedRunner:
         ex = DistributedExecutor(self.session, self.rows_per_batch, self.mesh)
         run_init_plans(ex, plan)
         root = plan.root
-        rows = [r for b in ex.run(root.child) for r in b.to_pylist()]
+        batches = list(ex.run(root.child))
+        ex.check_errors()
+        rows = [r for b in batches for r in b.to_pylist()]
         return QueryResult(names=[f.name for f in root.fields],
                            types=[f.type for f in root.fields], rows=rows)

@@ -79,11 +79,13 @@ def execute_plan(plan: LogicalPlan, session: Session,
     rows: List[tuple] = []
     if collect_rows:
         out_batches = list(ex.run(root.child))
+        ex.check_errors()
         rows = [r for b in out_batches for r in b.to_pylist()]
     else:
         # EXPLAIN ANALYZE: drain for stats, skip row materialization
         for _ in ex.run(root.child):
             pass
+        ex.check_errors()
     return QueryResult(names=[f.name for f in root.fields],
                        types=[f.type for f in root.fields], rows=rows)
 
@@ -130,7 +132,7 @@ def _apply_dynamic_bounds(probe: Batch,
 
 
 def mark_exists_mask(probe: Batch, build: Batch, probe_keys, build_keys,
-                     residual, negated: bool, max_matches: int):
+                     residual, negated: bool, max_matches: int, ex=None):
     """Correlated-EXISTS mark: probe row passes iff ANY build row with
     equal keys satisfies the residual predicate (over probe fields +
     build fields). The decorrelated mark-join shape of reference
@@ -152,8 +154,11 @@ def mark_exists_mask(probe: Batch, build: Batch, probe_keys, build_keys,
     n_src = len(probe.columns)
     shift = {i: (i if i < n_src else i + 1)
              for i in referenced_inputs(residual)}
-    filt = compile_filter(remap_inputs(residual, shift), expanded.schema)
-    kept = filt(expanded)
+    filt = compile_filter(remap_inputs(residual, shift), expanded.schema,
+                          errors=True)
+    kept, err = filt(expanded)
+    if err is not None and ex is not None:
+        ex.error_flags.append(err)
     return semi_join_mask(probe2, kept, [n_src], [n_src],
                           negated=negated, null_aware=False)
 
@@ -165,6 +170,9 @@ class _Executor:
         self.rows_per_batch = rows_per_batch
         self.init_values: List[object] = []
         self.stats = stats
+        # device int32 scalars from error-checking kernels; reduced to one
+        # host sync by check_errors() after the plan drains
+        self.error_flags: List = []
         self._shared: set = set()
         self._ever_shared: set = set()
         self._materialized: Dict[PlanNode, List[Batch]] = {}
@@ -176,6 +184,33 @@ class _Executor:
         self.spill_partitions = int(
             session.properties.get("spill_partitions", 16))
         session.last_memory_stats = self.pool.stats
+
+    def checked_filter(self, pred: ir.Expr, schema: Schema):
+        """Compiled filter that feeds row errors into this query's
+        error_flags (for predicates applied outside Filter nodes, e.g.
+        join ON residuals)."""
+        fn = compile_filter(pred, schema, errors=True)
+
+        def run(b: Batch) -> Batch:
+            out, err = fn(b)
+            if err is not None:
+                self.error_flags.append(err)
+            return out
+        return run
+
+    def check_errors(self) -> None:
+        """Raise the highest-coded row error seen by any kernel this query
+        (one host sync over all collected device scalars)."""
+        if not self.error_flags:
+            return
+        import numpy as np
+
+        from ..errors import QueryError
+        codes = np.asarray(jnp.stack(self.error_flags))
+        self.error_flags = []
+        code = int(codes.max())
+        if code:
+            raise QueryError(code)
 
     def mark_shared(self, roots: Sequence[PlanNode]) -> None:
         """Pre-scan for structurally repeated subplans (e.g. the shared
@@ -385,17 +420,23 @@ class _Executor:
 
     def _FilterNode(self, node: FilterNode) -> Iterator[Batch]:
         pred = self._resolve(node.predicate)
-        fn = compile_filter(pred, _plan_schema(node.child))
+        fn = compile_filter(pred, _plan_schema(node.child), errors=True)
         compact = self._compactor()
         for b in self.run(node.child):
-            yield compact(fn(b))
+            out, err = fn(b)
+            if err is not None:
+                self.error_flags.append(err)
+            yield compact(out)
 
     def _ProjectNode(self, node: ProjectNode) -> Iterator[Batch]:
         exprs = [self._resolve(e) for e in node.exprs]
         fn = compile_projection(exprs, [f.name for f in node.fields],
-                                _plan_schema(node.child))
+                                _plan_schema(node.child), errors=True)
         for b in self.run(node.child):
-            yield fn(b)
+            out, err = fn(b)
+            if err is not None:
+                self.error_flags.append(err)
+            yield out
 
     def _LimitNode(self, node: LimitNode) -> Iterator[Batch]:
         remaining = node.count
@@ -576,7 +617,7 @@ class _Executor:
                 # (correct for inner; left-join residuals are rare)
                 raise NotImplementedError(
                     "residual predicate on LEFT JOIN")
-            residual_fn = compile_filter(residual, _plan_schema(node))
+            residual_fn = self.checked_filter(residual, _plan_schema(node))
 
         from .spill import HostPartitionStore, SpillableBuildBuffer
         buf = SpillableBuildBuffer(self.pool, "join-build",
@@ -748,5 +789,5 @@ class _Executor:
                 maxk = int(match_count_max(b, build, skeys, fkeys))
                 mask = mark_exists_mask(
                     b, build, skeys, fkeys, node.residual, node.negated,
-                    bucket_capacity(max(maxk, 1), minimum=1))
+                    bucket_capacity(max(maxk, 1), minimum=1), ex=self)
             yield Batch(b.schema, b.columns, mask)

@@ -208,7 +208,12 @@ class LocalRunner:
         run_init_plans(ex, plan)
         root = plan.root
         schema = Schema([(f.name, f.type) for f in root.fields])
-        return schema, ex.run(root.child)
+        # drain and error-check BEFORE the caller appends to the target:
+        # a failing INSERT ... SELECT must not persist partial rows
+        # (reference TableFinishOperator commits only on success)
+        out = list(ex.run(root.child))
+        ex.check_errors()
+        return schema, iter(out)
 
     def _ctas(self, stmt: A.CreateTableAsSelect, session=None,
               user: str = "") -> QueryResult:

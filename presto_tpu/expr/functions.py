@@ -8,8 +8,13 @@ codes with host-side vocabulary precomputation at trace time — the vocab is
 static under jit, so LIKE/substr/comparison tables bake into the compiled
 kernel as constants (the TPU answer to Presto's per-invocation Joni regex).
 
-Division/modulus by zero currently yields NULL rather than a query error;
-device-side error flags are TODO (Presto raises DIVISION_BY_ZERO).
+Error semantics (reference spi/StandardErrorCode.java): kernels record a
+per-row int32 error code on the Val (``err``; 0/None = ok) instead of
+raising — integer/decimal division by zero sets DIVISION_BY_ZERO exactly
+like Presto's BigintOperators.divide, while double division follows IEEE
+(Infinity/NaN, no error) like DoubleOperators. The compiler propagates the
+codes with branch masking (IF/CASE/AND-OR short circuits) and the executor
+raises QueryError after the batch is produced; TRY() clears them to NULL.
 """
 from __future__ import annotations
 
@@ -23,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
+from .. import errors as E
 from ..types import Type
 
 
@@ -38,6 +44,8 @@ class Val:
     #: lets string/positional args (substr offsets, LIKE patterns) stay
     #: static under jit, like constant folding in the reference codegen
     literal: Optional[object] = None
+    #: per-row int32 error code (0 = ok); None = statically error-free
+    err: Optional[jnp.ndarray] = None
 
     @staticmethod
     def constant(value, typ: Type, n: int) -> "Val":
@@ -66,6 +74,21 @@ def _all_valid(args: Sequence[Val]) -> jnp.ndarray:
     for a in args[1:]:
         v = v & a.valid
     return v
+
+
+def merge_err(*errs: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+    """Combine per-row error codes; the max code wins on a row."""
+    present = [e for e in errs if e is not None]
+    if not present:
+        return None
+    out = present[0]
+    for e in present[1:]:
+        out = jnp.maximum(out, e)
+    return out
+
+
+def flag_err(cond: jnp.ndarray, code: int) -> jnp.ndarray:
+    return jnp.where(cond, jnp.int32(code), jnp.int32(0))
 
 
 # -- decimal helpers ---------------------------------------------------------
@@ -294,13 +317,17 @@ def _arith(op):
                 den = jnp.where(db == 0, 1, db)
                 q = num / den
                 data = (jnp.sign(q) * jnp.floor(jnp.abs(num) / jnp.abs(den) + 0.5)).astype(jnp.int64)
+                err = flag_err(valid & (db == 0), E.DIVISION_BY_ZERO)
                 valid = valid & (db != 0)
+                return Val(data, valid, out, err=err)
             elif op == "mod":
                 sc = max(sa, sb)
                 da2, db2 = rescale_decimal(da, sa, sc), rescale_decimal(db, sb, sc)
                 den = jnp.where(db2 == 0, 1, db2)
                 data = jnp.sign(da2) * (jnp.abs(da2) % jnp.abs(den))
+                err = flag_err(valid & (db2 == 0), E.DIVISION_BY_ZERO)
                 valid = valid & (db2 != 0)
+                return Val(data, valid, out, err=err)
             else:
                 sc = s_out
                 da2, db2 = rescale_decimal(da, sa, sc), rescale_decimal(db, sb, sc)
@@ -319,20 +346,22 @@ def _arith(op):
                 den = jnp.where(db == 0, 1, db)
                 # SQL integer division truncates toward zero
                 data = (jnp.sign(da) * jnp.sign(den)) * (jnp.abs(da) // jnp.abs(den))
+                err = flag_err(valid & (db == 0), E.DIVISION_BY_ZERO)
                 valid = valid & (db != 0)
-            else:
-                den = jnp.where(db == 0.0, 1.0, db)
-                data = da / den
-                valid = valid & (db != 0.0)
+                return Val(data, valid, out, err=err)
+            # double/real: IEEE semantics like Java (DoubleOperators.divide):
+            # x/0 = ±Infinity, 0/0 = NaN — no error, no NULL
+            data = da / db
         elif op == "mod":
             if T.is_integral(out):
                 den = jnp.where(db == 0, 1, db)
                 data = jnp.sign(da) * (jnp.abs(da) % jnp.abs(den))
+                err = flag_err(valid & (db == 0), E.DIVISION_BY_ZERO)
                 valid = valid & (db != 0)
-            else:
-                den = jnp.where(db == 0.0, 1.0, db)
-                data = jnp.sign(da) * (jnp.abs(da) % jnp.abs(den))
-                valid = valid & (db != 0.0)
+                return Val(data, valid, out, err=err)
+            # double % 0 = NaN (Java remainder semantics)
+            den = jnp.where(db == 0.0, jnp.nan, db)
+            data = jnp.sign(da) * (jnp.abs(da) % jnp.abs(den))
         else:
             raise AssertionError(op)
         return Val(data, valid, out)

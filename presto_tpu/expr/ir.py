@@ -31,6 +31,7 @@ class Form(enum.Enum):
     BETWEEN = "between"      # BETWEEN(v, lo, hi)
     NULL_IF = "null_if"
     SWITCH = "switch"        # SWITCH(cond1, val1, cond2, val2, ..., default)
+    TRY = "try"              # TRY(expr): row-level errors become NULL
 
 
 @dataclasses.dataclass(frozen=True)

@@ -269,6 +269,7 @@ class Task:
                     self.buffer.add_broadcast(serialize_page(batch))
                 else:   # single
                     self.buffer.add(0, serialize_page(batch))
+            ex.check_errors()
             self.buffer.finish()
             self.state = "FINISHED"
         except Exception as e:   # noqa: BLE001 - reported to coordinator

@@ -377,6 +377,26 @@ class ExpressionAnalyzer:
 
     def _FunctionCall(self, node: A.FunctionCall) -> ir.Expr:
         name = _FUNCTION_ALIASES.get(node.name, node.name)
+        if name == "try":
+            # TRY(expr): row-level evaluation errors become NULL
+            # (reference operator/scalar/TryFunction.java)
+            if len(node.args) != 1:
+                raise AnalysisError("try() takes exactly one argument")
+            arg = self.analyze(node.args[0])
+            return ir.special(ir.Form.TRY, arg.type, arg)
+        if name == "if":
+            # IF(cond, then [, else]) function spelling of CASE
+            if len(node.args) not in (2, 3):
+                raise AnalysisError("if() takes two or three arguments")
+            cond = self._to_bool(self.analyze(node.args[0]))
+            then = self.analyze(node.args[1])
+            els = (self.analyze(node.args[2]) if len(node.args) == 3
+                   else ir.lit(None, then.type))
+            out_t = T.common_super_type(then.type, els.type)
+            if out_t is None:
+                raise AnalysisError("IF branches have incompatible types")
+            return ir.special(ir.Form.IF, out_t, cond,
+                              coerce(then, out_t), coerce(els, out_t))
         if name in AGGREGATE_FUNCTIONS:
             raise AnalysisError(
                 f"aggregate function {name}() in scalar context (missing "
