@@ -14,6 +14,7 @@ AggregationAnalyzer.java + planner/QueryPlanner.java split).
 from __future__ import annotations
 
 import dataclasses
+import math
 from decimal import Decimal
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -45,6 +46,16 @@ _FUNCTION_ALIASES = {
     "ceiling": "ceil", "char_length": "length",
     "stddev": "stddev_samp", "variance": "var_samp",
     "var": "var_samp", "every": "bool_and",
+    "dow": "day_of_week", "doy": "day_of_year",
+    "week_of_year": "week", "yow": "year_of_week",
+}
+
+#: zero-argument functions folded to literals at analysis time
+_NILADIC = {
+    "pi": (math.pi, T.DOUBLE),
+    "e": (math.e, T.DOUBLE),
+    "nan": (float("nan"), T.DOUBLE),
+    "infinity": (float("inf"), T.DOUBLE),
 }
 
 _ARITH_OPS = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
@@ -321,7 +332,11 @@ class ExpressionAnalyzer:
     def _Extract(self, node: A.Extract) -> ir.Expr:
         v = self.analyze(node.value)
         field = node.field.lower()
-        if field not in ("year", "month", "day", "quarter"):
+        field = {"dow": "day_of_week", "doy": "day_of_year",
+                 "yow": "year_of_week"}.get(field, field)
+        if field not in ("year", "month", "day", "quarter", "day_of_week",
+                         "day_of_year", "week", "year_of_week", "hour",
+                         "minute", "second", "millisecond"):
             raise AnalysisError(f"EXTRACT({field}) not supported")
         return ir.call(field, T.BIGINT, v)
 
@@ -377,6 +392,16 @@ class ExpressionAnalyzer:
 
     def _FunctionCall(self, node: A.FunctionCall) -> ir.Expr:
         name = _FUNCTION_ALIASES.get(node.name, node.name)
+        if name in _NILADIC and not node.args:
+            value, typ = _NILADIC[name]
+            return ir.lit(value, typ)
+        if name == "parse_timestamp_literal":
+            # TIMESTAMP '...' — folded to a literal here
+            s = node.args[0]
+            if not isinstance(s, A.StringLiteral):
+                raise AnalysisError("TIMESTAMP literal must be a string")
+            T.TIMESTAMP.to_storage(s.value)    # validate now
+            return ir.lit(s.value, T.TIMESTAMP)
         if name == "try":
             # TRY(expr): row-level evaluation errors become NULL
             # (reference operator/scalar/TryFunction.java)

@@ -229,6 +229,27 @@ class TimestampType(Type):
     def storage_dtype(self):
         return jnp.int64
 
+    def to_storage(self, value: Any) -> int:
+        import datetime
+
+        if isinstance(value, (int, np.integer)):
+            return int(value)
+        if isinstance(value, str):
+            s = value.strip().replace("T", " ")
+            value = datetime.datetime.fromisoformat(s)
+        if isinstance(value, datetime.datetime):
+            epoch = datetime.datetime(1970, 1, 1)
+            return round((value - epoch).total_seconds() * 1_000_000)
+        if isinstance(value, datetime.date):
+            return (value - datetime.date(1970, 1, 1)).days * 86_400_000_000
+        raise TypeError(f"cannot convert {value!r} to timestamp")
+
+    def from_storage(self, value: Any):
+        import datetime
+
+        return (datetime.datetime(1970, 1, 1)
+                + datetime.timedelta(microseconds=int(value)))
+
 
 @dataclasses.dataclass(frozen=True)
 class VarcharType(Type):
