@@ -235,9 +235,32 @@ class DistributedExecutor(_Executor):
             if a.distinct:
                 raise NotImplementedError(
                     "DISTINCT aggregates must be lowered by the planner")
-        aggs = [AggSpec(a.fn, a.arg, a.output_type, a.name, mask=a.mask)
+        aggs = [AggSpec(a.fn, a.arg, a.output_type, a.name, mask=a.mask,
+                        param=a.param)
                 for a in node.aggs]
         group = list(node.group_indices)
+        from ..ops.aggregation import has_drain_agg
+        if has_drain_agg(aggs):
+            # approx_percentile: colocate each group's raw rows via hash
+            # exchange, then one exact segmented-sort pass per shard (no
+            # mergeable state exists — the window-node pattern)
+            b = self._drain(node.child)
+            if b is None:
+                if group:
+                    return
+                b = self._pad_shardable(Batch.from_arrays(
+                    _plan_schema(node.child),
+                    [[] for _ in node.child.fields], num_rows=0))
+            if group:
+                b = self._repartitioner(group)(b)
+                fn = self._smap(
+                    lambda x: grouped_aggregate(x, group, aggs,
+                                                mode="single"), 1)
+                yield fn(b)
+            else:
+                yield self._pad_shardable(
+                    global_aggregate(_to_host(b), aggs, mode="single"))
+            return
         if not group:
             yield self._global_agg(node, aggs)
             return

@@ -547,7 +547,8 @@ class _Executor:
 
     def _AggregationNode(self, node: AggregationNode) -> Iterator[Batch]:
         aggs = [
-            AggSpec(a.fn, a.arg, a.output_type, a.name, mask=a.mask)
+            AggSpec(a.fn, a.arg, a.output_type, a.name, mask=a.mask,
+                    param=a.param)
             for a in node.aggs
         ]
         for a in node.aggs:
@@ -555,6 +556,23 @@ class _Executor:
                 raise NotImplementedError(
                     "DISTINCT aggregates must be lowered by the planner")
         group = list(node.group_indices)
+        from ..ops.aggregation import has_drain_agg
+        if has_drain_agg(aggs):
+            # approx_percentile: no mergeable state — drain the input and
+            # evaluate in one segmented-sort pass (the sort-based engine's
+            # answer to the reference's QuantileDigest sketch state)
+            b = self._drain(node.child)
+            if b is None:
+                if group:
+                    return
+                b = Batch.from_arrays(
+                    _plan_schema(node.child),
+                    [[] for _ in node.child.fields], num_rows=0)
+            if group:
+                yield grouped_aggregate(b, group, aggs, mode="single")
+            else:
+                yield global_aggregate(b, aggs, mode="single")
+            return
         # fragment steps (reference plan/AggregationNode.Step): SINGLE
         # raw->rows; PARTIAL raw->states (shipped to an exchange); FINAL
         # states->rows.  step never changes the kernels, only which side

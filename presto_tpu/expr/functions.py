@@ -745,9 +745,55 @@ register("rpad")(_vocab_transform(
     else (s + (pad * n)[: n - len(s)] if pad else s)))
 register("ltrim")(_vocab_transform(lambda s: s.lstrip()))
 register("rtrim")(_vocab_transform(lambda s: s.rstrip()))
-register("split_part")(_vocab_transform(
-    lambda s, delim, idx: (s.split(delim)[idx - 1]
-                           if delim and idx - 1 < len(s.split(delim)) else "")))
+def _vocab_transform_nullable(fn):
+    """Like _vocab_transform but fn may return None (SQL NULL): the null
+    slots clear validity and the output vocab is deduplicated so equal
+    strings share one code (required by code-comparing joins/grouping)."""
+    def impl(args, out):
+        a = args[0]
+        if a.dictionary is None:
+            raise NotImplementedError("string fn on non-dictionary column")
+        extra = []
+        for x in args[1:]:
+            lit = _string_literal_of(x) if x.type.is_string else x.literal
+            if lit is None:
+                raise NotImplementedError(
+                    "string function positional args must be constants")
+            extra.append(lit)
+        entries = [fn(s, *extra) for s in a.dictionary]
+        lookup: dict = {}
+        vocab: list = []
+        remap = np.empty(len(entries) + 1, dtype=np.int32)
+        for i, s in enumerate(entries):
+            if s is None:
+                remap[i] = -1
+                continue
+            code = lookup.get(s)
+            if code is None:
+                code = lookup[s] = len(vocab)
+                vocab.append(s)
+            remap[i] = code
+        remap[-1] = -1
+        codes = _code_gather(jnp.asarray(remap), a.data)
+        return Val(codes, a.valid & (codes >= 0), out,
+                   dictionary=tuple(vocab))
+    return impl
+
+
+def _split_part(s: str, delim: str, idx: int) -> Optional[str]:
+    if idx <= 0:
+        # constant index: raised at trace time like Presto's
+        # INVALID_FUNCTION_ARGUMENT for non-positive indexes
+        from ..errors import INVALID_FUNCTION_ARGUMENT, QueryError
+        raise QueryError(INVALID_FUNCTION_ARGUMENT,
+                         "split_part index must be greater than zero")
+    if not delim:
+        return s if idx == 1 else None
+    parts = s.split(delim)
+    return parts[idx - 1] if idx <= len(parts) else None
+
+
+register("split_part")(_vocab_transform_nullable(_split_part))
 
 
 def _vocab_int_fn(fn):
@@ -805,41 +851,6 @@ def _vocab_bool_fn(fn):
 
 
 register("starts_with")(_vocab_bool_fn(lambda s, p: s.startswith(p)))
-
-
-def _vocab_transform_nullable(fn):
-    """Like _vocab_transform but fn may return None (SQL NULL): the null
-    slots clear validity and the output vocab is deduplicated so equal
-    strings share one code (required by code-comparing joins/grouping)."""
-    def impl(args, out):
-        a = args[0]
-        if a.dictionary is None:
-            raise NotImplementedError("string fn on non-dictionary column")
-        extra = []
-        for x in args[1:]:
-            lit = _string_literal_of(x) if x.type.is_string else x.literal
-            if lit is None:
-                raise NotImplementedError(
-                    "string function positional args must be constants")
-            extra.append(lit)
-        entries = [fn(s, *extra) for s in a.dictionary]
-        lookup: dict = {}
-        vocab: list = []
-        remap = np.empty(len(entries) + 1, dtype=np.int32)
-        for i, s in enumerate(entries):
-            if s is None:
-                remap[i] = -1
-                continue
-            code = lookup.get(s)
-            if code is None:
-                code = lookup[s] = len(vocab)
-                vocab.append(s)
-            remap[i] = code
-        remap[-1] = -1
-        codes = _code_gather(jnp.asarray(remap), a.data)
-        return Val(codes, a.valid & (codes >= 0), out,
-                   dictionary=tuple(vocab))
-    return impl
 
 
 def _presto_replacement(repl: str) -> str:

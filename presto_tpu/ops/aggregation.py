@@ -32,7 +32,17 @@ _VARIANCE_FNS = ("var_samp", "var_pop", "stddev_samp",
                  "stddev_pop")
 _SUPPORTED = ("sum", "count", "count_star", "min", "max", "avg",
               "var_samp", "var_pop", "stddev_samp", "stddev_pop",
-              "bool_and", "bool_or")
+              "bool_and", "bool_or", "approx_percentile")
+#: aggregates with no mergeable fixed-size state: the executor drains the
+#: input and evaluates in one 'single'-mode pass (reference computes these
+#: with QuantileDigest sketches — state/DigestAndPercentileState.java; the
+#: TPU engine is sort-based, so an exact segmented-sort select is both
+#: cheaper and within the sketch's error bound by definition)
+DRAIN_FNS = ("approx_percentile",)
+
+
+def has_drain_agg(aggs) -> bool:
+    return any(a.fn in DRAIN_FNS for a in aggs)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,6 +57,8 @@ class AggSpec:
     # this aggregate (reference AggregationNode.Aggregation mask — the
     # MarkDistinct lowering of DISTINCT aggregates)
     mask: Optional[int] = None
+    # static scalar parameter (approx_percentile's p)
+    param: Optional[float] = None
 
     def __post_init__(self):
         assert self.fn in _SUPPORTED, self.fn
@@ -54,6 +66,9 @@ class AggSpec:
     # state layout produced by partial mode / consumed by final mode
     def state_types(self) -> List[Tuple[str, Type]]:
         base = self.name or self.fn
+        if self.fn in DRAIN_FNS:
+            raise NotImplementedError(
+                f"{self.fn} has no mergeable partial state (drain-only)")
         if self.fn in ("count", "count_star"):
             return [(f"{base}$cnt", T.BIGINT)]
         if self.fn == "avg":
@@ -106,12 +121,12 @@ def mark_distinct_flags(batch: Batch,
     return jnp.zeros(batch.capacity, dtype=bool).at[s_idx].set(boundary)
 
 
-def _group_sort(batch: Batch, group_indices: Sequence[int]):
-    """Sort rows by group keys; return (key_operands, permuted batch arrays).
-
-    Returns (sorted_cols, sorted_validity, sorted_mask, boundary, group_id,
-    num_groups) where boundary marks the first live row of each group.
-    """
+def _group_key_ops(batch: Batch,
+                   group_indices: Sequence[int]) -> List[jnp.ndarray]:
+    """Lexicographic sort operands for GROUP BY keys: [dead_rank, then per
+    key (null_rank, null-neutralized data)]. Shared by every kernel whose
+    output rows must align positionally across separate sorts of the same
+    batch (grouped_aggregate and the percentile drain)."""
     dead_rank = jnp.where(batch.row_mask, 0, 1).astype(jnp.int32)
     key_ops: List[jnp.ndarray] = [dead_rank]
     for gi in group_indices:
@@ -122,6 +137,28 @@ def _group_sort(batch: Batch, group_indices: Sequence[int]):
         key_ops.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))  # nulls last
         # neutralize NULL rows' data so stale values can't split NULL groups
         key_ops.append(jnp.where(c.validity, data, jnp.zeros_like(data)))
+    return key_ops
+
+
+def _boundary_groups(s_keys, s_mask):
+    """Boundary/group-id/start-index machinery over sorted key operands."""
+    diff = jnp.zeros_like(s_mask)
+    for op in s_keys:
+        diff = diff | (op != jnp.roll(op, 1))
+    first = jnp.zeros_like(s_mask).at[0].set(True)
+    boundary = s_mask & (diff | first)
+    group_id = jnp.maximum(jnp.cumsum(boundary.astype(jnp.int64)) - 1, 0)
+    num_groups = jnp.sum(boundary.astype(jnp.int64))
+    return boundary, group_id, num_groups
+
+
+def _group_sort(batch: Batch, group_indices: Sequence[int]):
+    """Sort rows by group keys; return (key_operands, permuted batch arrays).
+
+    Returns (sorted_cols, sorted_validity, sorted_mask, boundary, group_id,
+    num_groups) where boundary marks the first live row of each group.
+    """
+    key_ops = _group_key_ops(batch, group_indices)
     payload: List[jnp.ndarray] = [batch.row_mask]
     for c in batch.columns:
         payload.append(c.data)
@@ -131,17 +168,7 @@ def _group_sort(batch: Batch, group_indices: Sequence[int]):
     s_mask = out[len(key_ops)]
     s_data = out[len(key_ops) + 1::2]
     s_valid = out[len(key_ops) + 2::2]
-
-    # boundary: live row whose keys differ from the previous row (or row 0)
-    diff = jnp.zeros_like(s_mask)
-    for op in s_keys:
-        prev = jnp.roll(op, 1)
-        diff = diff | (op != prev)
-    first = jnp.zeros_like(s_mask).at[0].set(True)
-    boundary = s_mask & (diff | first)
-    group_id = jnp.cumsum(boundary.astype(jnp.int64)) - 1
-    group_id = jnp.maximum(group_id, 0)
-    num_groups = jnp.sum(boundary.astype(jnp.int64))
+    boundary, group_id, num_groups = _boundary_groups(s_keys, s_mask)
     return s_data, s_valid, s_mask, boundary, group_id, num_groups
 
 
@@ -367,6 +394,133 @@ def _finalize(agg: AggSpec, parts: Tuple[jnp.ndarray, ...]) -> Tuple[jnp.ndarray
     return out, valid
 
 
+def _percentile_input(batch: Batch, input_idx: int, mask_idx):
+    """(valid, sort_value, unrank) for a percentile input column: dictionary
+    codes map through lexicographic ranks so value order is string order
+    (codes are appearance-ordered); unrank maps the winner back to a code."""
+    c = batch.columns[input_idx]
+    valid = c.validity & batch.row_mask
+    if mask_idx is not None:
+        valid = valid & batch.columns[mask_idx].data.astype(bool)
+    vdata = c.data
+    unrank = None
+    if c.dictionary is not None:
+        from .sort import rank_codes, unrank_table
+        vdata = rank_codes(vdata, c.dictionary).astype(jnp.int64)
+        unrank = unrank_table(c.dictionary)
+    elif vdata.dtype == jnp.bool_:
+        vdata = vdata.astype(jnp.int32)
+    return valid, vdata, unrank
+
+
+def _select_ks(aggs: Sequence[AggSpec], nvalid: jnp.ndarray):
+    """Per-agg nearest-rank index (0-based) within the valid run."""
+    ks = []
+    for agg in aggs:
+        p = float(agg.param if agg.param is not None else 0.5)
+        ks.append(jnp.clip(jnp.ceil(p * nvalid).astype(jnp.int64) - 1, 0,
+                           jnp.maximum(nvalid - 1, 0)))
+    return ks
+
+
+def _grouped_percentiles(batch: Batch, group_indices: Sequence[int],
+                         aggs: Sequence[AggSpec], cap: int):
+    """Nearest-rank percentiles per group for aggregates sharing one
+    (input, mask): ONE segmented sort by (group keys, value), k selections.
+    Valid values sort first within each group, so the k-th smallest valid
+    value sits at (group start + k). Group order comes from the shared
+    _group_key_ops operands, so outputs align positionally with
+    grouped_aggregate's rows."""
+    valid, vdata, unrank = _percentile_input(batch, aggs[0].input,
+                                             aggs[0].mask)
+    key_ops = _group_key_ops(batch, group_indices)
+    val_null = jnp.where(valid, 0, 1).astype(jnp.int32)
+    vneutral = jnp.where(valid, vdata, jnp.zeros_like(vdata))
+    out = jax.lax.sort(key_ops + [val_null, vneutral],
+                       num_keys=len(key_ops) + 2, is_stable=False)
+    s_live = out[0] == 0
+    s_keys = out[1:len(key_ops)]
+    s_vnull, s_vals = out[-2], out[-1]
+    boundary, group_id, num_groups = _boundary_groups(s_keys, s_live)
+    nvalid = jax.ops.segment_sum(
+        (s_live & (s_vnull == 0)).astype(jnp.int64), group_id,
+        num_segments=cap)
+    bidx = jnp.nonzero(boundary, size=cap, fill_value=batch.capacity - 1)[0]
+    out_mask = jnp.arange(cap) < num_groups
+    results = []
+    for k in _select_ks(aggs, nvalid):
+        sel = jnp.clip(bidx + k, 0, batch.capacity - 1)
+        data = jnp.take(s_vals, sel, axis=0)
+        if unrank is not None:
+            data = jnp.take(unrank, jnp.clip(data, 0, unrank.shape[0] - 1),
+                            axis=0)
+        results.append((data, (nvalid > 0) & out_mask))
+    return results
+
+
+def _global_percentiles(batch: Batch, aggs: Sequence[AggSpec]):
+    """Single-group nearest-rank percentiles (one sort, k selections)."""
+    valid, vdata, unrank = _percentile_input(batch, aggs[0].input,
+                                             aggs[0].mask)
+    val_null = jnp.where(valid, 0, 1).astype(jnp.int32)
+    vneutral = jnp.where(valid, vdata, jnp.zeros_like(vdata))
+    _, s_vals = jax.lax.sort([val_null, vneutral], num_keys=2,
+                             is_stable=False)
+    n = jnp.sum(valid.astype(jnp.int64))
+    results = []
+    for k in _select_ks(aggs, n):
+        data = jnp.take(s_vals, k)
+        if unrank is not None:
+            data = jnp.take(unrank, jnp.clip(data, 0, unrank.shape[0] - 1))
+        results.append((data, n > 0))
+    return results
+
+
+def _drain_groups(aggs):
+    """Drain aggs grouped by shared (input, mask) -> one sort per group."""
+    groups: dict = {}
+    for agg in aggs:
+        if agg.fn in DRAIN_FNS:
+            groups.setdefault((agg.input, agg.mask), []).append(agg)
+    return groups
+
+
+def _with_drain_aggs(batch: Batch, group_indices, aggs, mode,
+                     output_capacity) -> Batch:
+    """grouped_aggregate with approx_percentile columns spliced in."""
+    if mode != "single":
+        raise NotImplementedError(
+            "approx_percentile requires single-step aggregation "
+            "(the planner routes such plans through a drain)")
+    cap = output_capacity or batch.capacity
+    regular = [a for a in aggs if a.fn not in DRAIN_FNS]
+    base = grouped_aggregate(batch, group_indices, regular, "single",
+                             output_capacity)
+    computed = {}
+    for shared in _drain_groups(aggs).values():
+        for agg, res in zip(shared, _grouped_percentiles(
+                batch, group_indices, shared, cap)):
+            computed[id(agg)] = res
+    nk = len(group_indices)
+    out_cols = list(base.columns[:nk])
+    out_fields = list(zip(base.schema.names[:nk], base.schema.types[:nk]))
+    ri = nk
+    for agg in aggs:
+        if agg.fn in DRAIN_FNS:
+            data, valid = computed[id(agg)]
+            out_fields.append((agg.name or agg.fn, agg.output_type))
+            out_cols.append(Column(
+                agg.output_type,
+                data.astype(agg.output_type.storage_dtype), valid,
+                batch.columns[agg.input].dictionary
+                if agg.output_type.is_string else None))
+        else:
+            out_cols.append(base.columns[ri])
+            out_fields.append((base.schema.names[ri], base.schema.types[ri]))
+            ri += 1
+    return Batch(Schema(out_fields), out_cols, base.row_mask)
+
+
 def grouped_aggregate(
     batch: Batch,
     group_indices: Sequence[int],
@@ -383,6 +537,9 @@ def grouped_aggregate(
     (Presto's intermediate combine step), enabling hierarchical merging.
     """
     assert mode in ("single", "partial", "final", "merge")
+    if has_drain_agg(aggs):
+        return _with_drain_aggs(batch, group_indices, aggs, mode,
+                                output_capacity)
     cap = output_capacity or batch.capacity
     s_data, s_valid, s_mask, boundary, group_id, num_groups = _group_sort(
         batch, group_indices)
@@ -457,6 +614,36 @@ def global_aggregate(
     (reference AggregationOperator.java global aggregation semantics).
     'merge' consumes state columns and emits merged state columns."""
     assert mode in ("single", "partial", "final", "merge")
+    if has_drain_agg(aggs):
+        if mode != "single":
+            raise NotImplementedError(
+                "approx_percentile requires single-step aggregation")
+        regular = [a for a in aggs if a.fn not in DRAIN_FNS]
+        base = global_aggregate(batch, regular, "single")
+        computed = {}
+        for shared in _drain_groups(aggs).values():
+            for agg, res in zip(shared, _global_percentiles(batch, shared)):
+                computed[id(agg)] = res
+        out_cols2: List[Column] = []
+        out_fields2: List[Tuple[str, Type]] = []
+        ri = 0
+        for agg in aggs:
+            if agg.fn in DRAIN_FNS:
+                data, valid = computed[id(agg)]
+                dt = agg.output_type.storage_dtype
+                out_fields2.append((agg.name or agg.fn, agg.output_type))
+                out_cols2.append(Column(
+                    agg.output_type,
+                    jnp.zeros(128, dtype=dt).at[0].set(data.astype(dt)),
+                    jnp.zeros(128, dtype=bool).at[0].set(valid),
+                    batch.columns[agg.input].dictionary
+                    if agg.output_type.is_string else None))
+            else:
+                out_cols2.append(base.columns[ri])
+                out_fields2.append((base.schema.names[ri],
+                                    base.schema.types[ri]))
+                ri += 1
+        return Batch(Schema(out_fields2), out_cols2, base.row_mask)
     cap = 128  # minimum bucket; one live row
     mask = batch.row_mask
     out_fields: List[Tuple[str, Type]] = []

@@ -25,7 +25,7 @@ import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 from ..expr import ir
-from ..ops.aggregation import AggSpec
+from ..ops.aggregation import AggSpec, DRAIN_FNS as _DRAIN_FNS
 from ..sql.analyzer import Field
 from .plan import (
     AggregationNode, DistinctNode, FilterNode, GroupIdNode, JoinNode,
@@ -137,6 +137,11 @@ class _Fragmenter:
         child, loc = self.visit(node.child)
         if loc in ("single", "any"):
             return dataclasses.replace(node, child=child), loc
+        if any(a.fn in _DRAIN_FNS for a in node.aggs):
+            # drain-only aggregates (approx_percentile) have no mergeable
+            # partial state: ship raw rows to one task and aggregate there
+            src = self.cut(child, loc, OutputSpec("single"))
+            return dataclasses.replace(node, child=src), "single"
         keys = list(node.group_indices)
         partial_fields = _agg_state_fields(node)
         partial = dataclasses.replace(

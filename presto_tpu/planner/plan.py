@@ -116,6 +116,8 @@ class PlanAgg:
     # mask channel produced by MarkDistinctNode (reference
     # AggregationNode.Aggregation mask symbol)
     mask: Optional[int] = None
+    # static scalar parameter (approx_percentile's p)
+    param: Optional[float] = None
 
 
 @_one_child

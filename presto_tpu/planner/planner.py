@@ -624,7 +624,7 @@ class _Planner:
                 fn = "max"
             if fn not in ("count", "sum", "avg", "min", "max", "var_samp",
                           "var_pop", "stddev_samp", "stddev_pop",
-                          "bool_and", "bool_or"):
+                          "bool_and", "bool_or", "approx_percentile"):
                 raise AnalysisError(f"aggregate {fn}() not supported yet")
             if call.is_star or not call.args:
                 if fn != "count":
@@ -633,7 +633,24 @@ class _Planner:
                                     f"_agg{j}", distinct=False))
                 agg_fields.append(Field(f"_agg{j}", T.BIGINT))
                 continue
-            if len(call.args) != 1:
+            param = None
+            if fn == "approx_percentile":
+                # approx_percentile(x, p): p must be a constant in [0, 1]
+                # (reference ApproximateLongPercentileAggregations)
+                if len(call.args) != 2:
+                    raise AnalysisError(
+                        "approx_percentile(x, p) takes two arguments "
+                        "(the weighted form is not supported)")
+                p_expr = analyzer.analyze(call.args[1])
+                if not isinstance(p_expr, ir.Literal) \
+                        or p_expr.value is None:
+                    raise AnalysisError(
+                        "approx_percentile percentage must be a constant")
+                param = float(p_expr.value)
+                if not 0.0 <= param <= 1.0:
+                    raise AnalysisError(
+                        "percentile must be between 0 and 1")
+            elif len(call.args) != 1:
                 raise AnalysisError(f"{fn}() takes one argument")
             arg = analyzer.analyze(call.args[0])
             arg_index = len(pre_exprs)
@@ -641,7 +658,7 @@ class _Planner:
             pre_fields.append(Field(f"_aggarg{j}", arg.type))
             out_t = _agg_output_type(fn, arg.type)
             aggs.append(PlanAgg(fn, arg_index, out_t, f"_agg{j}",
-                                distinct=distinct))
+                                distinct=distinct, param=param))
             agg_fields.append(Field(f"_agg{j}", out_t))
 
         pre = ProjectNode(child=node, exprs=tuple(pre_exprs),

@@ -38,7 +38,8 @@ class UnresolvedColumnError(AnalysisError):
 AGGREGATE_FUNCTIONS = frozenset(
     ["count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
      "stddev_pop", "variance", "var_samp", "var_pop", "approx_distinct",
-     "any_value", "arbitrary", "bool_and", "bool_or"])
+     "any_value", "arbitrary", "bool_and", "bool_or",
+     "approx_percentile"])
 
 # SQL surface name -> kernel registry name
 _FUNCTION_ALIASES = {

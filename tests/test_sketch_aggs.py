@@ -1,0 +1,156 @@
+"""Sketch-style aggregates: approx_distinct and approx_percentile.
+
+The reference computes these with fixed-memory sketches (reference
+operator/aggregation/state/HyperLogLogState.java,
+DigestAndPercentileState.java); the sort-based TPU engine computes exact
+answers (exact is trivially within any sketch's error bound):
+approx_distinct lowers to mark-distinct count, approx_percentile is a
+drain-style segmented-sort select with no partial state (the planner ships
+raw rows through a single-task cut, like the window path).
+"""
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.exec.runner import LocalRunner
+    return LocalRunner(tpch_sf=0.01)
+
+
+@pytest.fixture(scope="module")
+def dist(runner):
+    from presto_tpu.exec.distributed import DistributedRunner
+    return DistributedRunner(catalogs=runner.session.catalogs,
+                             n_devices=8, rows_per_batch=1 << 12)
+
+
+def _numpy_lineitem(runner, cols):
+    rows = runner.execute(
+        f"select {', '.join(cols)} from lineitem").rows
+    return [np.asarray(c) for c in zip(*rows)]
+
+
+def nearest_rank(values, p):
+    v = np.sort(values)
+    if len(v) == 0:
+        return None
+    k = min(max(int(np.ceil(p * len(v))) - 1, 0), len(v) - 1)
+    return v[k]
+
+
+def test_approx_distinct_exact(runner):
+    got = runner.execute(
+        "select approx_distinct(l_orderkey), approx_distinct(l_returnflag) "
+        "from lineitem").rows[0]
+    want = runner.execute(
+        "select count(distinct l_orderkey), count(distinct l_returnflag) "
+        "from lineitem").rows[0]
+    assert tuple(got) == tuple(want)
+
+
+def test_global_percentile(runner):
+    (qty,) = _numpy_lineitem(runner, ["l_quantity"])
+    got = runner.execute(
+        "select approx_percentile(l_quantity, 0.5), "
+        "approx_percentile(l_quantity, 0.9), "
+        "approx_percentile(l_quantity, 0.0), "
+        "approx_percentile(l_quantity, 1.0) from lineitem").rows[0]
+    for g, p in zip(got, (0.5, 0.9, 0.0, 1.0)):
+        assert float(g) == float(nearest_rank(qty, p)), p
+
+
+def test_grouped_percentile(runner):
+    rf, price = _numpy_lineitem(runner, ["l_returnflag", "l_extendedprice"])
+    got = runner.execute(
+        "select l_returnflag, approx_percentile(l_extendedprice, 0.5), "
+        "count(*) from lineitem group by 1 order by 1").rows
+    assert len(got) == len(set(rf))
+    for flag, med, cnt in got:
+        sel = price[rf == flag]
+        assert cnt == len(sel)
+        assert float(med) == float(nearest_rank(sel, 0.5)), flag
+
+
+def test_percentile_mixed_with_regular_aggs(runner):
+    rf, price = _numpy_lineitem(runner, ["l_returnflag", "l_extendedprice"])
+    got = runner.execute(
+        "select l_returnflag, sum(l_extendedprice), "
+        "approx_percentile(l_extendedprice, 0.25), avg(l_extendedprice) "
+        "from lineitem group by 1 order by 1").rows
+    for flag, s, q25, avg in got:
+        sel = price[rf == flag]
+        assert abs(float(s) - round(sel.sum(), 2)) < 1e-6 * abs(sel.sum())
+        assert float(q25) == float(nearest_rank(sel, 0.25))
+        assert abs(float(avg) - sel.mean()) < 1e-6 * abs(sel.mean())
+
+
+def test_percentile_of_integers(runner):
+    got = runner.execute(
+        "select approx_percentile(l_linenumber, 0.5) from lineitem").rows
+    assert isinstance(got[0][0], (int, np.integer))
+
+
+def test_percentile_empty_input(runner):
+    got = runner.execute(
+        "select approx_percentile(l_quantity, 0.5) from lineitem "
+        "where l_quantity < -1").rows
+    assert got == [(None,)]
+
+
+def test_percentile_nonconstant_p_rejected(runner):
+    from presto_tpu.sql.analyzer import AnalysisError
+    with pytest.raises(AnalysisError):
+        runner.execute("select approx_percentile(l_quantity, l_discount) "
+                       "from lineitem")
+
+
+def test_percentile_varchar_lexicographic(runner):
+    # dictionary codes are appearance-ordered; the kernel must sort by
+    # lexicographic rank, not raw code
+    names = sorted(r[0] for r in runner.execute(
+        "select n_name from nation").rows)
+    got = runner.execute(
+        "select approx_percentile(n_name, 0.5) from nation").rows[0][0]
+    k = max(int(np.ceil(0.5 * len(names))) - 1, 0)
+    assert got == names[k]
+
+
+def test_percentile_multiple_ps_share_input(runner):
+    (qty,) = _numpy_lineitem(runner, ["l_quantity"])
+    got = runner.execute(
+        "select approx_percentile(l_quantity, 0.25), "
+        "approx_percentile(l_quantity, 0.5), "
+        "approx_percentile(l_quantity, 0.75) from lineitem").rows[0]
+    for g, p in zip(got, (0.25, 0.5, 0.75)):
+        assert float(g) == float(nearest_rank(qty, p))
+
+
+def test_split_part_nonpositive_index_errors(runner):
+    from presto_tpu.errors import QueryError
+    with pytest.raises(QueryError):
+        runner.execute("select split_part('a:b', ':', 0)")
+
+
+def test_split_part_out_of_range_is_null(runner):
+    assert runner.execute(
+        "select split_part('a:b', ':', 5)").rows == [(None,)]
+
+
+def test_distributed_percentile(runner, dist):
+    want = runner.execute(
+        "select l_returnflag, approx_percentile(l_extendedprice, 0.5) "
+        "from lineitem group by 1 order by 1").rows
+    got = dist.execute(
+        "select l_returnflag, approx_percentile(l_extendedprice, 0.5) "
+        "from lineitem group by 1 order by 1").rows
+    assert [(a, float(b)) for a, b in got] \
+        == [(a, float(b)) for a, b in want]
+
+
+def test_distributed_global_percentile(runner, dist):
+    want = runner.execute(
+        "select approx_percentile(l_quantity, 0.9) from lineitem").rows
+    got = dist.execute(
+        "select approx_percentile(l_quantity, 0.9) from lineitem").rows
+    assert float(got[0][0]) == float(want[0][0])
