@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .types import Type, VarcharType, CharType, parse_type
+from .types import ArrayType, MapType, Type, VarcharType, CharType, parse_type
 
 
 def bucket_capacity(n: int, minimum: int = 128) -> int:
@@ -111,9 +111,13 @@ class Column:
 
     @property
     def capacity(self) -> int:
-        return self.data.shape[0]
+        # validity is always the row-level [capacity] mask, even for
+        # composite columns whose data is a tuple of arrays
+        return self.validity.shape[0]
 
     def tree_flatten(self):
+        # data may be a tuple of arrays (ARRAY/MAP/ROW columns): jax
+        # recurses into nested containers automatically
         return (self.data, self.validity), (self.type, self.dictionary)
 
     @classmethod
@@ -211,6 +215,7 @@ class Batch:
         arrays: List[np.ndarray] = []
         validities: List[Optional[np.ndarray]] = []
         dictionaries: List[Optional[Tuple[str, ...]]] = []
+        composite: Dict[int, Tuple[Type, List[Any]]] = {}
         n = None
         for name in names:
             typ, values = data[name]
@@ -222,6 +227,12 @@ class Batch:
                     f"column {name!r} has {len(values)} values, expected {n}"
                 )
             schema_fields.append((name, typ))
+            if isinstance(typ, ArrayType):
+                composite[len(schema_fields) - 1] = (typ, values)
+                arrays.append(np.zeros(n, dtype=np.int32))   # placeholder
+                validities.append(None)
+                dictionaries.append(None)
+                continue
             valid = np.array([v is not None for v in values], dtype=bool)
             if typ.is_string:
                 vocab: List[str] = []
@@ -245,9 +256,15 @@ class Batch:
                 dictionaries.append(None)
             validities.append(valid)
         schema = Schema(schema_fields)
-        return Batch.from_arrays(
+        out = Batch.from_arrays(
             schema, arrays, validities, dictionaries, capacity=capacity, num_rows=n
         )
+        if composite:
+            cols = list(out.columns)
+            for i, (typ, values) in composite.items():
+                cols[i] = make_array_column(typ, values, out.capacity)
+            out = Batch(schema, cols, out.row_mask)
+        return out
 
     # -- export -------------------------------------------------------------
     def to_pylist(self) -> List[Tuple]:
@@ -255,6 +272,9 @@ class Batch:
         mask = np.asarray(self.row_mask)
         out_cols = []
         for col in self.columns:
+            if isinstance(col.type, (ArrayType, MapType)):
+                out_cols.append(_composite_to_pylist(col, mask))
+                continue
             data = np.asarray(col.data)[mask]
             valid = np.asarray(col.validity)[mask]
             vals: List[Any] = []
@@ -294,7 +314,8 @@ class Batch:
             cols.append(
                 Column(
                     c.type,
-                    jnp.take(c.data, idx, axis=0),
+                    jax.tree_util.tree_map(
+                        lambda a: jnp.take(a, idx, axis=0), c.data),
                     jnp.take(c.validity, idx, axis=0) & new_mask,
                     c.dictionary,
                 )
@@ -308,6 +329,119 @@ class Batch:
 jax.tree_util.register_pytree_node(
     Batch, Batch.tree_flatten, Batch.tree_unflatten
 )
+
+
+def _composite_to_pylist(col: Column, mask: np.ndarray) -> List[Any]:
+    """Decode an ARRAY/MAP column's live rows to python lists/dicts."""
+    def decode_elem(typ, d, vocab):
+        if typ.is_string:
+            code = int(d)
+            return (vocab[code] if vocab and 0 <= code < len(vocab)
+                    else None)
+        return typ.from_storage(d)
+
+    valid = np.asarray(col.validity)[mask]
+    if isinstance(col.type, ArrayType):
+        values, lengths, elem_valid = (np.asarray(a) for a in col.data)
+        values, lengths, elem_valid = values[mask], lengths[mask], elem_valid[mask]
+        et = col.type.element
+        out: List[Any] = []
+        for i, v in enumerate(valid):
+            if not v:
+                out.append(None)
+                continue
+            row = []
+            for j in range(int(lengths[i])):
+                row.append(decode_elem(et, values[i, j], col.dictionary)
+                           if elem_valid[i, j] else None)
+            out.append(row)
+        return out
+    # MAP
+    keys, values, lengths, val_valid = (np.asarray(a) for a in col.data)
+    keys, values = keys[mask], values[mask]
+    lengths, val_valid = lengths[mask], val_valid[mask]
+    kt, vt = col.type.key, col.type.value
+    kd, vd = col.dictionary or (None, None)
+    out = []
+    for i, v in enumerate(valid):
+        if not v:
+            out.append(None)
+            continue
+        m = {}
+        for j in range(int(lengths[i])):
+            k = decode_elem(kt, keys[i, j], kd)
+            m[k] = (decode_elem(vt, values[i, j], vd)
+                    if val_valid[i, j] else None)
+        out.append(m)
+    return out
+
+
+def make_array_column(typ: ArrayType, values: Sequence[Optional[Sequence]],
+                      cap: int) -> Column:
+    """Build an ARRAY column from python lists (None = NULL row)."""
+    et = typ.element
+    max_len = max([len(v) for v in values if v is not None] + [1])
+    data = np.zeros((cap, max_len), dtype=np.dtype(et.storage_dtype))
+    lengths = np.zeros(cap, dtype=np.int32)
+    elem_valid = np.zeros((cap, max_len), dtype=bool)
+    row_valid = np.zeros(cap, dtype=bool)
+    vocab: List[str] = []
+    lookup: Dict[str, int] = {}
+    for i, row in enumerate(values):
+        if row is None:
+            continue
+        row_valid[i] = True
+        lengths[i] = len(row)
+        for j, e in enumerate(row):
+            if e is None:
+                continue
+            elem_valid[i, j] = True
+            if et.is_string:
+                code = lookup.get(e)
+                if code is None:
+                    code = lookup[e] = len(vocab)
+                    vocab.append(e)
+                data[i, j] = code
+            else:
+                data[i, j] = et.to_storage(e)
+    return Column(typ, (jnp.asarray(data), jnp.asarray(lengths),
+                        jnp.asarray(elem_valid)), jnp.asarray(row_valid),
+                  tuple(vocab) if et.is_string else None)
+
+
+def _concat_array_columns(cols: Sequence[Column], cap: int) -> Column:
+    """Concatenate ARRAY columns along rows, padding widths to the max."""
+    typ = cols[0].type
+    max_len = max(c.data[0].shape[1] for c in cols)
+    if typ.element.is_string:
+        vocab, remaps = unify_dictionaries(cols)
+        dictionary: Optional[Tuple[str, ...]] = vocab
+    else:
+        vocab, remaps, dictionary = None, None, None
+    vals, lens, evs, rvs = [], [], [], []
+    for ci, c in enumerate(cols):
+        v, ln, ev = c.data
+        pad = max_len - v.shape[1]
+        if pad:
+            v = jnp.pad(v, ((0, 0), (0, pad)))
+            ev = jnp.pad(ev, ((0, 0), (0, pad)))
+        if remaps is not None:
+            table = jnp.asarray(remaps[ci])
+            idx = jnp.where(v >= 0, v, len(remaps[ci]) - 1)
+            v = jnp.take(table, idx, axis=0)
+        vals.append(v)
+        lens.append(ln)
+        evs.append(ev)
+        rvs.append(c.validity)
+    def cat_pad(parts, width=None):
+        out = jnp.concatenate(parts)
+        pad = cap - out.shape[0]
+        if pad > 0:
+            padding = ((0, pad),) + ((0, 0),) * (out.ndim - 1)
+            out = jnp.pad(out, padding)
+        return out
+    return Column(typ, (cat_pad(vals), cat_pad(lens), cat_pad(evs)),
+                  cat_pad(rvs), dictionary)
 
 
 def unify_dictionaries(columns: Sequence[Column]) -> Tuple[Tuple[str, ...], List[np.ndarray]]:
@@ -365,6 +499,11 @@ def concat_batches(batches: Sequence[Batch], capacity: Optional[int] = None) -> 
     for i in range(ncols):
         cols = [b.columns[i] for b in batches]
         typ = cols[0].type
+        if isinstance(typ, ArrayType):
+            out_cols.append(_concat_array_columns(cols, cap))
+            continue
+        if isinstance(typ, MapType):
+            raise NotImplementedError("concat of MAP columns")
         if typ.is_string:
             vocab, remaps = unify_dictionaries(cols)
             cols = [remap_codes(c, r, vocab) for c, r in zip(cols, remaps)]

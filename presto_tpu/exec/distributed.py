@@ -460,6 +460,17 @@ class DistributedExecutor(_Executor):
         if state is not None:
             yield self._pad_shardable(sort_batch(state, keys))
 
+    def _UnnestNode(self, node) -> Iterator[Batch]:
+        # gather to host, expand, re-shard: capacity changes (cap*L) would
+        # otherwise break mesh divisibility for downstream exchanges
+        from .local import unnest_expand_fn, _plan_schema as _ps
+        b = self._drain(node.child)
+        if b is None:
+            return
+        exprs = tuple(self._resolve(e) for e in node.exprs)
+        fn = unnest_expand_fn(exprs, node.ordinality, _ps(node))
+        yield self._pad_shardable(fn(_to_host(b)))
+
     def _WindowNode(self, node) -> Iterator[Batch]:
         from ..ops.window import WindowSpec, evaluate_window
         b = self._drain(node.child)

@@ -163,6 +163,63 @@ def mark_exists_mask(probe: Batch, build: Batch, probe_keys, build_keys,
                           negated=negated, null_aware=False)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def unnest_expand_fn(exprs, ordinality: bool, schema: Schema):
+    """Compiled lateral array expansion: [cap, L] element tiles flatten to
+    [cap*L] rows, outer columns repeat per element slot (reference
+    operator/unnest/UnnestOperator.java). Rows beyond an array's length
+    are masked dead; multiple arrays zip to the longest (shorter ones
+    padded with NULL elements)."""
+    import jax
+
+    from ..expr.compiler import eval_expr
+    from ..expr.functions import Val
+
+    @jax.jit
+    def expand(b: Batch) -> Batch:
+        inputs = [Val(c.data, c.validity, c.type, c.dictionary)
+                  for c in b.columns]
+        if not inputs:
+            inputs = [Val(b.row_mask, b.row_mask, T.BOOLEAN)]
+        arrs = [eval_expr(e, inputs) for e in exprs]
+        widths = [a.data[0].shape[1] for a in arrs]
+        L = max(widths)
+        cap = b.capacity
+        # effective length: NULL array -> 0 rows (cross-join semantics)
+        eff_lens = [jnp.where(a.valid, a.data[1], 0) for a in arrs]
+        max_len = eff_lens[0]
+        for ln in eff_lens[1:]:
+            max_len = jnp.maximum(max_len, ln)
+        slot = jnp.broadcast_to(jnp.arange(L)[None, :], (cap, L))
+        out_mask = (b.row_mask[:, None] & (slot < max_len[:, None])
+                    ).reshape(-1)
+        cols = []
+        for c in b.columns:
+            data = jax.tree_util.tree_map(
+                lambda a: jnp.repeat(a, L, axis=0), c.data)
+            cols.append(Column(c.type, data,
+                               jnp.repeat(c.validity, L, axis=0),
+                               c.dictionary))
+        for a, w, ln in zip(arrs, widths, eff_lens):
+            values, _, elem_valid = a.data
+            if w < L:
+                values = jnp.pad(values, ((0, 0), (0, L - w)))
+                elem_valid = jnp.pad(elem_valid, ((0, 0), (0, L - w)))
+            ev = elem_valid & (slot < ln[:, None])
+            cols.append(Column(a.type.element, values.reshape(-1),
+                               ev.reshape(-1), a.dictionary))
+        if ordinality:
+            cols.append(Column(T.BIGINT,
+                               (slot + 1).astype(jnp.int64).reshape(-1),
+                               out_mask, None))
+        return Batch(schema, cols, out_mask)
+
+    return expand
+
+
 class _Executor:
     def __init__(self, session: Session, rows_per_batch: int,
                  stats=None):
@@ -450,6 +507,13 @@ class _Executor:
     def _UnionNode(self, node: UnionNode) -> Iterator[Batch]:
         for c in node.children:
             yield from self.run(c)
+
+    def _UnnestNode(self, node) -> Iterator[Batch]:
+        exprs = tuple(self._resolve(e) for e in node.exprs)
+        fn = unnest_expand_fn(exprs, node.ordinality, _plan_schema(node))
+        compact = self._compactor()
+        for b in self.run(node.child):
+            yield compact(fn(b))
 
     def _GroupIdNode(self, node: GroupIdNode) -> Iterator[Batch]:
         """One replica batch per grouping set: absent keys get their

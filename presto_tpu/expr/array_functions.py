@@ -1,0 +1,437 @@
+"""ARRAY scalar functions over the padded dense device representation.
+
+The TPU re-design of Presto's array function surface (reference
+presto-main/.../operator/scalar/ArrayFunctions + the ~45 Array* classes,
+spi/block/ArrayBlock.java): an array Val's ``data`` is the tuple
+(values[cap, L], lengths[cap] int32, elem_valid[cap, L] bool) — every
+operation below is a static-shape vectorized 2D kernel (no offsets
+indirection, no per-row loops). Higher-order functions (transform/filter/
+reduce/…) live in compiler.py because they evaluate lambda IR.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import errors as E
+from .. import types as T
+from ..types import Type
+from .functions import (
+    Val, _all_valid, _code_gather, cast_val, flag_err, merge_err, register,
+    vocab_table,
+)
+
+
+def arr_parts(v: Val):
+    values, lengths, elem_valid = v.data
+    return values, lengths, elem_valid
+
+
+def in_length(values: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    L = values.shape[1]
+    return jnp.arange(L)[None, :] < lengths[:, None]
+
+
+def unify_codes(vals: Sequence[Val]):
+    """Remap each string Val's codes onto one merged vocabulary (the
+    expression-layer face of batch.unify_dictionaries)."""
+    from ..batch import unify_dictionaries, vocab_column
+    vocab, remaps = unify_dictionaries(
+        [vocab_column(v.dictionary) for v in vals])
+    out = [_code_gather(jnp.asarray(r), v.data)
+           for v, r in zip(vals, remaps)]
+    return vocab, out
+
+
+@register("array_constructor")
+def _array_constructor(args: List[Val], out: Type) -> Val:
+    et = out.element
+    if not args:
+        raise NotImplementedError("empty ARRAY[] literal")
+    if et.is_string:
+        vocab, codes = unify_codes(args)
+        values = jnp.stack(codes, axis=1)
+        dictionary: Optional[Tuple[str, ...]] = vocab
+    else:
+        values = jnp.stack([cast_val(a, et).data for a in args], axis=1)
+        dictionary = None
+    elem_valid = jnp.stack([a.valid for a in args], axis=1)
+    n = values.shape[0]
+    lengths = jnp.full(n, len(args), dtype=jnp.int32)
+    row_valid = jnp.ones(n, dtype=bool)
+    return Val((values, lengths, elem_valid), row_valid, out,
+               dictionary=dictionary,
+               err=merge_err(*[a.err for a in args]))
+
+
+@register("cardinality")
+def _cardinality(args, out):
+    (a,) = args
+    if isinstance(a.type, T.MapType):
+        lengths = a.data[2]
+    else:
+        _, lengths, _ = arr_parts(a)
+    return Val(lengths.astype(jnp.int64), a.valid, out)
+
+
+def _gather_element(a: Val, j: jnp.ndarray):
+    """values[i, j[i]] + element validity at that slot (j pre-clipped)."""
+    values, lengths, elem_valid = arr_parts(a)
+    jj = jnp.clip(j, 0, values.shape[1] - 1)[:, None]
+    data = jnp.take_along_axis(values, jj, axis=1)[:, 0]
+    ev = jnp.take_along_axis(elem_valid, jj, axis=1)[:, 0]
+    return data, ev
+
+
+@register("subscript")
+def _subscript(args, out):
+    a, i = args
+    if isinstance(a.type, T.MapType):
+        return _map_lookup(a, i, out, null_on_missing=False)
+    values, lengths, _ = arr_parts(a)
+    idx = i.data.astype(jnp.int64)
+    in_range = (idx >= 1) & (idx <= lengths.astype(jnp.int64))
+    data, ev = _gather_element(a, idx - 1)
+    both = a.valid & i.valid
+    # out-of-bounds subscript is an error (reference ArraySubscriptOperator)
+    err = flag_err(both & ~in_range, E.INVALID_FUNCTION_ARGUMENT)
+    return Val(data, both & in_range & ev, out, dictionary=a.dictionary,
+               err=merge_err(err, a.err, i.err))
+
+
+@register("element_at")
+def _element_at(args, out):
+    a, i = args
+    if isinstance(a.type, T.MapType):
+        return _map_lookup(a, i, out, null_on_missing=True)
+    values, lengths, _ = arr_parts(a)
+    idx = i.data.astype(jnp.int64)
+    ln = lengths.astype(jnp.int64)
+    # negative index counts from the end; index 0 raises (reference
+    # ElementAtFunction: "SQL array indices start at 1")
+    j = jnp.where(idx < 0, ln + idx, idx - 1)
+    in_range = (j >= 0) & (j < ln)
+    data, ev = _gather_element(a, j)
+    err = flag_err(a.valid & i.valid & (idx == 0),
+                   E.INVALID_FUNCTION_ARGUMENT)
+    return Val(data, a.valid & i.valid & in_range & ev, out,
+               dictionary=a.dictionary, err=merge_err(err, a.err, i.err))
+
+
+def _elem_compare_eq(a: Val, x: Val):
+    """values[i, j] == x[i] with dictionary unification for strings."""
+    values, lengths, elem_valid = arr_parts(a)
+    if a.type.element.is_string:
+        vocab, (acodes_flat, xcodes) = unify_codes(
+            [Val(values.reshape(-1), None, T.VARCHAR,
+                 dictionary=a.dictionary), x])
+        values = acodes_flat.reshape(values.shape)
+        xdata = xcodes
+    else:
+        xdata = cast_val(x, a.type.element).data
+    return (values == xdata[:, None]) & elem_valid & in_length(
+        values, lengths)
+
+
+@register("contains")
+def _contains(args, out):
+    a, x = args
+    values, lengths, elem_valid = arr_parts(a)
+    hit = _elem_compare_eq(a, x)
+    any_hit = jnp.any(hit, axis=1)
+    # ANSI 3VL: no match over an array with NULL elements is unknown
+    has_null = jnp.any(~elem_valid & in_length(values, lengths), axis=1)
+    return Val(any_hit, a.valid & x.valid & (any_hit | ~has_null),
+               T.BOOLEAN, err=merge_err(a.err, x.err))
+
+
+@register("array_position")
+def _array_position(args, out):
+    a, x = args
+    hit = _elem_compare_eq(a, x)
+    L = hit.shape[1]
+    first = jnp.argmax(hit, axis=1) + 1
+    pos = jnp.where(jnp.any(hit, axis=1), first, 0).astype(jnp.int64)
+    return Val(pos, a.valid & x.valid, out, err=merge_err(a.err, x.err))
+
+
+def _rank_tables(vocab):
+    from ..ops.sort import rank_codes, unrank_table
+    return rank_codes, unrank_table(vocab)
+
+
+def _array_extreme(is_max):
+    def impl(args, out):
+        (a,) = args
+        values, lengths, elem_valid = arr_parts(a)
+        live = elem_valid & in_length(values, lengths)
+        unrank = None
+        if a.type.element.is_string:
+            from ..ops.sort import rank_codes, unrank_table
+            values = rank_codes(values, a.dictionary or ()).astype(jnp.int64)
+            unrank = unrank_table(a.dictionary or ())
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            sent = jnp.asarray(-jnp.inf if is_max else jnp.inf,
+                               dtype=values.dtype)
+        else:
+            info = jnp.iinfo(values.dtype)
+            sent = jnp.asarray(info.min if is_max else info.max,
+                               dtype=values.dtype)
+        masked = jnp.where(live, values, sent)
+        data = jnp.max(masked, axis=1) if is_max else jnp.min(masked, axis=1)
+        any_live = jnp.any(live, axis=1)
+        # Presto: NULL if array contains a NULL element
+        has_null = jnp.any(~elem_valid & in_length(values, lengths), axis=1)
+        if unrank is not None:
+            data = jnp.take(unrank, jnp.clip(data, 0, unrank.shape[0] - 1),
+                            axis=0)
+        return Val(data, a.valid & any_live & ~has_null, out,
+                   dictionary=a.dictionary, err=a.err)
+    return impl
+
+
+register("array_max")(_array_extreme(True))
+register("array_min")(_array_extreme(False))
+
+
+@register("array_sort")
+def _array_sort(args, out):
+    """Ascending, nulls last (reference ArraySortFunction)."""
+    (a,) = args
+    values, lengths, elem_valid = arr_parts(a)
+    inl = in_length(values, lengths)
+    svals = values
+    unrank = None
+    if a.type.element.is_string:
+        from ..ops.sort import rank_codes, unrank_table
+        svals = rank_codes(values, a.dictionary or ()).astype(jnp.int64)
+        unrank = unrank_table(a.dictionary or ())
+    # slot class: 0 = value, 1 = null element, 2 = beyond length
+    slot = jnp.where(inl & elem_valid, 0, jnp.where(inl, 1, 2))
+    neutral = jnp.where(inl & elem_valid, svals, jnp.zeros_like(svals))
+    order = jnp.lexsort((neutral, slot), axis=1)
+    sorted_vals = jnp.take_along_axis(values, order, axis=1)
+    sorted_valid = jnp.take_along_axis(elem_valid & inl, order, axis=1)
+    return Val((sorted_vals, lengths, sorted_valid), a.valid, out,
+               dictionary=a.dictionary, err=a.err)
+
+
+@register("array_distinct")
+def _array_distinct(args, out):
+    """First-occurrence order (reference ArrayDistinctFunction)."""
+    (a,) = args
+    values, lengths, elem_valid = arr_parts(a)
+    inl = in_length(values, lengths)
+    live = inl & elem_valid
+    nulls = inl & ~elem_valid
+    # pairwise O(L^2): dup[i, j] = exists k<j with equal value (or null)
+    eq = (values[:, :, None] == values[:, None, :])
+    prior = jnp.tril(jnp.ones((values.shape[1],) * 2, dtype=bool), k=-1)
+    dup_val = jnp.any(eq & live[:, :, None] & live[:, None, :]
+                      & prior[None, :, :], axis=2)
+    dup_null = jnp.any(nulls[:, :, None] & nulls[:, None, :]
+                       & prior[None, :, :], axis=2)
+    keep = inl & ~jnp.where(elem_valid, dup_val, dup_null)
+    return _compact_rows(values, elem_valid, keep, a, out)
+
+
+def _compact_rows(values, elem_valid, keep, a: Val, out: Type) -> Val:
+    """Keep flagged elements, preserving order; recompute lengths."""
+    L = values.shape[1]
+    order = jnp.lexsort((jnp.broadcast_to(jnp.arange(L), values.shape),
+                         ~keep), axis=1)
+    new_vals = jnp.take_along_axis(values, order, axis=1)
+    new_valid = jnp.take_along_axis(elem_valid & keep, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return Val((new_vals, new_len, new_valid), a.valid, out,
+               dictionary=a.dictionary, err=a.err)
+
+
+@register("array_concat")
+def _array_concat(args, out):
+    if len(args) > 2:
+        # variadic: left fold (reference ArrayConcatFunction)
+        acc = args[0]
+        for nxt in args[1:]:
+            acc = _array_concat([acc, nxt], out)
+        return acc
+    a, b = args
+    if a.type.element.is_string:
+        av, al, ae = arr_parts(a)
+        bv, bl, be = arr_parts(b)
+        vocab, (ac, bc) = unify_codes([
+            Val(av.reshape(-1), None, T.VARCHAR, dictionary=a.dictionary),
+            Val(bv.reshape(-1), None, T.VARCHAR, dictionary=b.dictionary)])
+        a = Val((ac.reshape(av.shape), al, ae), a.valid, a.type, vocab)
+        b = Val((bc.reshape(bv.shape), bl, be), b.valid, b.type, vocab)
+        dictionary: Optional[Tuple[str, ...]] = vocab
+    else:
+        dictionary = None
+    av, al, ae = arr_parts(a)
+    bv, bl, be = arr_parts(b)
+    La, Lb = av.shape[1], bv.shape[1]
+    Lo = La + Lb
+    # out[i, j] = a[i, j] if j < len_a else b[i, j - len_a]
+    j = jnp.arange(Lo)[None, :]
+    from_a = j < al[:, None]
+    bj = jnp.clip(j - al[:, None], 0, Lb - 1)
+    aj = jnp.clip(j, 0, La - 1)
+    a_vals = jnp.take_along_axis(av, aj.astype(jnp.int32), axis=1)
+    b_vals = jnp.take_along_axis(bv, bj.astype(jnp.int32), axis=1)
+    a_ev = jnp.take_along_axis(ae, aj.astype(jnp.int32), axis=1)
+    b_ev = jnp.take_along_axis(be, bj.astype(jnp.int32), axis=1)
+    new_len = (al + bl).astype(jnp.int32)
+    inl = j < new_len[:, None]
+    vals = jnp.where(from_a, a_vals, b_vals)
+    ev = jnp.where(from_a, a_ev, b_ev) & inl
+    return Val((vals, new_len, ev), a.valid & b.valid, out,
+               dictionary=dictionary, err=merge_err(a.err, b.err))
+
+
+@register("repeat")
+def _repeat(args, out):
+    x, n = args
+    if n.literal is None:
+        raise NotImplementedError("repeat() count must be a constant")
+    k = max(int(n.literal), 0)
+    values = jnp.broadcast_to(x.data[:, None], (x.data.shape[0], max(k, 1)))
+    ev = jnp.broadcast_to(x.valid[:, None], values.shape)
+    lengths = jnp.full(values.shape[0], k, dtype=jnp.int32)
+    return Val((values, lengths, ev), n.valid, out,
+               dictionary=x.dictionary, err=merge_err(x.err, n.err))
+
+
+@register("sequence")
+def _sequence(args, out):
+    """sequence(a, b[, step]) with constant bounds (static length)."""
+    for v in args:
+        if v.literal is None:
+            raise NotImplementedError("sequence() bounds must be constants")
+    start = int(args[0].literal)
+    stop = int(args[1].literal)
+    step = int(args[2].literal) if len(args) > 2 else (
+        1 if stop >= start else -1)
+    if step == 0:
+        raise E.QueryError(E.INVALID_FUNCTION_ARGUMENT,
+                           "sequence step cannot be zero")
+    seq = list(range(start, stop + (1 if step > 0 else -1), step))
+    n = args[0].data.shape[0]
+    k = max(len(seq), 1)
+    values = jnp.broadcast_to(
+        jnp.asarray(seq or [0], dtype=jnp.int64)[None, :], (n, k))
+    lengths = jnp.full(n, len(seq), dtype=jnp.int32)
+    ev = jnp.broadcast_to((jnp.arange(k) < len(seq))[None, :], (n, k))
+    return Val((values, lengths, ev), _all_valid(args), out)
+
+
+@register("split")
+def _split(args, out):
+    """split(s, delim[, limit]): per-vocab-entry parts baked as tables."""
+    a, d = args[0], args[1]
+    from .functions import _string_literal_of
+    delim = _string_literal_of(d)
+    if a.dictionary is None or delim is None:
+        raise NotImplementedError("split() needs a dictionary column and "
+                                  "a constant delimiter")
+    limit = None
+    if len(args) > 2:
+        if args[2].literal is None:
+            raise NotImplementedError("split() limit must be a constant")
+        limit = int(args[2].literal)
+    parts_per = []
+    for s in a.dictionary:
+        parts = s.split(delim, limit - 1 if limit else -1) if delim else [s]
+        parts_per.append(parts)
+    L = max([len(p) for p in parts_per] + [1])
+    vocab: List[str] = []
+    lookup: dict = {}
+    val_table = np.zeros((len(a.dictionary) + 1, L), dtype=np.int32)
+    len_table = np.zeros(len(a.dictionary) + 1, dtype=np.int32)
+    for i, parts in enumerate(parts_per):
+        len_table[i] = len(parts)
+        for j, p in enumerate(parts):
+            code = lookup.get(p)
+            if code is None:
+                code = lookup[p] = len(vocab)
+                vocab.append(p)
+            val_table[i, j] = code
+    values = _code_gather(jnp.asarray(val_table), a.data)
+    lengths = _code_gather(jnp.asarray(len_table), a.data)
+    ev = in_length(values, lengths)
+    return Val((values, lengths, ev), a.valid, out,
+               dictionary=tuple(vocab), err=a.err)
+
+
+# -- MAP ---------------------------------------------------------------------
+
+@register("map")
+def _map_constructor(args, out):
+    """map(key_array, value_array) (reference MapConstructor)."""
+    karr, varr = args
+    kv, kl, ke = arr_parts(karr)
+    vv, vl, ve = arr_parts(varr)
+    if kv.shape[1] != vv.shape[1]:
+        L = max(kv.shape[1], vv.shape[1])
+        kv = jnp.pad(kv, ((0, 0), (0, L - kv.shape[1])))
+        ke = jnp.pad(ke, ((0, 0), (0, L - ke.shape[1])))
+        vv = jnp.pad(vv, ((0, 0), (0, L - vv.shape[1])))
+        ve = jnp.pad(ve, ((0, 0), (0, L - ve.shape[1])))
+    # equal lengths required; duplicate keys raise (reference
+    # MapConstructor "Duplicate map keys are not allowed")
+    inl = in_length(kv, kl)
+    prior = jnp.tril(jnp.ones((kv.shape[1],) * 2, dtype=bool), k=-1)
+    dup_rows = jnp.any((kv[:, :, None] == kv[:, None, :])
+                       & inl[:, :, None] & inl[:, None, :]
+                       & prior[None, :, :], axis=(1, 2))
+    err = flag_err(karr.valid & varr.valid & ((kl != vl) | dup_rows),
+                   E.INVALID_FUNCTION_ARGUMENT)
+    dictionary = (karr.dictionary, varr.dictionary) \
+        if (karr.dictionary or varr.dictionary) else None
+    return Val((kv, vv, kl, ve), karr.valid & varr.valid, out,
+               dictionary=dictionary,
+               err=merge_err(err, karr.err, varr.err))
+
+
+def _map_lookup(m: Val, k: Val, out: Type, null_on_missing: bool) -> Val:
+    keys, values, lengths, val_valid = m.data
+    kd, vd = m.dictionary or (None, None)
+    if m.type.key.is_string:
+        vocab, (kcodes_flat, xcodes) = unify_codes([
+            Val(keys.reshape(-1), None, T.VARCHAR, dictionary=kd), k])
+        keys = kcodes_flat.reshape(keys.shape)
+        xdata = xcodes
+    else:
+        xdata = cast_val(k, m.type.key).data
+    inl = in_length(keys, lengths)
+    hit = (keys == xdata[:, None]) & inl
+    found = jnp.any(hit, axis=1)
+    j = jnp.argmax(hit, axis=1)
+    data = jnp.take_along_axis(values, j[:, None], axis=1)[:, 0]
+    vv = jnp.take_along_axis(val_valid, j[:, None], axis=1)[:, 0]
+    both = m.valid & k.valid
+    err = None
+    if not null_on_missing:
+        # missing key on m[k] raises (reference MapSubscriptOperator)
+        err = flag_err(both & ~found, E.INVALID_FUNCTION_ARGUMENT)
+    return Val(data, both & found & vv, out, dictionary=vd,
+               err=merge_err(err, m.err, k.err))
+
+
+@register("map_keys")
+def _map_keys(args, out):
+    (m,) = args
+    keys, values, lengths, val_valid = m.data
+    kd, _ = m.dictionary or (None, None)
+    ev = in_length(keys, lengths)
+    return Val((keys, lengths, ev), m.valid, out, dictionary=kd, err=m.err)
+
+
+@register("map_values")
+def _map_values(args, out):
+    (m,) = args
+    keys, values, lengths, val_valid = m.data
+    _, vd = m.dictionary or (None, None)
+    ev = in_length(values, lengths) & val_valid
+    return Val((values, lengths, ev), m.valid, out, dictionary=vd, err=m.err)

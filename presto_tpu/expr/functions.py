@@ -50,6 +50,14 @@ class Val:
     @staticmethod
     def constant(value, typ: Type, n: int) -> "Val":
         if value is None:
+            if isinstance(typ, T.ArrayType):
+                return Val(
+                    (jnp.zeros((n, 1), dtype=typ.storage_dtype),
+                     jnp.zeros(n, dtype=jnp.int32),
+                     jnp.zeros((n, 1), dtype=bool)),
+                    jnp.zeros(n, dtype=bool), typ,
+                    dictionary=() if typ.element.is_string else None,
+                )
             return Val(
                 jnp.full(n, typ.null_storage(), dtype=typ.storage_dtype),
                 jnp.zeros(n, dtype=bool), typ, literal=None,
@@ -122,6 +130,13 @@ def cast_val(v: Val, to: Type) -> Val:
     if isinstance(f, T.UnknownType):
         # typed NULL: all-invalid storage of the target type
         n = v.data.shape[0]
+        if isinstance(to, T.ArrayType):
+            return Val((jnp.zeros((n, 1), dtype=to.storage_dtype),
+                        jnp.zeros(n, dtype=jnp.int32),
+                        jnp.zeros((n, 1), dtype=bool)),
+                       jnp.zeros(n, dtype=bool), to,
+                       dictionary=() if to.element.is_string else None,
+                       err=v.err)
         return Val(jnp.zeros(n, dtype=to.storage_dtype),
                    jnp.zeros(n, dtype=bool), to,
                    dictionary=() if to.is_string else None, err=v.err)

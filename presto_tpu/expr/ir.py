@@ -91,6 +91,36 @@ class Cast(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class LambdaRef(Expr):
+    """Reference to an enclosing lambda's parameter: ``level`` is the
+    absolute nesting depth of the owning lambda (0 = outermost), ``index``
+    the parameter position within it — so nested lambdas can reference
+    outer parameters unambiguously."""
+
+    index: int = 0
+    level: int = 0
+
+    def __repr__(self) -> str:
+        return f"$lam{self.level}.{self.index}:{self.type.display()}"
+
+
+@dataclasses.dataclass(frozen=True)
+class LambdaExpr(Expr):
+    """Lambda passed to a higher-order function (reference
+    sql/relational/LambdaDefinitionExpression.java). ``type`` is the body's
+    result type; parameters appear in the body as LambdaRef nodes."""
+
+    body: Optional[Expr] = None
+    n_params: int = 0
+
+    def children(self) -> Tuple[Expr, ...]:
+        return (self.body,)
+
+    def __repr__(self) -> str:
+        return f"lambda({self.n_params})->{self.body!r}"
+
+
+@dataclasses.dataclass(frozen=True)
 class SpecialForm(Expr):
     form: Form = Form.AND
     args: Tuple[Expr, ...] = ()

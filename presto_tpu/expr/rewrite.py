@@ -22,6 +22,11 @@ def rewrite(e: ir.Expr, fn: Callable[[ir.Expr], ir.Expr]) -> ir.Expr:
     elif isinstance(e, ir.SpecialForm):
         e = ir.SpecialForm(type=e.type, form=e.form,
                            args=tuple(rewrite(a, fn) for a in e.args))
+    elif isinstance(e, ir.LambdaExpr):
+        # lambda bodies capture outer InputRefs: rewrite through them
+        # (LambdaRefs are leaves and pass through fn untouched)
+        e = ir.LambdaExpr(type=e.type, body=rewrite(e.body, fn),
+                          n_params=e.n_params)
     return fn(e)
 
 

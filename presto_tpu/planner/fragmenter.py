@@ -246,6 +246,11 @@ class _Fragmenter:
         src = self.cut(child, loc, OutputSpec("single"))
         return dataclasses.replace(node, child=src), "single"
 
+    def _UnnestNode(self, node):
+        # row-local expansion: runs wherever its child runs
+        child, loc = self.visit(node.child)
+        return dataclasses.replace(node, child=child), loc
+
     def _WindowNode(self, node: WindowNode):
         child, loc = self.visit(node.child)
         if loc in ("single", "any"):

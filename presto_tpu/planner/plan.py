@@ -278,6 +278,20 @@ class DistinctNode(PlanNode):
 
 @_one_child
 @dataclasses.dataclass(frozen=True)
+class UnnestNode(PlanNode):
+    """Lateral array expansion (reference plan/UnnestNode.java +
+    operator/unnest/UnnestOperator.java): output = child fields, then one
+    element column per array expression, then optional ordinality. Each
+    child row replicates once per element of its (longest) array."""
+
+    child: PlanNode
+    exprs: Tuple[object, ...]      # ir.Expr of ArrayType over child schema
+    ordinality: bool
+    fields: Tuple[Field, ...]
+
+
+@_one_child
+@dataclasses.dataclass(frozen=True)
 class MarkDistinctNode(PlanNode):
     """Appends one boolean column that is true at the first occurrence
     of each distinct tuple of ``cols`` (reference plan/MarkDistinctNode

@@ -139,7 +139,39 @@ class _Planner:
             return self.plan_query_node(rel.query)
         if isinstance(rel, A.Join):
             return self.plan_join(rel)
+        if isinstance(rel, A.Unnest):
+            # standalone FROM UNNEST(...): expand over a one-row input
+            return self.plan_unnest(
+                ValuesNode(fields=(), rows=((),)), rel, None, ())
         raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+    def plan_unnest(self, left: PlanNode, un: A.Unnest,
+                    alias: Optional[str],
+                    col_names: Tuple[str, ...]) -> PlanNode:
+        """Lateral UNNEST: expressions resolve against the relations to
+        the LEFT in the FROM list (reference RelationPlanner.visitUnnest +
+        plan/UnnestNode.java)."""
+        from .plan import UnnestNode
+        scope = Scope(left.fields)
+        analyzer = ExpressionAnalyzer(scope)
+        exprs = []
+        elem_fields: List[Field] = []
+        for i, e in enumerate(un.exprs):
+            x = analyzer.analyze(e)
+            if not isinstance(x.type, T.ArrayType):
+                raise AnalysisError("UNNEST argument must be an array")
+            exprs.append(x)
+            name = col_names[len(elem_fields)] \
+                if len(elem_fields) < len(col_names) else f"_unnest{i}"
+            elem_fields.append(Field(name, x.type.element,
+                                     relation=alias or ""))
+        if un.ordinality:
+            name = col_names[len(elem_fields)] \
+                if len(elem_fields) < len(col_names) else "ordinality"
+            elem_fields.append(Field(name, T.BIGINT, relation=alias or ""))
+        fields = tuple(left.fields) + tuple(elem_fields)
+        return UnnestNode(child=left, exprs=tuple(exprs),
+                          ordinality=un.ordinality, fields=fields)
 
     def plan_table(self, rel: A.Table) -> PlanNode:
         name = rel.name
@@ -163,6 +195,17 @@ class _Planner:
 
     def plan_join(self, rel: A.Join) -> PlanNode:
         left = self.plan_relation(rel.left)
+        # lateral UNNEST as the right side of an (implicit) cross join
+        right_rel, un_alias, un_cols = rel.right, None, ()
+        if isinstance(right_rel, A.AliasedRelation) \
+                and isinstance(right_rel.relation, A.Unnest):
+            un_alias, un_cols = right_rel.alias, right_rel.column_names
+            right_rel = right_rel.relation
+        if isinstance(right_rel, A.Unnest):
+            if rel.join_type not in ("cross", "implicit"):
+                raise AnalysisError(
+                    "UNNEST only joins as CROSS JOIN / FROM-list item")
+            return self.plan_unnest(left, right_rel, un_alias, un_cols)
         right = self.plan_relation(rel.right)
         combined = left.fields + right.fields
         if rel.join_type in ("cross", "implicit"):

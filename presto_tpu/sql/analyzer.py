@@ -177,6 +177,9 @@ class ExpressionAnalyzer:
                  replacements: Optional[Dict[A.Expression, ir.Expr]] = None):
         self.scope = scope
         self.replacements = replacements or {}
+        # innermost-last stack of {param_name: (position, type)} frames
+        # for lambda bodies (reference analyzer LambdaArgumentDeclaration)
+        self.lambda_scopes: List[Dict[str, Tuple[int, T.Type]]] = []
 
     def analyze(self, node: A.Expression) -> ir.Expr:
         hit = self.replacements.get(node)
@@ -189,6 +192,12 @@ class ExpressionAnalyzer:
 
     # -- leaves --------------------------------------------------------------
     def _Identifier(self, node: A.Identifier) -> ir.Expr:
+        low = node.name.lower()
+        for lvl in range(len(self.lambda_scopes) - 1, -1, -1):
+            frame = self.lambda_scopes[lvl]
+            if low in frame:
+                pos, typ = frame[low]
+                return ir.LambdaRef(type=typ, index=pos, level=lvl)
         idx = self.scope.resolve(node.name)
         return ir.input_ref(idx, self.scope.field(idx).type)
 
@@ -427,12 +436,149 @@ class ExpressionAnalyzer:
             raise AnalysisError(
                 f"aggregate function {name}() in scalar context (missing "
                 "GROUP BY rewrite?)")
+        if name in ("transform", "filter", "reduce", "any_match",
+                    "all_match", "none_match") \
+                and node.args and any(isinstance(a, A.Lambda)
+                                      for a in node.args):
+            return self._higher_order(name, node)
         args = [self.analyze(a) for a in node.args]
+        array_t = self._array_fn_type(name, args)
+        if array_t is not None:
+            fn = "array_concat" if (name == "concat" and
+                                    isinstance(args[0].type, T.ArrayType)) \
+                else name
+            return ir.call(fn, array_t, *args)
         try:
             out = infer_call_type(name, [a.type for a in args])
         except KeyError:
             raise AnalysisError(f"unknown function {node.name!r}")
         return ir.call(name, out, *args)
+
+    def _ArrayLiteral(self, node: A.ArrayLiteral) -> ir.Expr:
+        if not node.items:
+            raise AnalysisError("empty ARRAY[] literal needs a cast")
+        items = [self.analyze(a) for a in node.items]
+        el: T.Type = T.UNKNOWN
+        for a in items:
+            nxt = T.common_super_type(el, a.type)
+            if nxt is None:
+                raise AnalysisError("ARRAY elements have incompatible types")
+            el = nxt
+        items = [coerce(a, el) for a in items]
+        return ir.call("array_constructor", T.ArrayType(el), *items)
+
+    def _Subscript(self, node: A.Subscript) -> ir.Expr:
+        base = self.analyze(node.base)
+        idx = self.analyze(node.index)
+        if isinstance(base.type, T.ArrayType):
+            if not T.is_integral(idx.type):
+                raise AnalysisError("array subscript must be an integer")
+            return ir.call("subscript", base.type.element, base, idx)
+        if isinstance(base.type, T.MapType):
+            return ir.call("subscript", base.type.value, base,
+                           coerce(idx, base.type.key))
+        raise AnalysisError(
+            f"cannot subscript {base.type.display()}")
+
+    def _Lambda(self, node):
+        raise AnalysisError(
+            "lambda expressions are only valid as arguments of "
+            "higher-order functions (transform, filter, reduce, ...)")
+
+    def _analyze_lambda(self, lam: A.Lambda,
+                        param_types: Sequence[T.Type]) -> ir.LambdaExpr:
+        if len(lam.params) != len(param_types):
+            raise AnalysisError(
+                f"lambda takes {len(lam.params)} arguments, expected "
+                f"{len(param_types)}")
+        frame = {p.lower(): (i, t)
+                 for i, (p, t) in enumerate(zip(lam.params, param_types))}
+        self.lambda_scopes.append(frame)
+        try:
+            body = self.analyze(lam.body)
+        finally:
+            self.lambda_scopes.pop()
+        return ir.LambdaExpr(type=body.type, body=body,
+                             n_params=len(lam.params))
+
+    def _higher_order(self, name: str, node: A.FunctionCall) -> ir.Expr:
+        args = list(node.args)
+        arr = self.analyze(args[0])
+        if not isinstance(arr.type, T.ArrayType):
+            raise AnalysisError(f"{name}() expects an array argument")
+        et = arr.type.element
+        if name == "reduce":
+            if len(args) != 4:
+                raise AnalysisError(
+                    "reduce(array, init, (s, x) -> ..., s -> ...) "
+                    "takes four arguments")
+            init = self.analyze(args[1])
+            if not isinstance(args[2], A.Lambda) \
+                    or not isinstance(args[3], A.Lambda):
+                raise AnalysisError("reduce() needs lambda arguments")
+            step = self._analyze_lambda(args[2], [init.type, et])
+            step_body = coerce(step.body, init.type)
+            step = ir.LambdaExpr(type=init.type, body=step_body, n_params=2)
+            out_lam = self._analyze_lambda(args[3], [init.type])
+            return ir.call("reduce", out_lam.type, arr, init, step, out_lam)
+        if len(args) != 2 or not isinstance(args[1], A.Lambda):
+            raise AnalysisError(f"{name}(array, lambda) takes a lambda")
+        lam = self._analyze_lambda(args[1], [et])
+        if name == "transform":
+            return ir.call(name, T.ArrayType(lam.type), arr, lam)
+        if not isinstance(lam.type, T.BooleanType):
+            raise AnalysisError(f"{name}() lambda must return boolean")
+        if name == "filter":
+            return ir.call(name, arr.type, arr, lam)
+        return ir.call(name, T.BOOLEAN, arr, lam)
+
+    def _array_fn_type(self, name: str,
+                       args: List[ir.Expr]) -> Optional[T.Type]:
+        """Structural return types for array/map builtins (these need the
+        argument's element types, which name-only infer_call_type can't
+        see)."""
+        ts = [a.type for a in args]
+        if name == "cardinality" and isinstance(ts[0], (T.ArrayType,
+                                                        T.MapType)):
+            return T.BIGINT
+        if name == "element_at":
+            if isinstance(ts[0], T.ArrayType):
+                return ts[0].element
+            if isinstance(ts[0], T.MapType):
+                return ts[0].value
+        if not any(isinstance(t, (T.ArrayType, T.MapType)) for t in ts) \
+                and name not in ("repeat", "sequence", "split", "map"):
+            return None
+        if name == "contains":
+            return T.BOOLEAN
+        if name == "array_position":
+            return T.BIGINT
+        if name in ("array_min", "array_max"):
+            return ts[0].element
+        if name in ("array_distinct", "array_sort"):
+            return ts[0]
+        if name == "array_concat" or (name == "concat" and
+                                      isinstance(ts[0], T.ArrayType)):
+            out = ts[0]
+            for t in ts[1:]:
+                out = T.common_super_type(out, t)
+                if out is None:
+                    raise AnalysisError("cannot concat incompatible arrays")
+            return out
+        if name == "repeat" and len(ts) == 2:
+            return T.ArrayType(ts[0])
+        if name == "sequence":
+            return T.ArrayType(T.BIGINT)
+        if name == "split" and ts and ts[0].is_string:
+            return T.ArrayType(T.VARCHAR)
+        if name == "map" and len(ts) == 2 \
+                and all(isinstance(t, T.ArrayType) for t in ts):
+            return T.MapType(ts[0].element, ts[1].element)
+        if name == "map_keys" and isinstance(ts[0], T.MapType):
+            return T.ArrayType(ts[0].key)
+        if name == "map_values" and isinstance(ts[0], T.MapType):
+            return T.ArrayType(ts[0].value)
+        return None
 
     def _ScalarSubquery(self, node):
         raise AnalysisError("scalar subquery must be planned (init plan)")

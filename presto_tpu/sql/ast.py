@@ -173,6 +173,27 @@ class FunctionCall(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayLiteral(Expression):
+    """ARRAY[e1, e2, ...] (reference sql/tree/ArrayConstructor.java)."""
+    items: Tuple[Expression, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Subscript(Expression):
+    """base[index] — 1-based array subscript / map key lookup
+    (reference sql/tree/SubscriptExpression.java)."""
+    base: Expression
+    index: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Lambda(Expression):
+    """x -> expr / (x, y) -> expr (reference sql/tree/LambdaExpression.java)."""
+    params: Tuple[str, ...]
+    body: Expression
+
+
+@dataclasses.dataclass(frozen=True)
 class WindowFunction(Expression):
     """fn(...) OVER (PARTITION BY ... ORDER BY ...) (reference
     sql/tree/FunctionCall window + Window.java)."""
@@ -255,6 +276,15 @@ class AliasedRelation(Relation):
 @dataclasses.dataclass(frozen=True)
 class SubqueryRelation(Relation):
     query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Unnest(Relation):
+    """UNNEST(expr, ...) [WITH ORDINALITY] — lateral array expansion
+    (reference sql/tree/Unnest.java). Expressions may reference columns
+    of relations earlier in the FROM list."""
+    exprs: Tuple[Expression, ...]
+    ordinality: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

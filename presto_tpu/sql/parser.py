@@ -489,6 +489,23 @@ class _Parser:
             rel = self._relation()
             self.expect_op(")")
             return rel
+        t = self.peek()
+        if t.kind == "IDENT" and t.text.lower() == "unnest" \
+                and self.peek(1).kind == "OP" and self.peek(1).text == "(":
+            self.next()
+            self.next()
+            exprs = [self.expression()]
+            while self.accept_op(","):
+                exprs.append(self.expression())
+            self.expect_op(")")
+            ordinality = False
+            if self.accept_kw("with"):
+                w = self.next()
+                if w.text.lower() != "ordinality":
+                    raise SqlSyntaxError("expected ORDINALITY",
+                                         w.line, w.col)
+                ordinality = True
+            return A.Unnest(tuple(exprs), ordinality)
         return A.Table(self.qualified_name())
 
     # -- expressions (Pratt) ------------------------------------------------
@@ -599,6 +616,27 @@ class _Parser:
 
     def _primary(self) -> A.Expression:
         t = self.peek()
+        # lambda: x -> expr  |  (x, y) -> expr
+        if t.kind in ("IDENT", "QIDENT") and self.peek(1).kind == "OP" \
+                and self.peek(1).text == "->":
+            name = self.identifier()
+            self.expect_op("->")
+            return A.Lambda((name,), self.expression())
+        if t.kind == "OP" and t.text == "(":
+            params = self._try_lambda_params()
+            if params is not None:
+                return A.Lambda(params, self.expression())
+        if t.kind == "IDENT" and t.text.lower() == "array" \
+                and self.peek(1).kind == "OP" and self.peek(1).text == "[":
+            self.next()
+            self.next()
+            items: List[A.Expression] = []
+            if not self.at_op("]"):
+                items.append(self.expression())
+                while self.accept_op(","):
+                    items.append(self.expression())
+            self.expect_op("]")
+            return self._postfix(A.ArrayLiteral(tuple(items)))
         if t.kind == "INTEGER":
             self.next()
             return A.LongLiteral(int(t.text))
@@ -726,6 +764,12 @@ class _Parser:
     def _type_name(self) -> str:
         base = self.identifier() if self.peek().kind != "KEYWORD" \
             else self.next().text
+        if base.lower() in ("array", "map") and self.accept_op("("):
+            args = [self._type_name()]
+            while self.accept_op(","):
+                args.append(self._type_name())
+            self.expect_op(")")
+            return f"{base}({','.join(args)})"
         if self.accept_op("("):
             args = [self.expect_kind("INTEGER").text]
             while self.accept_op(","):
@@ -792,8 +836,33 @@ class _Parser:
         self.expect_op(")")
         return A.WindowFunction(call, tuple(partition), order_by, frame)
 
+    def _try_lambda_params(self) -> Optional[Tuple[str, ...]]:
+        """Consume '(a, b, ...) ->' if present; None (no consumption)
+        otherwise."""
+        save = self.i
+        if not self.accept_op("("):
+            return None
+        names: List[str] = []
+        while self.peek().kind in ("IDENT", "QIDENT"):
+            names.append(self.identifier())
+            if self.accept_op(","):
+                continue
+            break
+        if names and self.accept_op(")") and self.accept_op("->"):
+            return tuple(names)
+        self.i = save
+        return None
+
     def _postfix(self, e: A.Expression) -> A.Expression:
-        while self.at_op(".") and self.peek(1).kind in ("IDENT", "QIDENT"):
-            self.next()
-            e = A.DereferenceExpression(e, A.Identifier(self.identifier()))
-        return e
+        while True:
+            if self.at_op(".") and self.peek(1).kind in ("IDENT", "QIDENT"):
+                self.next()
+                e = A.DereferenceExpression(e, A.Identifier(self.identifier()))
+                continue
+            if self.at_op("["):
+                self.next()
+                idx = self.expression()
+                self.expect_op("]")
+                e = A.Subscript(e, idx)
+                continue
+            return e

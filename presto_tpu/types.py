@@ -28,7 +28,7 @@ batch.Column), like Presto's per-Block isNull arrays.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, ClassVar, Optional
+from typing import Any, ClassVar, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -294,6 +294,71 @@ class CharType(Type):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayType(Type):
+    """ARRAY(T): padded dense device representation (reference
+    spi/type/ArrayType.java + block/ArrayBlock.java's offsets+values,
+    re-designed TPU-first as a [capacity, max_len] tile + per-row lengths
+    so every array op is a static-shape vectorized 2D kernel).
+
+    Column layout for an array column: ``data`` is the tuple
+    (values[cap, L], lengths[cap] int32, elem_valid[cap, L] bool);
+    ``validity`` stays the row-level null mask; ``dictionary`` holds the
+    element vocabulary when the element type is a string."""
+
+    element: Type = None  # type: ignore[assignment]
+    name: ClassVar[str] = "array"
+
+    @property
+    def storage_dtype(self):
+        return self.element.storage_dtype
+
+    def display(self) -> str:
+        return f"array({self.element.display()})"
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(Type):
+    """MAP(K, V): padded dense like ArrayType. Column ``data`` is
+    (keys[cap, L], values[cap, L], lengths[cap], val_valid[cap, L]);
+    keys are never null (SQL map semantics). ``dictionary`` is the tuple
+    (key_vocab, value_vocab) when either side is a string (reference
+    spi/type/MapType.java + block/MapBlock.java)."""
+
+    key: Type = None      # type: ignore[assignment]
+    value: Type = None    # type: ignore[assignment]
+    name: ClassVar[str] = "map"
+
+    @property
+    def storage_dtype(self):
+        return self.value.storage_dtype
+
+    def display(self) -> str:
+        return f"map({self.key.display()}, {self.value.display()})"
+
+
+@dataclasses.dataclass(frozen=True)
+class RowType(Type):
+    """ROW(f1 T1, ...): struct of child columns. Column ``data`` is a
+    tuple of (child_data, child_valid) pairs; ``dictionary`` is a tuple
+    of per-field vocabularies (reference spi/type/RowType.java)."""
+
+    field_types: Tuple[Type, ...] = ()
+    field_names: Tuple[str, ...] = ()
+    name: ClassVar[str] = "row"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32   # unused; children carry their own dtypes
+
+    def display(self) -> str:
+        inner = ", ".join(
+            (f"{n} {t.display()}" if n else t.display())
+            for n, t in zip(self.field_names or [""] * len(self.field_types),
+                            self.field_types))
+        return f"row({inner})"
+
+
+@dataclasses.dataclass(frozen=True)
 class UnknownType(Type):
     """Type of a bare NULL literal."""
 
@@ -390,6 +455,9 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
             return common_super_type(a, DecimalType(int_digits, 0))
         if isinstance(b, DecimalType) and is_integral(a):
             return common_super_type(b, a)
+    if isinstance(a, ArrayType) and isinstance(b, ArrayType):
+        e = common_super_type(a.element, b.element)
+        return ArrayType(e) if e is not None else None
     if a.is_string and b.is_string:
         return VARCHAR
     if isinstance(a, DateType) and isinstance(b, TimestampType):
@@ -404,8 +472,24 @@ def parse_type(text: str) -> Type:
     s = text.strip().lower()
     if "(" in s:
         base, _, rest = s.partition("(")
-        args = [int(x) for x in rest.rstrip(")").split(",")]
         base = base.strip()
+        inner = rest.rstrip()
+        assert inner.endswith(")"), text
+        inner = inner[:-1]
+        if base == "array":
+            return ArrayType(parse_type(inner))
+        if base == "map":
+            depth = 0
+            for i, ch in enumerate(inner):
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                elif ch == "," and depth == 0:
+                    return MapType(parse_type(inner[:i]),
+                                   parse_type(inner[i + 1:]))
+            raise ValueError(f"bad map type {text!r}")
+        args = [int(x) for x in inner.split(",")]
         if base == "decimal":
             return DecimalType(*args)
         if base == "varchar":
