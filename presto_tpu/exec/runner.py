@@ -18,6 +18,7 @@ from ..connectors.memory import MemoryConnector
 from ..connectors.spi import CatalogManager, TableHandle
 from ..connectors.tpch import TpchConnector
 from ..sql import ast as A
+from ..sql.ast import count_parameters, substitute_parameters
 from ..sql.parser import parse_statement
 from ..planner.optimizer import optimize
 from ..planner.planner import LogicalPlan, Session, plan_query
@@ -141,13 +142,23 @@ class LocalRunner:
                                [(c,) for c in session.catalogs.names()])
         if isinstance(stmt, A.ShowTables):
             conn = session.catalogs.get(session.catalog)
+            names = list(conn.metadata.list_tables())
+            names += [v[2] for v in self.session.views
+                      if v[0] == session.catalog
+                      and v[1] == session.schema]
             return QueryResult(
-                ["Table"], [T.VARCHAR],
-                [(t,) for t in conn.metadata.list_tables()])
+                ["Table"], [T.VARCHAR], [(t,) for t in sorted(names)])
         if isinstance(stmt, A.ShowColumns):
             name = stmt.table
             catalog = self.session.catalog if len(name) < 3 else name[-3]
             schema = self.session.schema if len(name) < 2 else name[-2]
+            view = self.session.views.get((catalog, schema, name[-1]))
+            if view is not None:
+                plan = plan_query(view, session)
+                return QueryResult(
+                    ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
+                    [(f.name, f.type.display())
+                     for f in plan.root.fields])
             conn = session.catalogs.get(catalog)
             ts = conn.metadata.table_schema(
                 TableHandle(catalog, schema, name[-1]))
@@ -184,8 +195,87 @@ class LocalRunner:
             conn, table = self._writable(stmt.name, user)
             conn.drop_table(table, if_exists=stmt.if_exists)
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.CreateView):
+            key = self._object_key(stmt.name)
+            if key in self.session.views and not stmt.or_replace:
+                raise ValueError(f"view {'.'.join(key)} already exists")
+            try:
+                existing = session.catalogs.get(
+                    key[0]).metadata.list_tables()
+            except Exception:
+                existing = ()
+            if key[2] in existing:
+                raise ValueError(
+                    f"table {'.'.join(key)} already exists (a view "
+                    "cannot shadow a table)")
+            # validate now: a broken view should fail CREATE, not SELECT
+            plan_query(stmt.query, session)
+            self.session.views[key] = stmt.query
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.DropView):
+            key = self._object_key(stmt.name)
+            if key not in self.session.views:
+                if stmt.if_exists:
+                    return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+                raise ValueError(f"view {'.'.join(key)} does not exist")
+            del self.session.views[key]
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.Prepare):
+            if isinstance(stmt.statement, (A.Prepare, A.ExecuteStmt,
+                                           A.Deallocate)):
+                raise ValueError(
+                    "cannot prepare PREPARE/EXECUTE/DEALLOCATE statements")
+            self.session.prepared[stmt.name] = stmt.statement
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.Deallocate):
+            if self.session.prepared.pop(stmt.name, None) is None:
+                raise ValueError(
+                    f"prepared statement {stmt.name!r} not found")
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.ExecuteStmt):
+            prepared = self.session.prepared.get(stmt.name)
+            if prepared is None:
+                raise ValueError(
+                    f"prepared statement {stmt.name!r} not found")
+            want = count_parameters(prepared)
+            if len(stmt.args) != want:
+                raise ValueError(
+                    f"Incorrect number of parameters: expected {want} "
+                    f"but found {len(stmt.args)}")
+            bound = substitute_parameters(prepared, list(stmt.args))
+            return self._execute_stmt(bound, properties, user)
+        if isinstance(stmt, A.DescribeOutput):
+            prepared = self.session.prepared.get(stmt.name)
+            if prepared is None:
+                raise ValueError(
+                    f"prepared statement {stmt.name!r} not found")
+            if not isinstance(prepared, A.Query):
+                return QueryResult(["Column Name", "Type"],
+                                   [T.VARCHAR, T.VARCHAR], [])
+            # bind NULL for parameters: output shape doesn't depend on them
+            n_params = count_parameters(prepared)
+            bound = substitute_parameters(
+                prepared, [A.NullLiteral()] * n_params)
+            plan = optimize(plan_query(bound, session), session)
+            root = plan.root
+            return QueryResult(
+                ["Column Name", "Type"], [T.VARCHAR, T.VARCHAR],
+                [(f.name, f.type.display()) for f in root.fields])
+        if isinstance(stmt, A.DescribeInput):
+            prepared = self.session.prepared.get(stmt.name)
+            if prepared is None:
+                raise ValueError(
+                    f"prepared statement {stmt.name!r} not found")
+            n = count_parameters(prepared)
+            return QueryResult(["Position", "Type"], [T.BIGINT, T.VARCHAR],
+                               [(i, "unknown") for i in range(n)])
         raise NotImplementedError(
             f"statement {type(stmt).__name__} is not supported yet")
+
+    def _object_key(self, name) -> tuple:
+        catalog = self.session.catalog if len(name) < 3 else name[-3]
+        schema = self.session.schema if len(name) < 2 else name[-2]
+        return (catalog, schema, name[-1])
 
     # -- write path (reference TableWriterOperator + finishInsert) ----------
     def _writable(self, name, user: str = ""):

@@ -51,6 +51,13 @@ class Session:
     catalog: str = "tpch"
     schema: str = "default"
     properties: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # logical views: (catalog, schema, name) -> stored A.Query, expanded
+    # at plan time (reference metadata views / ConnectorViewDefinition)
+    views: Dict[Tuple[str, str, str], object] = dataclasses.field(
+        default_factory=dict)
+    # prepared statements: name -> statement AST (reference
+    # Session.preparedStatements + PrepareTask)
+    prepared: Dict[str, object] = dataclasses.field(default_factory=dict)
     # filled by the executor: memory.MemoryStats of the last query
     last_memory_stats: object = None
 
@@ -67,6 +74,7 @@ class _Planner:
         self.ctes: Dict[str, PlanNode] = {}
         self.init_plans: List[PlanNode] = []
         self._ids = itertools.count()
+        self._view_stack: List[Tuple[str, str, str]] = []
 
     # -- entry ---------------------------------------------------------------
     def plan_root(self, query: A.Query) -> OutputNode:
@@ -184,6 +192,24 @@ class _Planner:
             catalog, schema, table = self.session.catalog, name[0], name[1]
         else:
             catalog, schema, table = name[-3], name[-2], name[-1]
+        view_key = (catalog, schema, table)
+        view = self.session.views.get(view_key)
+        if view is not None:
+            # view expansion (reference StatementAnalyzer view handling):
+            # plan the stored query, alias columns under the view name
+            if view_key in self._view_stack:
+                raise AnalysisError(
+                    f"view {'.'.join(view_key)} is recursive")
+            self._view_stack.append(view_key)
+            # the view body resolves names in ITS OWN scope: the caller's
+            # WITH aliases must not capture tables inside the view
+            outer_ctes, self.ctes = self.ctes, {}
+            try:
+                inner = self.plan_query_node(view)
+            finally:
+                self.ctes = outer_ctes
+                self._view_stack.pop()
+            return _realias(inner, table, ())
         conn = self.session.catalogs.get(catalog)
         handle = TableHandle(catalog, schema, table)
         table_schema = conn.metadata.table_schema(handle)

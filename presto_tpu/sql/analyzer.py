@@ -580,6 +580,11 @@ class ExpressionAnalyzer:
             return T.ArrayType(ts[0].value)
         return None
 
+    def _Parameter(self, node):
+        raise AnalysisError(
+            "unbound ? parameter (only valid inside PREPARE; bind with "
+            "EXECUTE ... USING)")
+
     def _ScalarSubquery(self, node):
         raise AnalysisError("scalar subquery must be planned (init plan)")
 

@@ -173,6 +173,13 @@ class FunctionCall(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class Parameter(Expression):
+    """Positional ? parameter in a prepared statement
+    (reference sql/tree/Parameter.java)."""
+    index: int
+
+
+@dataclasses.dataclass(frozen=True)
 class ArrayLiteral(Expression):
     """ARRAY[e1, e2, ...] (reference sql/tree/ArrayConstructor.java)."""
     items: Tuple[Expression, ...]
@@ -414,6 +421,101 @@ class CreateTableAsSelect(Node):
 class DropTable(Node):
     name: Tuple[str, ...]
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW name AS query (reference
+    sql/tree/CreateView.java; the parsed query is the stored
+    ConnectorViewDefinition analogue)."""
+    name: Tuple[str, ...]
+    query: "Query"
+    or_replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropView(Node):
+    name: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    """PREPARE name FROM statement (reference sql/tree/Prepare.java)."""
+    name: str
+    statement: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecuteStmt(Node):
+    """EXECUTE name [USING expr, ...] (reference sql/tree/Execute.java)."""
+    name: str
+    args: Tuple[Expression, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DescribeOutput(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DescribeInput(Node):
+    name: str
+
+
+# ---------------------------------------------------------------------------
+# Prepared-statement parameter binding (reference
+# sql/planner/ParameterRewriter.java over sql/tree nodes)
+# ---------------------------------------------------------------------------
+
+def substitute_parameters(node, values):
+    """Replace Parameter(i) nodes with the i-th bound expression,
+    rebuilding the immutable AST."""
+    def walk(n):
+        if isinstance(n, Parameter):
+            if n.index >= len(values):
+                raise ValueError(
+                    "Incorrect number of parameters: expected at least "
+                    f"{n.index + 1} but found {len(values)}")
+            return values[n.index]
+        if dataclasses.is_dataclass(n) and not isinstance(n, type):
+            changes = {}
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                nv = walk(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            return dataclasses.replace(n, **changes) if changes else n
+        if isinstance(n, tuple):
+            out = tuple(walk(x) for x in n)
+            return out if any(a is not b for a, b in zip(out, n)) else n
+        if isinstance(n, list):
+            return [walk(x) for x in n]
+        return n
+    return walk(node)
+
+
+def count_parameters(node) -> int:
+    """Highest parameter ordinal + 1 in a statement AST."""
+    best = 0
+
+    def walk(n):
+        nonlocal best
+        if isinstance(n, Parameter):
+            best = max(best, n.index + 1)
+        if dataclasses.is_dataclass(n) and not isinstance(n, type):
+            for f in dataclasses.fields(n):
+                walk(getattr(n, f.name))
+        elif isinstance(n, (tuple, list)):
+            for x in n:
+                walk(x)
+    walk(node)
+    return best
 
 
 @dataclasses.dataclass(frozen=True)

@@ -114,6 +114,13 @@ class _Parser:
             return self._show()
         if self.at_kw("describe"):
             self.next()
+            t = self.peek()
+            if t.kind == "IDENT" and t.text.lower() in ("input", "output") \
+                    and self.peek(1).kind in ("IDENT", "QIDENT"):
+                kind = self.next().text.lower()
+                name = self.identifier()
+                return (A.DescribeInput(name) if kind == "input"
+                        else A.DescribeOutput(name))
             return A.ShowColumns(self.qualified_name())
         if self.at_kw("set"):
             self.next()
@@ -160,12 +167,43 @@ class _Parser:
             return self._create()
         if self.at_kw("drop"):
             self.next()
-            self.expect_kw("table")
+            is_view = False
+            if self.peek().kind == "IDENT" \
+                    and self.peek().text.lower() == "view":
+                self.next()
+                is_view = True
+            else:
+                self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):
                 self.expect_kw("exists")
                 if_exists = True
-            return A.DropTable(self.qualified_name(), if_exists)
+            name = self.qualified_name()
+            return (A.DropView(name, if_exists) if is_view
+                    else A.DropTable(name, if_exists))
+        if self.peek().kind == "IDENT" \
+                and self.peek().text.lower() == "prepare":
+            self.next()
+            name = self.identifier()
+            self.expect_kw("from")
+            return A.Prepare(name, self.statement())
+        if self.peek().kind == "IDENT" \
+                and self.peek().text.lower() == "execute":
+            self.next()
+            name = self.identifier()
+            args: List[A.Expression] = []
+            if self.accept_kw("using"):
+                args.append(self.expression())
+                while self.accept_op(","):
+                    args.append(self.expression())
+            return A.ExecuteStmt(name, tuple(args))
+        if self.peek().kind == "IDENT" \
+                and self.peek().text.lower() == "deallocate":
+            self.next()
+            t = self.next()
+            if t.text.lower() != "prepare":
+                raise SqlSyntaxError("expected PREPARE", t.line, t.col)
+            return A.Deallocate(self.identifier())
         if self.at_kw("insert"):
             self.next()
             self.expect_kw("into")
@@ -205,6 +243,23 @@ class _Parser:
 
     def _create(self) -> A.Node:
         self.expect_kw("create")
+        or_replace = False
+        if self.accept_kw("or"):
+            t = self.next()
+            if t.text.lower() != "replace":
+                raise SqlSyntaxError("expected REPLACE", t.line, t.col)
+            or_replace = True
+        if self.peek().kind == "IDENT" \
+                and self.peek().text.lower() == "view":
+            self.next()
+            name = self.qualified_name()
+            self.expect_kw("as")
+            q = self.query()
+            return A.CreateView(name, q, or_replace=or_replace)
+        if or_replace:
+            t = self.peek()
+            raise SqlSyntaxError("OR REPLACE only applies to CREATE VIEW",
+                                 t.line, t.col)
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -616,6 +671,12 @@ class _Parser:
 
     def _primary(self) -> A.Expression:
         t = self.peek()
+        if t.kind == "OP" and t.text == "?":
+            self.next()
+            self._param_count = getattr(self, "_param_count", 0)
+            idx = self._param_count
+            self._param_count += 1
+            return A.Parameter(idx)
         # lambda: x -> expr  |  (x, y) -> expr
         if t.kind in ("IDENT", "QIDENT") and self.peek(1).kind == "OP" \
                 and self.peek(1).text == "->":

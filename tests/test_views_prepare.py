@@ -1,0 +1,152 @@
+"""Views and prepared statements (reference sql/tree/CreateView.java,
+Prepare.java, Execute.java, ParameterRewriter.java; view expansion in
+StatementAnalyzer)."""
+import pytest
+
+
+@pytest.fixture()
+def runner():
+    from presto_tpu.exec.runner import LocalRunner
+    return LocalRunner(tpch_sf=0.001)
+
+
+def test_create_select_drop_view(runner):
+    runner.execute("create view v1 as select n_name, n_regionkey "
+                   "from nation where n_nationkey < 5")
+    assert runner.execute("select count(*) from v1").rows == [(5,)]
+    rows = runner.execute(
+        "select v1.n_name from v1 join region "
+        "on v1.n_regionkey = region.r_regionkey "
+        "where region.r_name = 'AMERICA' order by 1").rows
+    assert [r[0] for r in rows] == ["ARGENTINA", "BRAZIL", "CANADA"]
+    runner.execute("drop view v1")
+    with pytest.raises(Exception):
+        runner.execute("select * from v1")
+
+
+def test_view_over_view(runner):
+    runner.execute("create view a_nations as "
+                   "select * from nation where n_name like 'A%'")
+    runner.execute("create view al_nations as "
+                   "select * from a_nations where n_name like 'AL%'")
+    assert runner.execute(
+        "select n_name from al_nations").rows == [("ALGERIA",)]
+
+
+def test_or_replace(runner):
+    runner.execute("create view v as select 1 as x")
+    with pytest.raises(ValueError, match="already exists"):
+        runner.execute("create view v as select 2 as x")
+    runner.execute("create or replace view v as select 2 as x")
+    assert runner.execute("select x from v").rows == [(2,)]
+
+
+def test_drop_view_if_exists(runner):
+    runner.execute("drop view if exists nope")
+    with pytest.raises(ValueError, match="does not exist"):
+        runner.execute("drop view nope")
+
+
+def test_broken_view_fails_at_create(runner):
+    with pytest.raises(Exception):
+        runner.execute("create view bad as select no_such_col from nation")
+
+
+def test_view_shows_in_show_tables(runner):
+    runner.execute("create view zzz_view as select 1 as x")
+    names = [r[0] for r in runner.execute("show tables").rows]
+    assert "zzz_view" in names
+
+
+def test_prepare_execute(runner):
+    runner.execute("prepare q1 from "
+                   "select n_name from nation where n_nationkey = ?")
+    assert runner.execute("execute q1 using 3").rows == [("CANADA",)]
+    assert runner.execute("execute q1 using 4").rows == [("EGYPT",)]
+
+
+def test_prepare_multiple_params(runner):
+    runner.execute("prepare q2 from select n_name from nation "
+                   "where n_nationkey = ? or n_name = ? order by 1")
+    assert runner.execute("execute q2 using 3, 'PERU'").rows \
+        == [("CANADA",), ("PERU",)]
+
+
+def test_prepare_no_params(runner):
+    runner.execute("prepare q3 from select count(*) from region")
+    assert runner.execute("execute q3").rows == [(5,)]
+
+
+def test_describe_input_output(runner):
+    runner.execute("prepare q4 from select n_name, n_nationkey + ? as k "
+                   "from nation where n_regionkey = ?")
+    rows = runner.execute("describe input q4").rows
+    assert len(rows) == 2
+    out = runner.execute("describe output q4").rows
+    assert [r[0] for r in out] == ["n_name", "k"]
+
+
+def test_deallocate(runner):
+    runner.execute("prepare q5 from select 1")
+    runner.execute("deallocate prepare q5")
+    with pytest.raises(ValueError, match="not found"):
+        runner.execute("execute q5")
+
+
+def test_too_few_parameters(runner):
+    runner.execute("prepare q6 from "
+                   "select * from nation where n_nationkey = ?")
+    with pytest.raises(ValueError, match="parameters"):
+        runner.execute("execute q6")
+
+
+def test_unbound_parameter_rejected(runner):
+    from presto_tpu.sql.analyzer import AnalysisError
+    with pytest.raises(AnalysisError, match="unbound"):
+        runner.execute("select * from nation where n_nationkey = ?")
+
+
+def test_view_not_captured_by_outer_cte(runner):
+    runner.execute("create view vcnt as select count(*) as c from nation")
+    rows = runner.execute(
+        "with nation as (select 1 as x) select * from vcnt").rows
+    assert rows == [(25,)]
+
+
+def test_view_cannot_shadow_table(runner):
+    with pytest.raises(ValueError, match="shadow"):
+        runner.execute("create view nation as select 1 as x")
+
+
+def test_prepare_of_execute_rejected(runner):
+    with pytest.raises(ValueError, match="cannot prepare"):
+        runner.execute("prepare p from execute p")
+
+
+def test_describe_view(runner):
+    runner.execute("create view dv as select n_name, n_nationkey + 1 as k "
+                   "from nation")
+    rows = runner.execute("describe dv").rows
+    assert [r[0] for r in rows] == ["n_name", "k"]
+
+
+def test_too_many_parameters(runner):
+    runner.execute("prepare q7 from select ? as a")
+    with pytest.raises(ValueError, match="expected 1 but found 3"):
+        runner.execute("execute q7 using 1, 2, 3")
+
+
+def test_or_replace_table_rejected(runner):
+    from presto_tpu.sql.lexer import SqlSyntaxError
+    with pytest.raises(SqlSyntaxError, match="OR REPLACE"):
+        runner.execute("create or replace table memory.default.t "
+                       "as select 1 as x")
+
+
+def test_prepare_insert(runner):
+    runner.execute("create table memory.default.pt as select 1 as x")
+    runner.execute("prepare ins from "
+                   "insert into memory.default.pt select ?")
+    runner.execute("execute ins using 42")
+    assert runner.execute(
+        "select sum(x) from memory.default.pt").rows == [(43,)]
