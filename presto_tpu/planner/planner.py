@@ -62,6 +62,38 @@ class Session:
     last_memory_stats: object = None
 
 
+def _const_value(e: ir.Expr):
+    """Evaluate a constant expression to its python value (VALUES cells,
+    which may be arbitrary constant expressions: casts, arithmetic,
+    ARRAY[...] constructors — reference ExpressionInterpreter's role)."""
+    if isinstance(e, ir.Literal):
+        return e.value
+    import jax.numpy as jnp
+
+    from ..batch import Batch, Column, Schema
+    from ..errors import QueryError
+    from ..expr.compiler import eval_expr
+    from ..expr.functions import Val
+
+    carrier = Val(jnp.ones(1, dtype=bool), jnp.ones(1, dtype=bool),
+                  T.BOOLEAN)
+    try:
+        v = eval_expr(e, [carrier])
+    except (KeyError, NotImplementedError) as exc:
+        raise AnalysisError(
+            f"VALUES cell is not a supported constant expression: {exc}")
+    if v.err is not None:
+        code = int(jnp.max(v.err))
+        if code:
+            raise QueryError(code)
+    mask = jnp.ones(v.valid.shape[0], dtype=bool)
+    b = Batch(Schema([("c", e.type)]),
+              [Column(e.type, v.data, v.valid, v.dictionary)], mask)
+    out = b.to_pylist()[0][0]
+    # plan nodes are hashable dataclasses: array values ride as tuples
+    return tuple(out) if isinstance(out, list) else out
+
+
 def plan_query(query: A.Query, session: Session) -> LogicalPlan:
     planner = _Planner(session)
     root = planner.plan_root(query)
@@ -101,7 +133,42 @@ class _Planner:
             return self.plan_set_op(body)
         if isinstance(body, A.Query):   # parenthesized query term
             return self.plan_query_node(body)
+        if isinstance(body, A.ValuesQuery):
+            return self.plan_values(body)
         raise AnalysisError(f"unsupported query body {type(body).__name__}")
+
+    def plan_values(self, v: A.ValuesQuery) -> PlanNode:
+        """VALUES rows -> ValuesNode: cells analyze in an empty scope and
+        must fold to constants (reference sql/tree/Values.java + the
+        analyzer's row-type derivation)."""
+        if not v.rows:
+            raise AnalysisError("VALUES needs at least one row")
+        n_cols = len(v.rows[0])
+        analyzer = ExpressionAnalyzer(Scope(()))
+        cells: List[List[ir.Expr]] = []
+        for row in v.rows:
+            if len(row) != n_cols:
+                raise AnalysisError("VALUES rows differ in arity")
+            cells.append([analyzer.analyze(e) for e in row])
+        col_types: List[T.Type] = []
+        for c in range(n_cols):
+            t: T.Type = T.UNKNOWN
+            for row in cells:
+                nxt = T.common_super_type(t, row[c].type)
+                if nxt is None:
+                    raise AnalysisError(
+                        f"VALUES column {c + 1} has incompatible types")
+                t = nxt
+            col_types.append(t)
+        out_rows = []
+        for row in cells:
+            vals = []
+            for c in range(n_cols):
+                vals.append(_const_value(coerce(row[c], col_types[c])))
+            out_rows.append(tuple(vals))
+        fields = tuple(Field(f"_col{c}", col_types[c])
+                       for c in range(n_cols))
+        return ValuesNode(fields=fields, rows=tuple(out_rows))
 
     def plan_set_op(self, op: A.SetOperation) -> PlanNode:
         if op.op != "union":

@@ -336,9 +336,16 @@ class QuerySpecification(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ValuesQuery(Node):
+    """VALUES (e, ...), ... as a query body (reference
+    sql/tree/Values.java — the inlineTable rule)."""
+    rows: Tuple[Tuple[Expression, ...], ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class Query(Node):
     """Top-level query: body plus WITH bindings."""
-    body: Node                     # QuerySpecification | SetOperation
+    body: Node                     # QuerySpecification | SetOperation | ValuesQuery
     with_: Tuple[Tuple[str, "Query"], ...] = ()
 
 

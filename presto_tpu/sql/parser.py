@@ -291,6 +291,8 @@ class _Parser:
         limit = self._limit()
         if order_by or limit is not None:
             import dataclasses as _dc
+            if isinstance(body, A.ValuesQuery):
+                body = A.Query(body=body)
             if isinstance(body, A.Query):
                 # '(query) ORDER BY ...': order the parenthesized result —
                 # wrap as a subquery so an inner LIMIT/WITH is preserved
@@ -320,7 +322,21 @@ class _Parser:
             q = self.query()          # queryPrimary: '(' queryNoWith ')'
             self.expect_op(")")
             return q
+        if self.accept_kw("values"):
+            rows = [self._values_row()]
+            while self.accept_op(","):
+                rows.append(self._values_row())
+            return A.ValuesQuery(tuple(rows))
         return self.query_spec()
+
+    def _values_row(self) -> Tuple[A.Expression, ...]:
+        if self.accept_op("("):
+            items = [self.expression()]
+            while self.accept_op(","):
+                items.append(self.expression())
+            self.expect_op(")")
+            return tuple(items)
+        return (self.expression(),)
 
     def query_spec(self) -> A.QuerySpecification:
         self.expect_kw("select")
@@ -537,7 +553,7 @@ class _Parser:
 
     def _primary_relation(self) -> A.Relation:
         if self.accept_op("("):
-            if self.at_kw("select", "with") or self.at_op("("):
+            if self.at_kw("select", "with", "values") or self.at_op("("):
                 q = self.query()
                 self.expect_op(")")
                 return A.SubqueryRelation(q)

@@ -120,10 +120,9 @@ def test_truncate_scale(runner):
 def test_json_extract_dedupes_codes(runner):
     # equal extracted values must share one dictionary code: GROUP BY
     # over the extraction must merge them
-    runner.execute("create table memory.default.js as "
-                   "select '{\"a\": 1, \"z\": 9}' as doc "
-                   "union all select '{\"a\": 1}' "
-                   "union all select '{\"a\": 2}'")
+    runner.execute("create table memory.default.js as select * from "
+                   "(values ('{\"a\": 1, \"z\": 9}'), ('{\"a\": 1}'), "
+                   "('{\"a\": 2}')) as t(doc)")
     rows = runner.execute(
         "select json_extract_scalar(doc, '$.a') v, count(*) "
         "from memory.default.js group by 1 order by 1").rows

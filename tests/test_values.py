@@ -1,0 +1,99 @@
+"""VALUES as a query body / inline table (reference sql/tree/Values.java,
+SqlBase.g4 inlineTable) with derived-table column aliases."""
+import datetime
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.exec.runner import LocalRunner
+    return LocalRunner(tpch_sf=0.001)
+
+
+def test_bare_values(runner):
+    assert runner.execute("values 1, 2, 3").rows == [(1,), (2,), (3,)]
+
+
+def test_values_rows(runner):
+    assert runner.execute("values (1, 'a'), (2, 'b')").rows \
+        == [(1, "a"), (2, "b")]
+
+
+def test_values_as_relation_with_aliases(runner):
+    rows = runner.execute(
+        "select name from (values (1, 'a'), (2, 'b')) as t(id, name) "
+        "where id = 2").rows
+    assert rows == [("b",)]
+
+
+def test_values_order_limit(runner):
+    rows = runner.execute("values 3, 1, 2 order by 1 limit 2").rows
+    assert rows == [(1,), (2,)]
+
+
+def test_values_join(runner):
+    rows = runner.execute(
+        "select n.n_name, v.tag from nation n "
+        "join (values (0, 'zero'), (1, 'one')) v(k, tag) "
+        "on n.n_nationkey = v.k order by 1").rows
+    assert rows == [("ALGERIA", "zero"), ("ARGENTINA", "one")]
+
+
+def test_values_types_unify(runner):
+    rows = runner.execute("values (1, null), (null, 'x')").rows
+    assert rows == [(1, None), (None, "x")]
+
+
+def test_values_dates(runner):
+    rows = runner.execute("values date '2020-01-01'").rows
+    assert rows == [(datetime.date(2020, 1, 1),)]
+
+
+def test_values_union(runner):
+    rows = runner.execute(
+        "select * from (values 1) union all "
+        "select * from (values 2) order by 1").rows
+    assert rows == [(1,), (2,)]
+
+
+def test_values_arity_mismatch(runner):
+    from presto_tpu.sql.analyzer import AnalysisError
+    with pytest.raises(AnalysisError, match="arity"):
+        runner.execute("values (1, 2), (3)")
+
+
+def test_values_incompatible_types(runner):
+    from presto_tpu.sql.analyzer import AnalysisError
+    with pytest.raises(AnalysisError, match="incompatible"):
+        runner.execute("values (1), ('x')")
+
+
+def test_values_constant_expressions(runner):
+    assert runner.execute("values (1+1), (10/2)").rows == [(2,), (5,)]
+    assert runner.execute("values upper('ab') || 'c'").rows == [("ABc",)]
+
+
+def test_values_date_timestamp_coercion(runner):
+    rows = runner.execute(
+        "values (date '2020-01-01'), "
+        "(timestamp '2020-01-02 03:00:00')").rows
+    assert rows == [(datetime.datetime(2020, 1, 1),),
+                    (datetime.datetime(2020, 1, 2, 3, 0),)]
+
+
+def test_values_arrays(runner):
+    rows = runner.execute(
+        "select x[2] from (values (array[1,2,3]), (array[4,5,6])) t(x)").rows
+    assert rows == [(2,), (5,)]
+    rows = runner.execute(
+        "select sum(e) from (values (array[1,2])) t(x), "
+        "unnest(t.x) u(e)").rows
+    assert rows == [(3,)]
+
+
+def test_values_ctas(runner):
+    runner.execute("create table memory.default.vals_t as "
+                   "select * from (values (1, 'x'), (2, 'y')) t(a, b)")
+    assert runner.execute(
+        "select b from memory.default.vals_t where a = 2").rows == [("y",)]
