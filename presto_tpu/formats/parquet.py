@@ -1,0 +1,705 @@
+"""Parquet file reader: host metadata/pruning, device column decode.
+
+Port of concept from the reference's from-scratch Parquet reader
+(reference presto-parquet/.../reader/ParquetReader.java + per-type
+PrimitiveColumnReader, RunLengthBitPackingHybridDecoder,
+predicate/TupleDomainParquetPredicate.java row-group pruning). TPU-first
+split, mirroring formats/orc.py: footer/page-header parsing and
+row-group pruning stay on host; the bulk decode of the RLE/bit-packed
+hybrid (dictionary indices, definition levels, booleans) runs as one
+vectorized device kernel over the raw page bytes, and dictionary-encoded
+string columns land directly as engine dictionary codes — Parquet's
+dictionary IS the engine's vocab, no re-encoding.
+
+Supported: flat schemas over BOOLEAN/INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY
+(+DATE/TIMESTAMP/DECIMAL/UTF8 logical types), V1 data pages,
+PLAIN + PLAIN_DICTIONARY/RLE_DICTIONARY encodings, UNCOMPRESSED or GZIP
+codecs, nulls via definition levels, row-group min/max pruning.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import zlib
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..batch import Batch, Column, Schema, bucket_capacity
+from . import thrift_compact as tc
+
+MAGIC = b"PAR1"
+
+# physical types (parquet.thrift Type)
+P_BOOLEAN, P_INT32, P_INT64, P_INT96, P_FLOAT, P_DOUBLE, P_BYTE_ARRAY, \
+    P_FIXED = range(8)
+# encodings
+E_PLAIN, _, E_PLAIN_DICT, E_RLE, E_BIT_PACKED = 0, 1, 2, 3, 4
+E_RLE_DICT = 8
+# codecs
+C_UNCOMPRESSED, C_SNAPPY, C_GZIP = 0, 1, 2
+# converted types
+CT_UTF8, CT_DECIMAL, CT_DATE = 0, 5, 6
+CT_TS_MILLIS, CT_TS_MICROS = 9, 10
+
+
+@dataclasses.dataclass
+class ParquetColumn:
+    name: str
+    type: T.Type
+    physical: int
+    converted: Optional[int]
+    optional: bool
+    scale: int = 0
+    # timestamp unit -> engine micros: multiply by max(m,1), divide by
+    # max(-m,1) (millis: 1000, micros: 1, nanos: -1000)
+    ts_mult: int = 1
+
+
+@dataclasses.dataclass
+class ChunkInfo:
+    offset: int                  # first page offset (dict page if any)
+    total_size: int
+    codec: int
+    num_values: int
+    min_val: Optional[object] = None
+    max_val: Optional[object] = None
+    null_count: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RowGroupInfo:
+    num_rows: int
+    chunks: Dict[str, ChunkInfo]
+
+
+def _engine_type(el: Dict[int, object]) -> Tuple[T.Type, int]:
+    # SchemaElement fields (parquet.thrift): 1 type, 3 repetition,
+    # 4 name, 6 converted_type, 7 scale, 8 precision, 10 logicalType
+    phys = el.get(1)
+    conv = el.get(6)
+    scale = el.get(7, 0)
+    precision = el.get(8, 0)
+    logical = el.get(10) or {}
+    if conv == CT_DECIMAL and phys in (P_INT32, P_INT64):
+        return T.DecimalType(precision or 18, scale or 0), scale or 0
+    if phys == P_BOOLEAN:
+        return T.BOOLEAN, 0
+    if phys == P_INT32:
+        if conv == CT_DATE or 6 in logical:
+            return T.DATE, 0
+        return T.INTEGER, 0
+    if phys == P_INT64:
+        if conv in (CT_TS_MILLIS, CT_TS_MICROS) or 8 in logical:
+            return T.TIMESTAMP, 0
+        if 6 in logical:      # logical-only DATE on int64 (unusual)
+            return T.DATE, 0
+        return T.BIGINT, 0
+    if phys in (P_FLOAT, P_DOUBLE):
+        return T.DOUBLE, 0
+    if phys == P_BYTE_ARRAY:
+        return T.VARCHAR, 0
+    raise NotImplementedError(f"parquet physical type {phys}")
+
+
+# ---------------------------------------------------------------------------
+# RLE / bit-packed hybrid: host header scan + device expansion
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class HybridRuns:
+    """Flat per-run decode parameters (device-uploadable)."""
+
+    out_start: np.ndarray        # int64[r]
+    is_packed: np.ndarray        # bool[r]
+    values: np.ndarray           # int64[r]   RLE value
+    bit_start: np.ndarray        # int64[r]   absolute payload bit offset
+
+
+def scan_hybrid(data: bytes, n: int, width: int,
+                pos: int = 0) -> Tuple[HybridRuns, int]:
+    """Host scan of RLE/bit-packed hybrid run headers: O(runs)."""
+    out_start: List[int] = []
+    packed: List[bool] = []
+    values: List[int] = []
+    bit_start: List[int] = []
+    nbytes = (width + 7) // 8
+    out = 0
+    while out < n:
+        header, pos = tc._varint(data, pos)
+        if header & 1:                     # bit-packed group
+            count = (header >> 1) * 8
+            out_start.append(out)
+            packed.append(True)
+            values.append(0)
+            bit_start.append(pos * 8)
+            pos += (count * width) // 8
+            out += count                   # may exceed n (padding group)
+        else:                              # RLE run
+            count = header >> 1
+            v = int.from_bytes(data[pos:pos + nbytes], "little")
+            pos += nbytes
+            out_start.append(out)
+            packed.append(False)
+            values.append(v)
+            bit_start.append(0)
+            out += count
+    return HybridRuns(
+        out_start=np.asarray(out_start or [0], dtype=np.int64),
+        is_packed=np.asarray(packed or [False], dtype=bool),
+        values=np.asarray(values or [0], dtype=np.int64),
+        bit_start=np.asarray(bit_start or [0], dtype=np.int64),
+    ), pos
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("width", "cap"))
+def _expand_hybrid(stream: jnp.ndarray, out_start: jnp.ndarray,
+                   is_packed: jnp.ndarray, values: jnp.ndarray,
+                   bit_start: jnp.ndarray, width: int,
+                   cap: int) -> jnp.ndarray:
+    """Device expansion: j -> its run via searchsorted, then either the
+    run's RLE value or an LSB-first bit-gather from the raw page bytes
+    (the TPU form of RunLengthBitPackingHybridDecoder's inner loop)."""
+    j = jnp.arange(cap, dtype=jnp.int64)
+    run = jnp.clip(jnp.searchsorted(out_start, j, side="right") - 1,
+                   0, out_start.shape[0] - 1)
+    rel = j - jnp.take(out_start, run)
+    bit = jnp.take(bit_start, run) + rel * width
+    byte0 = bit >> 3
+    shift = (bit & 7).astype(jnp.int64)
+    acc = jnp.zeros(cap, dtype=jnp.int64)
+    for k in range(5):                      # width <= 32 spans <= 5 bytes
+        b = jnp.take(stream, jnp.clip(byte0 + k, 0, stream.shape[0] - 1),
+                     axis=0).astype(jnp.int64)
+        acc = acc | (b << (8 * k))
+    mask = (jnp.int64(1) << width) - 1 if width < 63 else jnp.int64(-1)
+    unpacked = (acc >> shift) & mask
+    return jnp.where(jnp.take(is_packed, run), unpacked,
+                     jnp.take(values, run))
+
+
+def decode_hybrid_device(data: bytes, n: int, width: int, cap: int,
+                         pos: int = 0) -> jnp.ndarray:
+    if width == 0:
+        return jnp.zeros(cap, dtype=jnp.int64)
+    runs, _ = scan_hybrid(data, n, width, pos)
+    scap = bucket_capacity(len(data) + 8, minimum=256)
+    stream = np.zeros(scap, dtype=np.uint8)
+    stream[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+    rcap = bucket_capacity(len(runs.out_start), minimum=16)
+
+    def pad(a, fill=0):
+        out = np.full(rcap, fill, dtype=a.dtype)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    return _expand_hybrid(
+        jnp.asarray(stream),
+        pad(runs.out_start, fill=np.iinfo(np.int64).max),
+        pad(runs.is_packed), pad(runs.values), pad(runs.bit_start),
+        width, cap)
+
+
+def _bitwidth(v: int) -> int:
+    return max(int(v).bit_length(), 1) if v > 0 else 1
+
+
+# ---------------------------------------------------------------------------
+# Reader
+# ---------------------------------------------------------------------------
+
+class ParquetReader:
+    """One file: parsed footer + per-row-group device decode."""
+
+    def __init__(self, path: str):
+        self.path = path
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(size - 8, 0))
+            tail = f.read(8)
+            if tail[4:] != MAGIC:
+                raise ValueError(f"{path}: not a parquet file")
+            meta_len = struct.unpack("<I", tail[:4])[0]
+            f.seek(size - 8 - meta_len)
+            meta = f.read(meta_len)
+        fm, _ = tc.read_struct(meta)
+        elements = fm[2]
+        self.num_rows = fm.get(3, 0)
+        self.columns: List[ParquetColumn] = []
+        root = elements[0]
+        n_children = root.get(5, 0)
+        if n_children != len(elements) - 1:
+            raise NotImplementedError("nested parquet schemas")
+        for el in elements[1:]:
+            typ, scale = _engine_type(el)
+            name = el[4].decode() if isinstance(el[4], bytes) else el[4]
+            conv = el.get(6)
+            ts_mult = 1
+            if typ is T.TIMESTAMP:
+                if conv == CT_TS_MILLIS:
+                    ts_mult = 1000
+                else:
+                    ts = (el.get(10) or {}).get(8) or {}
+                    unit = ts.get(2) or {}
+                    if 1 in unit:            # MILLIS
+                        ts_mult = 1000
+                    elif 3 in unit:          # NANOS
+                        ts_mult = -1000
+            self.columns.append(ParquetColumn(
+                name=name, type=typ, physical=el.get(1),
+                converted=conv,
+                optional=el.get(3, 0) == 1, scale=scale,
+                ts_mult=ts_mult))
+        self.schema = Schema([(c.name, c.type) for c in self.columns])
+        self.row_groups: List[RowGroupInfo] = []
+        for rg in fm.get(4, ()):
+            chunks: Dict[str, ChunkInfo] = {}
+            for cc in rg[1]:
+                md = cc[3]
+                path_in_schema = [
+                    p.decode() if isinstance(p, bytes) else p
+                    for p in md[3]]
+                name = path_in_schema[0]
+                offset = md.get(11) or md[9]
+                stats = md.get(12) or {}
+                col = next(c for c in self.columns if c.name == name)
+                mn, mx = _decode_stat(stats, col)
+                chunks[name] = ChunkInfo(
+                    offset=offset, total_size=md[7], codec=md[4],
+                    num_values=md[5], min_val=mn, max_val=mx,
+                    null_count=stats.get(3))
+            self.row_groups.append(RowGroupInfo(num_rows=rg[3],
+                                                chunks=chunks))
+
+    # -- pruning -------------------------------------------------------------
+    def _group_matches(self, rg: RowGroupInfo, pushdown) -> bool:
+        """Row-group min/max pruning (reference
+        TupleDomainParquetPredicate.java matches())."""
+        if not pushdown:
+            return True
+        for name, lo, hi in pushdown:
+            ch = rg.chunks.get(name)
+            if ch is None or ch.min_val is None or ch.max_val is None:
+                continue
+            if lo is not None and ch.max_val < lo:
+                return False
+            if hi is not None and ch.min_val > hi:
+                return False
+        return True
+
+    # -- decode --------------------------------------------------------------
+    def batches(self, columns: Sequence[str], pushdown=None,
+                ) -> Iterator[Batch]:
+        """One device batch per surviving row group."""
+        want = [next(c for c in self.columns if c.name == n)
+                for n in columns]
+        schema = Schema([(c.name, c.type) for c in want])
+        with open(self.path, "rb") as f:
+            for rg in self.row_groups:
+                if not self._group_matches(rg, pushdown):
+                    continue
+                n = rg.num_rows
+                cap = bucket_capacity(max(n, 1))
+                cols = []
+                for c in want:
+                    ch = rg.chunks[c.name]
+                    f.seek(ch.offset)
+                    raw = f.read(ch.total_size)
+                    cols.append(self._decode_chunk(c, ch, raw, n, cap))
+                mask = jnp.asarray(np.arange(cap) < n)
+                yield Batch(schema, cols, mask)
+
+    def _decode_chunk(self, col: ParquetColumn, ch: ChunkInfo,
+                      raw: bytes, n_rows: int, cap: int) -> Column:
+        pos = 0
+        dict_values: Optional[np.ndarray] = None
+        dict_vocab: Optional[Tuple[str, ...]] = None
+        parts: List[Tuple[int, np.ndarray, object]] = []
+        # [(num_values, present, values-or-indices info)]
+        total = 0
+        while total < ch.num_values and pos < len(raw):
+            header, pos = tc.read_struct(raw, pos)
+            ptype = header[1]
+            comp_size = header[3]
+            payload = raw[pos:pos + comp_size]
+            pos += comp_size
+            if ch.codec == C_GZIP:
+                payload = zlib.decompress(payload, 16 + 15)
+            elif ch.codec != C_UNCOMPRESSED:
+                raise NotImplementedError(
+                    f"parquet codec {ch.codec} (use UNCOMPRESSED or GZIP)")
+            if ptype == 2:              # dictionary page
+                dph = header[7]
+                dict_values, dict_vocab = _decode_dict_page(
+                    col, payload, dph[1])
+                continue
+            if ptype != 0:
+                raise NotImplementedError(f"page type {ptype}")
+            dh = header[5]
+            num_values = dh[1]
+            encoding = dh[2]
+            present, vpos = _decode_def_levels(col, payload, num_values)
+            n_present = int(present.sum()) if present is not None \
+                else num_values
+            parts.append((num_values, present,
+                          (encoding, payload, vpos, n_present)))
+            total += num_values
+        return _assemble_column(col, parts, dict_values, dict_vocab,
+                                n_rows, cap)
+
+
+def _decode_stat(stats: Dict[int, object], col: ParquetColumn):
+    def dec(b):
+        if b is None:
+            return None
+        if col.physical == P_INT32:
+            return struct.unpack("<i", b)[0]
+        if col.physical == P_INT64:
+            return struct.unpack("<q", b)[0]
+        if col.physical == P_DOUBLE:
+            return struct.unpack("<d", b)[0]
+        if col.physical == P_FLOAT:
+            return struct.unpack("<f", b)[0]
+        return None
+    # prefer min_value/max_value (field 6/5) over deprecated min/max (2/1)
+    mn = dec(stats.get(6, stats.get(2)))
+    mx = dec(stats.get(5, stats.get(1)))
+    return mn, mx
+
+
+def _decode_def_levels(col: ParquetColumn, payload: bytes,
+                       num_values: int):
+    """V1 data page definition levels -> (present bool[n] | None, pos)."""
+    if not col.optional:
+        return None, 0
+    ln = struct.unpack("<I", payload[:4])[0]
+    levels = _decode_hybrid_numpy(payload[4:4 + ln], num_values, 1)
+    return levels.astype(bool), 4 + ln
+
+
+def _decode_hybrid_numpy(data: bytes, n: int, width: int) -> np.ndarray:
+    """Host hybrid decode (small streams: def levels)."""
+    runs, _ = scan_hybrid(data, n, width, 0)
+    out = np.zeros(n, dtype=np.int64)
+    arr = np.frombuffer(data, dtype=np.uint8)
+    starts = runs.out_start
+    for i in range(len(starts)):
+        start = int(starts[i])
+        end = int(starts[i + 1]) if i + 1 < len(starts) else n
+        end = min(end, n)
+        if start >= n:
+            break
+        if not runs.is_packed[i]:
+            out[start:end] = runs.values[i]
+            continue
+        bit0 = int(runs.bit_start[i])
+        idx = np.arange(end - start, dtype=np.int64)
+        bit = bit0 + idx * width
+        acc = np.zeros(end - start, dtype=np.int64)
+        for k in range(5):
+            byte_idx = np.clip(bit // 8 + k, 0, len(arr) - 1)
+            acc |= arr[byte_idx].astype(np.int64) << (8 * k)
+        out[start:end] = (acc >> (bit % 8)) & ((1 << width) - 1)
+    return out
+
+
+def _decode_dict_page(col: ParquetColumn, payload: bytes, n: int):
+    """PLAIN dictionary page -> (numeric values | None, vocab | None)."""
+    if col.physical == P_BYTE_ARRAY:
+        vocab: List[str] = []
+        pos = 0
+        for _ in range(n):
+            ln = struct.unpack("<I", payload[pos:pos + 4])[0]
+            pos += 4
+            vocab.append(payload[pos:pos + ln].decode("utf-8", "replace"))
+            pos += ln
+        return None, tuple(vocab)
+    return _storage_fix(col, np.asarray(_plain_values(col, payload, n))), None
+
+
+def _plain_values(col: ParquetColumn, payload: bytes, n: int) -> np.ndarray:
+    if col.physical == P_INT32:
+        return np.frombuffer(payload, dtype="<i4", count=n)
+    if col.physical == P_INT64:
+        return np.frombuffer(payload, dtype="<i8", count=n)
+    if col.physical == P_DOUBLE:
+        return np.frombuffer(payload, dtype="<f8", count=n)
+    if col.physical == P_FLOAT:
+        return np.frombuffer(payload, dtype="<f4", count=n).astype("<f8")
+    if col.physical == P_BOOLEAN:
+        bits = np.unpackbits(np.frombuffer(payload, dtype=np.uint8),
+                             bitorder="little")
+        return bits[:n].astype(np.int8)
+    raise NotImplementedError(f"PLAIN physical {col.physical}")
+
+
+def _storage_fix(col: ParquetColumn, arr):
+    """Physical -> engine storage adjustments (timestamp units)."""
+    if col.ts_mult > 1:
+        return arr * col.ts_mult
+    if col.ts_mult < -1:
+        return arr // (-col.ts_mult)
+    return arr
+
+
+def _assemble_column(col: ParquetColumn, parts, dict_values, dict_vocab,
+                     n_rows: int, cap: int) -> Column:
+    """Fuse page parts into one device column of ``cap`` slots."""
+    out_dtype = col.type.storage_dtype
+    validity = np.zeros(cap, dtype=bool)
+    row0 = 0
+    value_arrays: List[jnp.ndarray] = []
+    present_all = np.zeros(cap, dtype=bool)
+    # ONE vocabulary per chunk, seeded from the dictionary page: PLAIN
+    # fallback pages after a dictionary page (parquet-mr's dictionary
+    # overflow layout) and multi-page PLAIN columns append to it, so codes
+    # from earlier pages stay valid
+    vocab: List[str] = list(dict_vocab or ())
+    lookup: Dict[str, int] = {s: i for i, s in enumerate(vocab)}
+    for num_values, present, (encoding, payload, vpos, n_present) in parts:
+        if present is None:
+            present_all[row0:row0 + num_values] = True
+        else:
+            present_all[row0:row0 + num_values] = present
+        if encoding in (E_PLAIN_DICT, E_RLE_DICT):
+            width = payload[vpos]
+            vcap = bucket_capacity(max(n_present, 1))
+            idx = decode_hybrid_device(payload, n_present, width, vcap,
+                                       pos=vpos + 1)[:n_present]
+            if dict_vocab is not None:
+                value_arrays.append(idx.astype(jnp.int32))
+            else:
+                table = jnp.asarray(dict_values)
+                vals = jnp.take(table, jnp.clip(idx, 0, len(dict_values) - 1))
+                value_arrays.append(vals)
+        elif encoding == E_PLAIN:
+            if col.physical == P_BYTE_ARRAY:
+                # slow path: host-parsed strings -> shared chunk vocab
+                p = vpos
+                codes = np.empty(n_present, dtype=np.int32)
+                for i in range(n_present):
+                    ln = struct.unpack("<I", payload[p:p + 4])[0]
+                    p += 4
+                    s = payload[p:p + ln].decode("utf-8", "replace")
+                    p += ln
+                    code = lookup.get(s)
+                    if code is None:
+                        code = lookup[s] = len(vocab)
+                        vocab.append(s)
+                    codes[i] = code
+                value_arrays.append(jnp.asarray(codes))
+            elif col.physical == P_BOOLEAN:
+                arr = _plain_values(col, payload[vpos:], n_present)
+                value_arrays.append(jnp.asarray(arr))
+            else:
+                arr = _plain_values(col, payload[vpos:], n_present)
+                value_arrays.append(jnp.asarray(
+                    _storage_fix(col, np.asarray(arr))))
+        else:
+            raise NotImplementedError(f"parquet encoding {encoding}")
+        row0 += num_values
+    if col.physical == P_BYTE_ARRAY:
+        dict_vocab = tuple(vocab)
+    validity[:] = present_all
+    if value_arrays:
+        flat = jnp.concatenate([v.reshape(-1) for v in value_arrays]) \
+            if len(value_arrays) > 1 else value_arrays[0]
+    else:
+        flat = jnp.zeros(1, dtype=out_dtype)
+    # scatter present values to row slots: row j takes the k-th value
+    # where k = rank of j among present rows
+    presj = jnp.asarray(present_all)
+    rank = jnp.cumsum(presj.astype(jnp.int32)) - 1
+    gathered = jnp.take(flat.astype(out_dtype),
+                        jnp.clip(rank, 0, flat.shape[0] - 1), axis=0)
+    data = jnp.where(presj, gathered, jnp.zeros_like(gathered))
+    return Column(col.type, data, jnp.asarray(validity), dict_vocab)
+
+
+# ---------------------------------------------------------------------------
+# Writer (test fixtures + CTAS export): single row group, V1 pages,
+# PLAIN numerics / PLAIN_DICTIONARY strings, UNCOMPRESSED
+# ---------------------------------------------------------------------------
+
+def write_parquet(path: str, schema: Schema,
+                  columns: Sequence[Sequence[object]]) -> None:
+    """Write python column values (None = NULL) as a flat parquet file."""
+    n = len(columns[0]) if columns else 0
+    out = bytearray(MAGIC)
+    chunk_metas: List[bytes] = []
+    for (name, typ), values in zip(
+            [(f.name, f.type) for f in schema.fields], columns):
+        phys, conv = _physical_of(typ)
+        offset = len(out)
+        present = [v is not None for v in values]
+        dict_page_offset = None
+        if typ.is_string:
+            vocab: List[str] = []
+            lookup: Dict[str, int] = {}
+            idx: List[int] = []
+            for v in values:
+                if v is None:
+                    continue
+                code = lookup.get(v)
+                if code is None:
+                    code = lookup[v] = len(vocab)
+                    vocab.append(v)
+                idx.append(code)
+            dict_payload = bytearray()
+            for s in vocab:
+                b = s.encode()
+                dict_payload += struct.pack("<I", len(b)) + b
+            dict_page_offset = len(out)
+            out += _page_header(2, len(dict_payload), dict_n=len(vocab))
+            out += dict_payload
+            width = _bitwidth(max(len(vocab) - 1, 1))
+            payload = _def_levels(present) + bytes([width]) \
+                + _rle_encode(idx, width)
+            data_page_offset = len(out)
+            out += _page_header(0, len(payload), data_n=n,
+                                encoding=E_PLAIN_DICT)
+            out += payload
+        else:
+            payload = _def_levels(present) + _plain_encode(
+                typ, phys, [v for v in values if v is not None])
+            data_page_offset = len(out)
+            out += _page_header(0, len(payload), data_n=n,
+                                encoding=E_PLAIN)
+            out += payload
+        total = len(out) - offset
+        md = tc.write_struct([
+            (1, tc.I32, phys),
+            (2, tc.LIST, (tc.I32, [E_PLAIN, E_RLE, E_PLAIN_DICT])),
+            (3, tc.LIST, (tc.BINARY, [name])),
+            (4, tc.I32, C_UNCOMPRESSED),
+            (5, tc.I64, n),
+            (6, tc.I64, total),
+            (7, tc.I64, total),
+            (9, tc.I64, data_page_offset),
+            (11, tc.I64, dict_page_offset),
+            (12, tc.STRUCT, _stats_struct(typ, phys, values)),
+        ])
+        chunk_metas.append(tc.write_struct([
+            (2, tc.I64, offset),
+            (3, tc.STRUCT, md),
+        ]))
+    rg = tc.write_struct([
+        (1, tc.LIST, (tc.STRUCT, chunk_metas)),
+        (2, tc.I64, len(out) - 4),
+        (3, tc.I64, n),
+    ])
+    elements = [tc.write_struct([
+        (4, tc.BINARY, "schema"),
+        (5, tc.I32, len(schema)),
+    ])]
+    for f in schema.fields:
+        phys, conv = _physical_of(f.type)
+        fields = [(1, tc.I32, phys), (3, tc.I32, 1),
+                  (4, tc.BINARY, f.name)]
+        if conv is not None:
+            fields.append((6, tc.I32, conv))
+        if isinstance(f.type, T.DecimalType):
+            fields.append((7, tc.I32, f.type.scale))
+            fields.append((8, tc.I32, f.type.precision))
+        elements.append(tc.write_struct(fields))
+    meta = tc.write_struct([
+        (1, tc.I32, 1),
+        (2, tc.LIST, (tc.STRUCT, elements)),
+        (3, tc.I64, n),
+        (4, tc.LIST, (tc.STRUCT, [rg])),
+    ])
+    out += meta
+    out += struct.pack("<I", len(meta))
+    out += MAGIC
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+
+def _physical_of(typ: T.Type) -> Tuple[int, Optional[int]]:
+    if isinstance(typ, T.BooleanType):
+        return P_BOOLEAN, None
+    if isinstance(typ, T.DateType):
+        return P_INT32, CT_DATE
+    if isinstance(typ, (T.TinyintType, T.SmallintType, T.IntegerType)):
+        return P_INT32, None
+    if isinstance(typ, T.TimestampType):
+        return P_INT64, CT_TS_MICROS
+    if isinstance(typ, T.DecimalType):
+        return P_INT64, CT_DECIMAL
+    if isinstance(typ, T.BigintType):
+        return P_INT64, None
+    if isinstance(typ, (T.DoubleType, T.RealType)):
+        return P_DOUBLE, None
+    if typ.is_string:
+        return P_BYTE_ARRAY, CT_UTF8
+    raise NotImplementedError(f"parquet write of {typ.display()}")
+
+
+def _page_header(ptype: int, size: int, data_n: int = 0,
+                 dict_n: int = 0, encoding: int = E_PLAIN) -> bytes:
+    if ptype == 2:
+        inner = tc.write_struct([(1, tc.I32, dict_n),
+                                 (2, tc.I32, E_PLAIN)])
+        return tc.write_struct([
+            (1, tc.I32, 2), (2, tc.I32, size), (3, tc.I32, size),
+            (7, tc.STRUCT, inner)])
+    inner = tc.write_struct([
+        (1, tc.I32, data_n), (2, tc.I32, encoding),
+        (3, tc.I32, E_RLE), (4, tc.I32, E_RLE)])
+    return tc.write_struct([
+        (1, tc.I32, 0), (2, tc.I32, size), (3, tc.I32, size),
+        (5, tc.STRUCT, inner)])
+
+
+def _def_levels(present: List[bool]) -> bytes:
+    body = _rle_encode([1 if p else 0 for p in present], 1)
+    return struct.pack("<I", len(body)) + body
+
+
+def _rle_encode(values: List[int], width: int) -> bytes:
+    """Pure RLE runs (always valid hybrid encoding)."""
+    out = bytearray()
+    nbytes = (width + 7) // 8
+    i = 0
+    while i < len(values):
+        j = i
+        while j < len(values) and values[j] == values[i]:
+            j += 1
+        out += tc._w_varint((j - i) << 1)
+        out += int(values[i]).to_bytes(nbytes, "little")
+        i = j
+    return bytes(out)
+
+
+def _plain_encode(typ: T.Type, phys: int, values: List[object]) -> bytes:
+    storage = [typ.to_storage(v) for v in values]
+    if phys == P_INT32:
+        return np.asarray(storage, dtype="<i4").tobytes()
+    if phys == P_INT64:
+        return np.asarray(storage, dtype="<i8").tobytes()
+    if phys == P_DOUBLE:
+        return np.asarray(storage, dtype="<f8").tobytes()
+    if phys == P_BOOLEAN:
+        bits = np.asarray(storage, dtype=np.uint8)
+        return np.packbits(bits, bitorder="little").tobytes()
+    raise NotImplementedError(f"plain encode {phys}")
+
+
+def _stats_struct(typ: T.Type, phys: int, values) -> Optional[bytes]:
+    live = [typ.to_storage(v) for v in values if v is not None]
+    if not live or phys not in (P_INT32, P_INT64, P_DOUBLE):
+        return None
+    mn, mx = min(live), max(live)
+    fmt = {P_INT32: "<i", P_INT64: "<q", P_DOUBLE: "<d"}[phys]
+    return tc.write_struct([
+        (3, tc.I64, sum(1 for v in values if v is None)),
+        (5, tc.BINARY, struct.pack(fmt, mx)),
+        (6, tc.BINARY, struct.pack(fmt, mn)),
+    ])

@@ -79,9 +79,10 @@ def _const_value(e: ir.Expr):
                   T.BOOLEAN)
     try:
         v = eval_expr(e, [carrier])
-    except (KeyError, NotImplementedError) as exc:
-        raise AnalysisError(
-            f"VALUES cell is not a supported constant expression: {exc}")
+    except NotImplementedError as exc:
+        # an engine limitation, not a user error — say so
+        raise NotImplementedError(
+            f"cannot evaluate VALUES cell {e!r}: {exc}")
     if v.err is not None:
         code = int(jnp.max(v.err))
         if code:
