@@ -1,0 +1,123 @@
+"""Shared base for file-format directory connectors (ORC, Parquet).
+
+The minimal shape of the reference's Hive connector read path (reference
+presto-hive/.../HivePageSourceProvider.java:58,85 dispatching each split
+to a format page source; BackgroundHiveSplitLoader.java listing files
+into splits): schema = directory, table = subdirectory (or a single
+``.<ext>`` file), one split per file, footer statistics drive pruning.
+Concrete connectors supply (extension, reader factory); readers are
+cached by (path, mtime) since planning asks for schema/stats repeatedly
+and footers are ranged reads anyway.
+"""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..batch import Schema
+from .spi import (
+    Connector, ConnectorMetadata, ConnectorSplitManager, PageSource, Split,
+    TableHandle, TableStats,
+)
+
+
+class FileConnectorBase(Connector):
+    """Directory-of-files connector parameterized by format."""
+
+    #: file extension including the dot, e.g. ".orc"
+    extension: str = ""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._metadata = _Metadata(self)
+        self._splits = _SplitManager(self)
+        self._readers: "OrderedDict[Tuple[str, float], object]" = \
+            OrderedDict()
+
+    # -- format hooks --------------------------------------------------------
+    def open_reader(self, path: str):
+        raise NotImplementedError
+
+    def make_page_source(self, path: str, columns: Sequence[str],
+                         pushdown) -> PageSource:
+        raise NotImplementedError
+
+    # -- shared machinery ----------------------------------------------------
+    def reader(self, path: str):
+        key = (path, os.path.getmtime(path))
+        r = self._readers.get(key)
+        if r is None:
+            r = self._readers[key] = self.open_reader(path)
+            while len(self._readers) > 64:
+                self._readers.popitem(last=False)
+        else:
+            self._readers.move_to_end(key)
+        return r
+
+    def table_files(self, table: str) -> List[str]:
+        path = os.path.join(self.root, table)
+        ext = self.extension
+        if os.path.isdir(path):
+            files = sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.endswith(ext))
+            if not files:
+                raise KeyError(
+                    f"unknown {self.name} table {table!r} (empty dir)")
+            return files
+        if os.path.isfile(path + ext):
+            return [path + ext]
+        raise KeyError(f"unknown {self.name} table {table!r}")
+
+    @property
+    def metadata(self) -> ConnectorMetadata:
+        return self._metadata
+
+    @property
+    def split_manager(self) -> ConnectorSplitManager:
+        return self._splits
+
+    def page_source(self, split: Split, columns: Sequence[str],
+                    pushdown=None, rows_per_batch: int = 1 << 17
+                    ) -> PageSource:
+        return self.make_page_source(split.info[0], columns, pushdown)
+
+
+class _Metadata(ConnectorMetadata):
+    def __init__(self, conn: FileConnectorBase):
+        self.conn = conn
+
+    def list_tables(self, schema: Optional[str] = None) -> List[str]:
+        out = []
+        ext = self.conn.extension
+        for entry in sorted(os.listdir(self.conn.root)):
+            full = os.path.join(self.conn.root, entry)
+            if os.path.isdir(full):
+                try:
+                    if self.conn.table_files(entry):
+                        out.append(entry)
+                except KeyError:
+                    continue
+            elif entry.endswith(ext):
+                out.append(entry[:-len(ext)])
+        return out
+
+    def table_schema(self, table: TableHandle) -> Schema:
+        files = self.conn.table_files(table.table)
+        return self.conn.reader(files[0]).schema
+
+    def table_stats(self, table: TableHandle) -> TableStats:
+        rows = 0.0
+        for f in self.conn.table_files(table.table):
+            rows += self.conn.reader(f).num_rows
+        return TableStats(row_count=rows, columns={}, primary_key=())
+
+
+class _SplitManager(ConnectorSplitManager):
+    def __init__(self, conn: FileConnectorBase):
+        self.conn = conn
+
+    def splits(self, table: TableHandle, desired: int = 1) -> List[Split]:
+        return [Split(table, (f,))
+                for f in self.conn.table_files(table.table)]

@@ -3,118 +3,43 @@
 The minimal shape of the reference's Hive connector read path (reference
 presto-hive/.../HivePageSourceProvider.java:58,85 dispatches each split
 to OrcPageSource.java:46; BackgroundHiveSplitLoader.java lists files
-into splits): here schema = directory, table = subdirectory (or a single
-``.orc`` file), one split per file, and each split decodes stripe-by-
-stripe into device batches via formats/orc.py. Min/max predicate
-pushdown prunes whole files on their footer statistics — the role of
+into splits) on the shared directory-connector base: one split per file,
+stripe-by-stripe device decode via formats/orc.py, min/max predicate
+pushdown pruning whole files on footer statistics — the role of
 TupleDomainOrcPredicate.java:77.
 """
 from __future__ import annotations
 
-import os
-from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
-from ..batch import Schema
 from ..formats.orc import OrcReader
-from .spi import (
-    ColumnStats, Connector, ConnectorMetadata, ConnectorSplitManager,
-    PageSource, Split, TableHandle, TableStats,
-)
-
-_READERS: "OrderedDict[Tuple[str, float], OrcReader]" = OrderedDict()
-
-
-def _reader(path: str) -> OrcReader:
-    """Footer-parsed readers cached by (path, mtime): planning asks for
-    schema and stats repeatedly, and footers are ranged reads anyway."""
-    key = (path, os.path.getmtime(path))
-    r = _READERS.get(key)
-    if r is None:
-        r = _READERS[key] = OrcReader(path)
-        while len(_READERS) > 64:
-            _READERS.popitem(last=False)
-    else:
-        _READERS.move_to_end(key)
-    return r
-
-
-def _table_files(root: str, table: str) -> List[str]:
-    path = os.path.join(root, table)
-    if os.path.isdir(path):
-        return sorted(
-            os.path.join(path, f) for f in os.listdir(path)
-            if f.endswith(".orc"))
-    if os.path.isfile(path + ".orc"):
-        return [path + ".orc"]
-    raise KeyError(f"unknown orc table {table!r}")
-
-
-class _Metadata(ConnectorMetadata):
-    def __init__(self, root: str):
-        self.root = root
-
-    def list_tables(self, schema: Optional[str] = None) -> List[str]:
-        out = []
-        for entry in sorted(os.listdir(self.root)):
-            full = os.path.join(self.root, entry)
-            if os.path.isdir(full) and _table_files(self.root, entry):
-                out.append(entry)
-            elif entry.endswith(".orc"):
-                out.append(entry[:-4])
-        return out
-
-    def table_schema(self, table: TableHandle) -> Schema:
-        files = _table_files(self.root, table.table)
-        return _reader(files[0]).schema
-
-    def table_stats(self, table: TableHandle) -> TableStats:
-        rows = 0.0
-        for f in _table_files(self.root, table.table):
-            rows += _reader(f).num_rows
-        return TableStats(row_count=rows, columns={}, primary_key=())
-
-
-class _SplitManager(ConnectorSplitManager):
-    def __init__(self, root: str):
-        self.root = root
-
-    def splits(self, table: TableHandle, desired: int = 1) -> List[Split]:
-        return [Split(table, (f,))
-                for f in _table_files(self.root, table.table)]
+from .filebase import FileConnectorBase
+from .spi import PageSource
 
 
 class _OrcPageSource(PageSource):
-    def __init__(self, split: Split, columns: Sequence[str],
+    def __init__(self, conn: "OrcConnector", path: str,
+                 columns: Sequence[str],
                  min_max: Optional[Dict[str, Tuple[int, int]]]):
-        self.path = split.info[0]
+        self.conn = conn
+        self.path = path
         self.columns = list(columns)
         self.min_max = min_max
 
     def batches(self):
-        yield from _reader(self.path).batches(self.columns, self.min_max)
+        yield from self.conn.reader(self.path).batches(
+            self.columns, self.min_max)
 
 
-class OrcConnector(Connector):
+class OrcConnector(FileConnectorBase):
     name = "orc"
+    extension = ".orc"
 
-    def __init__(self, root: str):
-        self.root = root
-        self._metadata = _Metadata(root)
-        self._splits = _SplitManager(root)
+    def open_reader(self, path: str) -> OrcReader:
+        return OrcReader(path)
 
-    @property
-    def metadata(self) -> ConnectorMetadata:
-        return self._metadata
-
-    @property
-    def split_manager(self) -> ConnectorSplitManager:
-        return self._splits
-
-    def page_source(self, split: Split, columns: Sequence[str],
-                    pushdown=None, rows_per_batch: int = 1 << 17
-                    ) -> PageSource:
+    def make_page_source(self, path, columns, pushdown) -> PageSource:
         # engine pushdown: ((column, lo, hi), ...) -> {column: (lo, hi)}
         min_max = ({name: (lo, hi) for name, lo, hi in pushdown}
                    if pushdown else None)
-        return _OrcPageSource(split, columns, min_max)
+        return _OrcPageSource(self, path, columns, min_max)

@@ -1,112 +1,39 @@
 """Parquet connector: a table is a directory of parquet files.
 
-The Parquet sibling of connectors/orc.py (reference
-presto-hive/.../HivePageSourceProvider.java dispatching to
-parquet/ParquetPageSourceFactory.java): schema = directory, table =
-subdirectory (or a single ``.parquet`` file), one split per file,
-row-group min/max pruning from footer statistics (reference
+The Parquet sibling of connectors/orc.py on the shared directory-
+connector base (reference presto-hive/.../HivePageSourceProvider.java
+dispatching to parquet/ParquetPageSourceFactory.java); row-group min/max
+pruning from footer statistics rides the scan pushdown (reference
 predicate/TupleDomainParquetPredicate.java).
 """
 from __future__ import annotations
 
-import os
-from collections import OrderedDict
-from typing import List, Optional, Sequence, Tuple
+from typing import Sequence
 
-from ..batch import Schema
 from ..formats.parquet import ParquetReader
-from .spi import (
-    Connector, ConnectorMetadata, ConnectorSplitManager, PageSource, Split,
-    TableHandle, TableStats,
-)
-
-_READERS: "OrderedDict[Tuple[str, float], ParquetReader]" = OrderedDict()
-
-
-def _reader(path: str) -> ParquetReader:
-    key = (path, os.path.getmtime(path))
-    r = _READERS.get(key)
-    if r is None:
-        r = _READERS[key] = ParquetReader(path)
-        while len(_READERS) > 64:
-            _READERS.popitem(last=False)
-    else:
-        _READERS.move_to_end(key)
-    return r
-
-
-def _table_files(root: str, table: str) -> List[str]:
-    path = os.path.join(root, table)
-    if os.path.isdir(path):
-        return sorted(
-            os.path.join(path, f) for f in os.listdir(path)
-            if f.endswith(".parquet"))
-    if os.path.isfile(path + ".parquet"):
-        return [path + ".parquet"]
-    raise KeyError(f"unknown parquet table {table!r}")
-
-
-class _Metadata(ConnectorMetadata):
-    def __init__(self, root: str):
-        self.root = root
-
-    def list_tables(self, schema: Optional[str] = None) -> List[str]:
-        out = []
-        for entry in sorted(os.listdir(self.root)):
-            full = os.path.join(self.root, entry)
-            if os.path.isdir(full) and _table_files(self.root, entry):
-                out.append(entry)
-            elif entry.endswith(".parquet"):
-                out.append(entry[:-8])
-        return out
-
-    def table_schema(self, table: TableHandle) -> Schema:
-        files = _table_files(self.root, table.table)
-        return _reader(files[0]).schema
-
-    def table_stats(self, table: TableHandle) -> TableStats:
-        rows = 0.0
-        for f in _table_files(self.root, table.table):
-            rows += _reader(f).num_rows
-        return TableStats(row_count=rows, columns={}, primary_key=())
-
-
-class _SplitManager(ConnectorSplitManager):
-    def __init__(self, root: str):
-        self.root = root
-
-    def splits(self, table: TableHandle, desired: int = 1) -> List[Split]:
-        return [Split(table, (f,))
-                for f in _table_files(self.root, table.table)]
+from .filebase import FileConnectorBase
+from .spi import PageSource
 
 
 class _ParquetPageSource(PageSource):
-    def __init__(self, split: Split, columns: Sequence[str], pushdown):
-        self.path = split.info[0]
+    def __init__(self, conn: "ParquetConnector", path: str,
+                 columns: Sequence[str], pushdown):
+        self.conn = conn
+        self.path = path
         self.columns = list(columns)
         self.pushdown = pushdown
 
     def batches(self):
-        yield from _reader(self.path).batches(self.columns, self.pushdown)
+        yield from self.conn.reader(self.path).batches(
+            self.columns, self.pushdown)
 
 
-class ParquetConnector(Connector):
+class ParquetConnector(FileConnectorBase):
     name = "parquet"
+    extension = ".parquet"
 
-    def __init__(self, root: str):
-        self.root = root
-        self._metadata = _Metadata(root)
-        self._splits = _SplitManager(root)
+    def open_reader(self, path: str) -> ParquetReader:
+        return ParquetReader(path)
 
-    @property
-    def metadata(self) -> ConnectorMetadata:
-        return self._metadata
-
-    @property
-    def split_manager(self) -> ConnectorSplitManager:
-        return self._splits
-
-    def page_source(self, split: Split, columns: Sequence[str],
-                    pushdown=None, rows_per_batch: int = 1 << 17
-                    ) -> PageSource:
-        return _ParquetPageSource(split, columns, pushdown)
+    def make_page_source(self, path, columns, pushdown) -> PageSource:
+        return _ParquetPageSource(self, path, columns, pushdown)

@@ -236,8 +236,16 @@ class _Executor:
         # runtime (dynamic-filter) scan bounds: scan node -> [(col, lo, hi)]
         self.dynamic_pushdown: Dict[PlanNode, List[Tuple]] = {}
         from ..memory import QueryMemoryPool
+
+        def _int_prop(name, default=None):
+            v = session.properties.get(name, default)
+            return int(v) if v is not None else None
         self.pool = QueryMemoryPool(
-            session.properties.get("query_max_memory"))
+            _int_prop("query_max_memory"),
+            # second spill tier: staged host bytes beyond this flush to
+            # compressed pages on disk (reference NodeSpillConfig)
+            disk_threshold=_int_prop("spill_to_disk_bytes", 4 << 30),
+            spill_dir=session.properties.get("spill_path"))
         self.spill_partitions = int(
             session.properties.get("spill_partitions", 16))
         session.last_memory_stats = self.pool.stats
@@ -783,22 +791,32 @@ class _Executor:
         (reference GenericPartitioningSpiller.java probe protocol)."""
         from .spill import HostPartitionStore
         pstore: Optional[HostPartitionStore] = None
-        for probe in self.run(node.left):
+        try:
+            for probe in self.run(node.left):
+                if pstore is None:
+                    pstore = HostPartitionStore(
+                        probe.schema, store.n,
+                        disk_threshold=self.pool.disk_threshold,
+                        disk_dir=self.pool.spill_dir,
+                        stats=self.pool.stats)
+                pstore.add(probe, list(node.left_keys))
             if pstore is None:
-                pstore = HostPartitionStore(probe.schema, store.n)
-            pstore.add(probe, list(node.left_keys))
-        if pstore is None:
-            return
-        for p in range(store.n):
-            bpart = store.partition_batch(p)
-            for probe_p in pstore.partition_batches(p, self.rows_per_batch):
-                if bpart is None:
-                    if node.join_type == "left":
-                        yield self._null_extend(probe_p, node)
-                    continue
-                out = self._probe(node, probe_p, bpart, payload,
-                                  payload_names)
-                yield residual_fn(out) if residual_fn is not None else out
+                return
+            for p in range(store.n):
+                bpart = store.partition_batch(p)
+                for probe_p in pstore.partition_batches(
+                        p, self.rows_per_batch):
+                    if bpart is None:
+                        if node.join_type == "left":
+                            yield self._null_extend(probe_p, node)
+                        continue
+                    out = self._probe(node, probe_p, bpart, payload,
+                                      payload_names)
+                    yield residual_fn(out) if residual_fn is not None \
+                        else out
+        finally:
+            if pstore is not None:
+                pstore.close()
 
     def _probe(self, node: JoinNode, probe: Batch, build: Batch,
                payload, payload_names) -> Batch:

@@ -103,8 +103,9 @@ def serialize_partitioned(batch: Batch, key_indices: List[int],
     return out
 
 
-def deserialize_page(data: bytes) -> Batch:
-    """Decode one serialized page back into a device batch."""
+def deserialize_arrays(data: bytes):
+    """Decode a page to host numpy: (schema, arrays, validities, dicts, n)
+    — the spill readback path, which concatenates before device upload."""
     if data[:4] != MAGIC:
         raise ValueError("bad page magic")
     version, marker = struct.unpack_from("<BB", data, 4)
@@ -136,4 +137,10 @@ def deserialize_page(data: bytes) -> Batch:
             arr = arr.astype(bool)
         arrays.append(arr)
         validities.append(valid)
+    return schema, arrays, validities, dicts, n
+
+
+def deserialize_page(data: bytes) -> Batch:
+    """Decode one serialized page back into a device batch."""
+    schema, arrays, validities, dicts, n = deserialize_arrays(data)
     return Batch.from_arrays(schema, arrays, validities, dicts, num_rows=n)

@@ -1,15 +1,18 @@
-"""Host-DRAM spill: hash-partitioned staging for join/agg state, full
-chunk staging for sort.
+"""Two-tier spill: host DRAM first, then compressed pages on disk.
 
 The TPU reshape of the reference's spill stack (reference
 presto-main/.../spiller/GenericPartitioningSpiller.java for partitioned
 join spill, operator/aggregation/builder/SpillableHashAggregationBuilder.java
 for agg state, OrderByOperator.java + FileSingleStreamSpiller.java for
-sort): the "disk" is host DRAM (device_get), the natural first spill tier
-on a TPU host, and partition ids are computed ON DEVICE with the same
-value-based splitmix64 row hash the exchange uses — so a spilled build
-partition and its probe partition agree by construction, including for
-dictionary-encoded strings (hashed by VALUE, not per-chunk code).
+sort): the first "disk" is host DRAM (device_get), the natural spill tier
+on a TPU host; when staged host bytes cross the pool's disk threshold,
+chunks flush as compressed wire pages (exec/pages.py serde — the
+reference's PagesSerde+LZ4 role) to a per-store temp file, partition-
+sliced so readback is ranged reads. Partition ids are computed ON DEVICE
+with the same value-based splitmix64 row hash the exchange uses — so a
+spilled build partition and its probe partition agree by construction,
+including for dictionary-encoded strings (hashed by VALUE, not per-chunk
+code).
 
 Buffers accumulate device batches against an OperatorMemoryContext; when
 the pool can't fit the next batch (or another operator revokes them) they
@@ -20,6 +23,8 @@ ids), so per-partition readback is slicing, not a rescan.
 from __future__ import annotations
 
 import dataclasses
+import os
+import tempfile
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -104,23 +109,117 @@ def _gather_chunks(schema: Schema,
     return arrays, valid_arr, vocabs
 
 
-class HostPartitionStore:
-    """Rows staged to host DRAM, hash-partitioned by key columns."""
+class SpillFile:
+    """Append-only spill file of compressed wire pages (the role of
+    reference spiller/FileSingleStreamSpiller.java's async file IO,
+    synchronous here — staging already decoupled the device)."""
 
-    def __init__(self, schema: Schema, n_partitions: int):
+    def __init__(self, directory: Optional[str] = None):
+        fd, self.path = tempfile.mkstemp(
+            prefix="presto-tpu-spill-", suffix=".bin", dir=directory)
+        self._f = os.fdopen(fd, "w+b")
+
+    def append(self, data: bytes) -> Tuple[int, int]:
+        off = self._f.seek(0, os.SEEK_END)
+        self._f.write(data)
+        return off, len(data)
+
+    def read(self, off: int, length: int) -> bytes:
+        self._f.seek(off)
+        return self._f.read(length)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        finally:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def _chunk_host_bytes(ch: _StagedChunk) -> int:
+    return sum(a.nbytes for a in ch.datas) + sum(v.nbytes for v in ch.valids)
+
+
+class HostPartitionStore:
+    """Rows staged to host DRAM, hash-partitioned by key columns; beyond
+    ``disk_threshold`` staged bytes, chunks flush to a SpillFile as one
+    compressed page per (chunk, partition)."""
+
+    def __init__(self, schema: Schema, n_partitions: int,
+                 disk_threshold: Optional[int] = None,
+                 disk_dir: Optional[str] = None,
+                 stats=None):
         self.schema = schema
         self.n = n_partitions
         self.chunks: List[_StagedChunk] = []
+        self.disk_threshold = disk_threshold
+        self.disk_dir = disk_dir
+        self.stats = stats
+        self.host_bytes = 0
+        self._file: Optional[SpillFile] = None
+        # per partition: [(offset, length)] fragments in the spill file
+        self._frags: List[List[Tuple[int, int]]] = [
+            [] for _ in range(n_partitions)]
 
     def add(self, batch: Batch, key_cols: Sequence[int]) -> int:
         """Stage a device batch; returns the device bytes it occupied."""
-        pid = hash_partition_ids(batch, list(key_cols), self.n)
-        self.chunks.append(_stage_chunk(batch, pid, self.n))
+        if self.n == 1 or not key_cols:
+            ch = _stage_chunk(batch)        # single partition: no hashing
+        else:
+            pid = hash_partition_ids(batch, list(key_cols), self.n)
+            ch = _stage_chunk(batch, pid, self.n)
+        if self._file is not None:
+            self._flush_chunk(ch)
+        else:
+            self.chunks.append(ch)
+            self.host_bytes += _chunk_host_bytes(ch)
+            if (self.disk_threshold is not None
+                    and self.host_bytes > self.disk_threshold):
+                self._flush_to_disk()
         return batch_device_bytes(batch)
 
+    def _flush_to_disk(self) -> None:
+        self._file = SpillFile(self.disk_dir)
+        for ch in self.chunks:
+            self._flush_chunk(ch)
+        self.chunks = []
+        self.host_bytes = 0
+
+    def _flush_chunk(self, ch: _StagedChunk) -> None:
+        from .pages import _encode
+        for p in range(self.n):
+            rows = ch.rows_of(p)
+            if rows.size == 0:
+                continue
+            page = _encode(self.schema,
+                           [d[rows] for d in ch.datas],
+                           [v[rows] for v in ch.valids],
+                           ch.dicts, compress=True)
+            self._frags[p].append(self._file.append(page))
+            if self.stats is not None:
+                self.stats.disk_spilled_bytes += len(page)
+
+    def _disk_chunks(self, p: int) -> Iterator[Tuple[_StagedChunk, np.ndarray]]:
+        from .pages import deserialize_arrays
+        for off, length in self._frags[p]:
+            _, arrays, valids, dicts, n = deserialize_arrays(
+                self._file.read(off, length))
+            ch = _StagedChunk(datas=arrays, valids=valids, dicts=dicts,
+                              part_rows=np.arange(n), bounds=None)
+            yield ch, ch.part_rows
+
     def _partition_arrays(self, p: int):
-        return _gather_chunks(
-            self.schema, ((ch, ch.rows_of(p)) for ch in self.chunks))
+        selections = [(ch, ch.rows_of(p)) for ch in self.chunks]
+        if self._file is not None:
+            selections.extend(self._disk_chunks(p))
+        return _gather_chunks(self.schema, selections)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
 
     def partition_batch(self, p: int) -> Optional[Batch]:
         """The whole partition as one device batch (build sides)."""
@@ -177,7 +276,11 @@ class SpillableBuildBuffer:
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:
-            self.store = HostPartitionStore(b.schema, self.n_partitions)
+            pool = self.ctx.pool
+            self.store = HostPartitionStore(
+                b.schema, self.n_partitions,
+                disk_threshold=pool.disk_threshold,
+                disk_dir=pool.spill_dir, stats=pool.stats)
         n = self.store.add(b, self.key_cols)
         self.ctx.pool.stats.spilled_bytes += n
         return n
@@ -203,6 +306,8 @@ class SpillableBuildBuffer:
 
     def close(self) -> None:
         self.ctx.close()
+        if self.store is not None:
+            self.store.close()
 
 
 class AggSpillBuffer:
@@ -252,7 +357,11 @@ class AggSpillBuffer:
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:
-            self.store = HostPartitionStore(b.schema, self.n_partitions)
+            pool = self.ctx.pool
+            self.store = HostPartitionStore(
+                b.schema, self.n_partitions,
+                disk_threshold=pool.disk_threshold,
+                disk_dir=pool.spill_dir, stats=pool.stats)
         n = self.store.add(b, self.key_idx)
         self.ctx.pool.stats.spilled_bytes += n
         return n
@@ -288,6 +397,8 @@ class AggSpillBuffer:
 
     def close(self) -> None:
         self.ctx.close()
+        if self.store is not None:
+            self.store.close()
 
 
 class SortSpillBuffer:
@@ -302,7 +413,7 @@ class SortSpillBuffer:
         self.ctx = pool.context(name, revoke_cb=self._spill_all)
         self.keys = list(keys)
         self.device: List[Batch] = []
-        self.chunks: List[_StagedChunk] = []
+        self.store: Optional[HostPartitionStore] = None
         self.schema: Optional[Schema] = None
         self.spilled = False
 
@@ -319,8 +430,14 @@ class SortSpillBuffer:
             self._stage(b)
 
     def _stage(self, b: Batch) -> int:
-        n = batch_device_bytes(b)
-        self.chunks.append(_stage_chunk(b))
+        if self.store is None:
+            pool = self.ctx.pool
+            # one partition: sort wants everything back in one readback,
+            # but still rides the two-tier (DRAM -> disk) staging
+            self.store = HostPartitionStore(
+                b.schema, 1, disk_threshold=pool.disk_threshold,
+                disk_dir=pool.spill_dir, stats=pool.stats)
+        n = self.store.add(b, [])
         self.ctx.pool.stats.spilled_bytes += n
         return n
 
@@ -345,8 +462,8 @@ class SortSpillBuffer:
 
     def _host_sorted(self, rows_per_batch: int) -> Iterator[Batch]:
         schema = self.schema
-        got = _gather_chunks(
-            schema, ((ch, ch.rows_of(None)) for ch in self.chunks))
+        got = None if self.store is None \
+            else self.store._partition_arrays(0)
         if got is None:
             return
         arrays, valid_arr, vocabs = got
@@ -366,6 +483,8 @@ class SortSpillBuffer:
 
     def close(self) -> None:
         self.ctx.close()
+        if self.store is not None:
+            self.store.close()
 
 
 def _np_sortable(data: np.ndarray, valid: np.ndarray,

@@ -513,6 +513,8 @@ def _assemble_column(col: ParquetColumn, parts, dict_values, dict_vocab,
             if len(value_arrays) > 1 else value_arrays[0]
     else:
         flat = jnp.zeros(1, dtype=out_dtype)
+    if flat.shape[0] == 0:      # entirely-NULL chunk: pages carry 0 values
+        flat = jnp.zeros(1, dtype=out_dtype)
     # scatter present values to row slots: row j takes the k-th value
     # where k = rank of j among present rows
     presj = jnp.asarray(present_all)

@@ -37,7 +37,8 @@ def batch_device_bytes(batch) -> int:
 class MemoryStats:
     peak_bytes: int = 0
     revocations: int = 0
-    spilled_bytes: int = 0
+    spilled_bytes: int = 0          # device bytes staged to host DRAM
+    disk_spilled_bytes: int = 0     # compressed page bytes written to disk
 
 
 class MemoryLimitExceeded(RuntimeError):
@@ -47,8 +48,14 @@ class MemoryLimitExceeded(RuntimeError):
 class QueryMemoryPool:
     """Per-query device-memory budget (reference memory/MemoryPool.java)."""
 
-    def __init__(self, limit_bytes: Optional[int] = None):
+    def __init__(self, limit_bytes: Optional[int] = None,
+                 disk_threshold: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
         self.limit = limit_bytes if limit_bytes is not None else UNLIMITED
+        # host-DRAM staging budget before the second (disk) tier kicks in
+        # (reference NodeSpillConfig.maxSpillPerNode + spiller-spill-path)
+        self.disk_threshold = disk_threshold
+        self.spill_dir = spill_dir
         self.reserved = 0
         self.stats = MemoryStats()
         self._contexts: List["OperatorMemoryContext"] = []

@@ -139,6 +139,27 @@ def test_nanosecond_timestamps_logical_only(tmp_path):
     assert [r[0] for r in rows] == ts
 
 
+def test_all_null_column(tmp_path):
+    p = str(tmp_path / "nulls.parquet")
+    pq.write_table(pa.table({"a": pa.array([None] * 3, type=pa.int64()),
+                             "b": pa.array([1, 2, 3])}),
+                   p, compression="NONE", version="1.0")
+    rows = rows_of(p, ["a", "b"])
+    assert rows == [(None, 1), (None, 2), (None, 3)]
+
+
+def test_empty_table_dir_is_unknown_table(tmp_path):
+    import os
+
+    from presto_tpu.connectors.parquet import ParquetConnector
+    os.mkdir(tmp_path / "emptytab")
+    conn = ParquetConnector(str(tmp_path))
+    from presto_tpu.connectors.spi import TableHandle
+    with pytest.raises(KeyError, match="emptytab"):
+        conn.metadata.table_schema(TableHandle("pq", "d", "emptytab"))
+    assert conn.metadata.list_tables() == []
+
+
 def test_own_writer_roundtrip(tmp_path):
     p = str(tmp_path / "own.parquet")
     schema = Schema([("a", T.BIGINT), ("b", T.VARCHAR), ("e", T.BOOLEAN)])

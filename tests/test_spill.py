@@ -57,3 +57,32 @@ def test_no_spill_when_unlimited(runner, oracle):
     compare(runner, oracle, sql, rel=1e-9)
     stats = runner.session.last_memory_stats
     assert stats is not None and stats.spilled_bytes == 0
+
+
+@pytest.fixture(scope="module")
+def disk_runner(runner, tmp_path_factory):
+    """Tiny device budget AND tiny host budget: every spillable buffer
+    flushes through to the disk tier (reference FileSingleStreamSpiller)."""
+    r = LocalRunner(catalogs=runner.session.catalogs,
+                    rows_per_batch=1 << 12)
+    r.session.properties["query_max_memory"] = BUDGET
+    r.session.properties["spill_partitions"] = 4
+    r.session.properties["spill_to_disk_bytes"] = 50_000
+    r.session.properties["spill_path"] = str(
+        tmp_path_factory.mktemp("spill"))
+    return r
+
+
+@pytest.mark.parametrize("sql", SPILL_QUERIES, ids=range(len(SPILL_QUERIES)))
+def test_disk_spill_matches_oracle(disk_runner, oracle, sql):
+    compare(disk_runner, oracle, sql, rel=1e-9)
+    stats = disk_runner.session.last_memory_stats
+    assert stats.peak_bytes <= BUDGET, stats
+    assert stats.disk_spilled_bytes > 0, f"no disk spill: {stats}"
+
+
+def test_disk_spill_files_cleaned_up(disk_runner, oracle):
+    import os
+    spill_dir = disk_runner.session.properties["spill_path"]
+    compare(disk_runner, oracle, SPILL_QUERIES[1], rel=1e-9)
+    assert os.listdir(spill_dir) == []
