@@ -794,11 +794,8 @@ class _Executor:
         try:
             for probe in self.run(node.left):
                 if pstore is None:
-                    pstore = HostPartitionStore(
-                        probe.schema, store.n,
-                        disk_threshold=self.pool.disk_threshold,
-                        disk_dir=self.pool.spill_dir,
-                        stats=self.pool.stats)
+                    pstore = HostPartitionStore(probe.schema, store.n,
+                                                pool=self.pool)
                 pstore.add(probe, list(node.left_keys))
             if pstore is None:
                 return

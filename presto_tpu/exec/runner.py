@@ -122,6 +122,18 @@ class LocalRunner:
             import time as _time
             t0 = _time.perf_counter()
             plan = optimize(plan_query(stmt.statement, session), session)
+            if stmt.type == "validate":
+                return QueryResult(["Valid"], [T.BOOLEAN], [(True,)])
+            if stmt.type == "io":
+                import json as _json
+
+                from ..planner.printer import plan_io
+                doc = _json.dumps(plan_io(plan), indent=2)
+                return QueryResult(["Query Plan"], [T.VARCHAR],
+                                   [(line,) for line in doc.split("\n")])
+            if stmt.analyze and stmt.format != "text":
+                raise ValueError(
+                    "EXPLAIN ANALYZE only supports FORMAT TEXT")
             stats = None
             if stmt.analyze:
                 # EXPLAIN ANALYZE: run the query with per-operator stats,
@@ -134,7 +146,23 @@ class LocalRunner:
                 execute_plan(plan, session, self.rows_per_batch,
                              stats=stats, collect_rows=False)
                 stats.total_wall_s = _time.perf_counter() - t1
-            text = print_plan(plan, stats)
+            if stmt.type == "distributed":
+                if stmt.format != "text":
+                    raise ValueError(
+                        "EXPLAIN (TYPE DISTRIBUTED) only supports "
+                        "FORMAT TEXT")
+                from ..planner.printer import print_distributed_plan
+                text = print_distributed_plan(plan)
+            elif stmt.format == "json":
+                import json as _json
+
+                from ..planner.printer import plan_json
+                text = _json.dumps(plan_json(plan), indent=2)
+            elif stmt.format == "graphviz":
+                from ..planner.printer import plan_graphviz
+                text = plan_graphviz(plan)
+            else:
+                text = print_plan(plan, stats)
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.split("\n")])
         if isinstance(stmt, A.ShowCatalogs):

@@ -148,15 +148,11 @@ class HostPartitionStore:
     compressed page per (chunk, partition)."""
 
     def __init__(self, schema: Schema, n_partitions: int,
-                 disk_threshold: Optional[int] = None,
-                 disk_dir: Optional[str] = None,
-                 stats=None):
+                 pool: Optional[QueryMemoryPool] = None):
         self.schema = schema
         self.n = n_partitions
         self.chunks: List[_StagedChunk] = []
-        self.disk_threshold = disk_threshold
-        self.disk_dir = disk_dir
-        self.stats = stats
+        self.pool = pool
         self.host_bytes = 0
         self._file: Optional[SpillFile] = None
         # per partition: [(offset, length)] fragments in the spill file
@@ -165,8 +161,12 @@ class HostPartitionStore:
 
     def add(self, batch: Batch, key_cols: Sequence[int]) -> int:
         """Stage a device batch; returns the device bytes it occupied."""
-        if self.n == 1 or not key_cols:
+        if self.n == 1:
             ch = _stage_chunk(batch)        # single partition: no hashing
+        elif not key_cols:
+            # bounds=None would alias every row into all n partitions
+            raise ValueError(
+                "multi-partition staging requires key columns")
         else:
             pid = hash_partition_ids(batch, list(key_cols), self.n)
             ch = _stage_chunk(batch, pid, self.n)
@@ -174,17 +174,28 @@ class HostPartitionStore:
             self._flush_chunk(ch)
         else:
             self.chunks.append(ch)
-            self.host_bytes += _chunk_host_bytes(ch)
-            if (self.disk_threshold is not None
-                    and self.host_bytes > self.disk_threshold):
-                self._flush_to_disk()
+            nb = _chunk_host_bytes(ch)
+            self.host_bytes += nb
+            pool = self.pool
+            if pool is not None:
+                # the staging budget is QUERY-wide (reference
+                # NodeSpillConfig.maxSpillPerNode): all stores share the
+                # pool counter, so N concurrent buffers can't each claim
+                # the full threshold
+                pool.host_staged_bytes += nb
+                if (pool.disk_threshold is not None
+                        and pool.host_staged_bytes > pool.disk_threshold):
+                    self._flush_to_disk()
         return batch_device_bytes(batch)
 
     def _flush_to_disk(self) -> None:
-        self._file = SpillFile(self.disk_dir)
+        self._file = SpillFile(
+            None if self.pool is None else self.pool.spill_dir)
         for ch in self.chunks:
             self._flush_chunk(ch)
         self.chunks = []
+        if self.pool is not None:
+            self.pool.host_staged_bytes -= self.host_bytes
         self.host_bytes = 0
 
     def _flush_chunk(self, ch: _StagedChunk) -> None:
@@ -198,8 +209,8 @@ class HostPartitionStore:
                            [v[rows] for v in ch.valids],
                            ch.dicts, compress=True)
             self._frags[p].append(self._file.append(page))
-            if self.stats is not None:
-                self.stats.disk_spilled_bytes += len(page)
+            if self.pool is not None:
+                self.pool.stats.disk_spilled_bytes += len(page)
 
     def _disk_chunks(self, p: int) -> Iterator[Tuple[_StagedChunk, np.ndarray]]:
         from .pages import deserialize_arrays
@@ -220,6 +231,9 @@ class HostPartitionStore:
         if self._file is not None:
             self._file.close()
             self._file = None
+        if self.pool is not None and self.host_bytes:
+            self.pool.host_staged_bytes -= self.host_bytes
+            self.host_bytes = 0
 
     def partition_batch(self, p: int) -> Optional[Batch]:
         """The whole partition as one device batch (build sides)."""
@@ -276,11 +290,8 @@ class SpillableBuildBuffer:
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:
-            pool = self.ctx.pool
-            self.store = HostPartitionStore(
-                b.schema, self.n_partitions,
-                disk_threshold=pool.disk_threshold,
-                disk_dir=pool.spill_dir, stats=pool.stats)
+            self.store = HostPartitionStore(b.schema, self.n_partitions,
+                                            pool=self.ctx.pool)
         n = self.store.add(b, self.key_cols)
         self.ctx.pool.stats.spilled_bytes += n
         return n
@@ -357,11 +368,8 @@ class AggSpillBuffer:
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:
-            pool = self.ctx.pool
-            self.store = HostPartitionStore(
-                b.schema, self.n_partitions,
-                disk_threshold=pool.disk_threshold,
-                disk_dir=pool.spill_dir, stats=pool.stats)
+            self.store = HostPartitionStore(b.schema, self.n_partitions,
+                                            pool=self.ctx.pool)
         n = self.store.add(b, self.key_idx)
         self.ctx.pool.stats.spilled_bytes += n
         return n
@@ -431,12 +439,10 @@ class SortSpillBuffer:
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:
-            pool = self.ctx.pool
             # one partition: sort wants everything back in one readback,
             # but still rides the two-tier (DRAM -> disk) staging
-            self.store = HostPartitionStore(
-                b.schema, 1, disk_threshold=pool.disk_threshold,
-                disk_dir=pool.spill_dir, stats=pool.stats)
+            self.store = HostPartitionStore(b.schema, 1,
+                                            pool=self.ctx.pool)
         n = self.store.add(b, [])
         self.ctx.pool.stats.spilled_bytes += n
         return n

@@ -56,6 +56,8 @@ class QueryMemoryPool:
         # (reference NodeSpillConfig.maxSpillPerNode + spiller-spill-path)
         self.disk_threshold = disk_threshold
         self.spill_dir = spill_dir
+        # host DRAM currently staged by ALL of this query's spill stores
+        self.host_staged_bytes = 0
         self.reserved = 0
         self.stats = MemoryStats()
         self._contexts: List["OperatorMemoryContext"] = []

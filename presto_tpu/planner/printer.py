@@ -15,6 +15,85 @@ from .plan import (
 from .planner import LogicalPlan
 
 
+def plan_json(plan: LogicalPlan) -> dict:
+    """Plan tree as a JSON-able dict — EXPLAIN (FORMAT JSON) (reference
+    planprinter/JsonRenderer.java)."""
+    def node_doc(n: PlanNode) -> dict:
+        return {
+            "name": type(n).__name__.replace("Node", ""),
+            "label": _label(n),
+            "outputs": [{"symbol": f.name, "type": f.type.display()}
+                        for f in n.fields],
+            "children": [node_doc(c) for c in n.children],
+        }
+    doc = node_doc(plan.root)
+    if plan.init_plans:
+        doc["initPlans"] = [node_doc(p) for p in plan.init_plans]
+    return doc
+
+
+def plan_graphviz(plan: LogicalPlan) -> str:
+    """dot digraph — EXPLAIN (FORMAT GRAPHVIZ) (reference
+    planprinter/GraphvizPrinter.java)."""
+    lines = ["digraph logical_plan {", "  node [shape=box];"]
+    counter = [0]
+
+    def walk(n: PlanNode) -> int:
+        my_id = counter[0]
+        counter[0] += 1
+        label = _label(n).replace('"', "'")
+        lines.append(f'  n{my_id} [label="{label}"];')
+        for c in n.children:
+            cid = walk(c)
+            lines.append(f"  n{my_id} -> n{cid};")
+        return my_id
+
+    walk(plan.root)
+    for p in plan.init_plans:
+        walk(p)
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def print_distributed_plan(plan: LogicalPlan) -> str:
+    """Fragmented plan with per-fragment partitioning and output spec —
+    EXPLAIN (TYPE DISTRIBUTED) (reference PlanPrinter.textDistributedPlan
+    over PlanFragmenter output)."""
+    from .fragmenter import fragment_plan
+    fp = fragment_plan(plan.root)
+    lines: List[str] = []
+    for frag in fp.fragments:
+        out = frag.output
+        spec = "" if out is None else (
+            f" => {out.kind}" + (f"{list(out.keys)}"
+                                 if out.kind == "partition" else ""))
+        lines.append(f"Fragment {frag.id} [{frag.partitioning}]{spec}")
+        _walk(frag.root, 1, lines)
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+def plan_io(plan: LogicalPlan) -> dict:
+    """Catalog/table access summary — EXPLAIN (TYPE IO) (reference
+    planprinter/IoPlanPrinter.java)."""
+    tables = []
+
+    def walk(n: PlanNode) -> None:
+        if isinstance(n, TableScanNode):
+            tables.append({
+                "catalog": n.catalog,
+                "schema": n.table.schema,
+                "table": n.table.table,
+                "columns": list(n.columns)})
+        for c in n.children:
+            walk(c)
+
+    walk(plan.root)
+    for p in plan.init_plans:
+        walk(p)
+    return {"inputTableColumnInfos": tables}
+
+
 def print_plan(plan: LogicalPlan, stats=None) -> str:
     """Text plan; with a StatsCollector, annotates each node with runtime
     stats — EXPLAIN ANALYZE (reference planprinter/PlanPrinter.java

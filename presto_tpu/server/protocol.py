@@ -175,6 +175,44 @@ class _Query:
                 break
 
 
+#: single-page query console (the role of the reference's React webapp,
+#: presto-main/src/main/resources/webapp/index.html query list — one
+#: dependency-free page polling /v1/query)
+_UI_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>presto-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#16181d;
+      color:#e8e8e8}
+ h1{font-size:1.2rem} table{border-collapse:collapse;width:100%}
+ th,td{text-align:left;padding:.35rem .6rem;border-bottom:1px solid #333;
+       font-size:.85rem} th{color:#9aa}
+ td.sql{font-family:ui-monospace,monospace;white-space:pre-wrap;
+        word-break:break-word;max-width:48rem}
+ .FINISHED{color:#6c6}.FAILED{color:#e66}.RUNNING{color:#fd5}
+ .muted{color:#789;font-size:.8rem}
+</style></head><body>
+<h1>presto-tpu &mdash; queries</h1>
+<div class="muted" id="meta"></div>
+<table><thead><tr><th>id</th><th>state</th><th>elapsed</th><th>query</th>
+</tr></thead><tbody id="rows"></tbody></table>
+<script>
+async function refresh(){
+  const r = await fetch('/v1/query');
+  const qs = await r.json();
+  document.getElementById('meta').textContent =
+    qs.length + ' queries \\u00b7 refreshed ' +
+    new Date().toLocaleTimeString();
+  document.getElementById('rows').innerHTML = qs.map(q =>
+    '<tr><td>'+q.queryId+'</td><td class="'+q.state+'">'+q.state+
+    '</td><td>'+q.elapsedMs+'ms</td><td class="sql">'+
+    q.query.replace(/&/g,'&amp;').replace(/</g,'&lt;')+
+    '</td></tr>').join('');
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "presto-tpu"
     protocol_version = "HTTP/1.1"
@@ -229,6 +267,23 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path.rstrip("/") == "/v1/resourceGroup":
             self._reply(200, {"groups": self._srv.resource_groups.info()})
+            return
+        if self.path.rstrip("/") == "/v1/query":
+            # query list for the UI (reference server/QueryResource.java)
+            out = []
+            for e in list(self._srv.runner.query_log)[-200:][::-1]:
+                out.append({"queryId": e.query_id, "state": e.state,
+                            "query": e.query,
+                            "elapsedMs": round(e.elapsed_ms, 1)})
+            self._reply(200, out)
+            return
+        if self.path.rstrip("/") in ("/ui", ""):
+            body = _UI_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         m = self._match_executing()
         if m is None:

@@ -367,7 +367,8 @@ class SetOperation(Node):
 class Explain(Node):
     statement: Node
     analyze: bool = False
-    type: str = "logical"          # logical|distributed|io
+    type: str = "logical"          # logical|distributed|validate|io
+    format: str = "text"           # text|json|graphviz
 
 
 @dataclasses.dataclass(frozen=True)

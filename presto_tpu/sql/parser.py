@@ -108,8 +108,35 @@ class _Parser:
     def statement(self) -> A.Node:
         if self.at_kw("explain"):
             self.next()
+            etype, fmt = "logical", "text"
+            if self.at_op("(") and self.peek(1).text.lower() in (
+                    "type", "format"):
+                self.next()
+                while True:
+                    t = self.next()
+                    word = t.text.lower()
+                    if word == "type":
+                        etype = self.next().text.lower()
+                        if etype not in ("logical", "distributed",
+                                         "validate", "io"):
+                            raise SqlSyntaxError(
+                                f"unknown EXPLAIN type {etype!r}",
+                                t.line, t.col)
+                    elif word == "format":
+                        fmt = self.next().text.lower()
+                        if fmt not in ("text", "json", "graphviz"):
+                            raise SqlSyntaxError(
+                                f"unknown EXPLAIN format {fmt!r}",
+                                t.line, t.col)
+                    else:
+                        raise SqlSyntaxError(
+                            "expected TYPE or FORMAT", t.line, t.col)
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
             analyze = self.accept_kw("analyze")
-            return A.Explain(self.statement(), analyze=analyze)
+            return A.Explain(self.statement(), analyze=analyze,
+                             type=etype, format=fmt)
         if self.at_kw("show"):
             return self._show()
         if self.at_kw("describe"):

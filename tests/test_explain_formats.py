@@ -1,0 +1,79 @@
+"""EXPLAIN (TYPE ...) / (FORMAT ...) variants and the web UI endpoints
+(reference sql/planner/planprinter/: PlanPrinter, JsonRenderer,
+GraphvizPrinter, IoPlanPrinter; webapp query console)."""
+import json
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.exec.runner import LocalRunner
+    return LocalRunner(tpch_sf=0.001)
+
+
+Q = ("select l_returnflag, count(*) from lineitem, orders "
+     "where l_orderkey = o_orderkey group by 1 order by 1")
+
+
+def text_of(runner, sql):
+    return "\n".join(r[0] for r in runner.execute(sql).rows)
+
+
+def test_explain_distributed(runner):
+    text = text_of(runner, f"explain (type distributed) {Q}")
+    assert "Fragment 0" in text and "Fragment" in text
+    assert "RemoteSource" in text
+    assert "partition" in text or "single" in text
+
+
+def test_explain_validate(runner):
+    assert runner.execute(f"explain (type validate) {Q}").rows == [(True,)]
+    with pytest.raises(Exception):
+        runner.execute("explain (type validate) select nope from nation")
+
+
+def test_explain_io(runner):
+    doc = json.loads(text_of(runner, f"explain (type io) {Q}"))
+    tables = {t["table"] for t in doc["inputTableColumnInfos"]}
+    assert tables == {"lineitem", "orders"}
+
+
+def test_explain_json(runner):
+    doc = json.loads(text_of(runner, f"explain (format json) {Q}"))
+    assert doc["name"] == "Output"
+    assert doc["children"]
+
+    def names(n):
+        yield n["name"]
+        for c in n["children"]:
+            yield from names(c)
+    assert "TableScan" in set(names(doc))
+
+
+def test_explain_graphviz(runner):
+    text = text_of(runner, f"explain (format graphviz) {Q}")
+    assert text.startswith("digraph") and "->" in text
+
+
+def test_explain_default_unchanged(runner):
+    text = text_of(runner, f"explain {Q}")
+    assert "Output" in text and "TableScan" in text
+
+
+def test_ui_endpoints(runner):
+    import urllib.request
+
+    from presto_tpu.server.protocol import PrestoTpuServer
+    srv = PrestoTpuServer(runner=runner)
+    srv.start()
+    try:
+        runner.execute("select 1")
+        base = f"http://127.0.0.1:{srv.port}"
+        qs = json.loads(urllib.request.urlopen(base + "/v1/query").read())
+        assert qs and {"queryId", "state", "query",
+                       "elapsedMs"} <= set(qs[0])
+        html = urllib.request.urlopen(base + "/ui").read().decode()
+        assert "presto-tpu" in html and "/v1/query" in html
+    finally:
+        srv.stop()
