@@ -42,17 +42,58 @@ def format_aligned(columns, rows) -> str:
     return "\n".join(lines)
 
 
+def format_separated(columns, rows, sep: str, header: bool) -> str:
+    """CSV/TSV output (reference presto-cli OutputFormat CSV/TSV[_HEADER]):
+    CSV quotes every field, TSV escapes separators."""
+    def cell(v) -> str:
+        if v is None:
+            return ""
+        s = str(v)
+        if sep == ",":
+            return '"' + s.replace('"', '""') + '"'
+        return (s.replace("\\", "\\\\").replace("\t", "\\t")
+                .replace("\n", "\\n"))
+
+    lines = []
+    if header:
+        lines.append(sep.join(cell(c[0]) for c in columns))
+    lines += [sep.join(cell(v) for v in r) for r in rows]
+    return "\n".join(lines)
+
+
+def format_json(columns, rows) -> str:
+    import json
+    names = [c[0] for c in columns]
+    return "\n".join(
+        json.dumps(dict(zip(names, r)), default=str) for r in rows)
+
+
+def format_rows(columns, rows, output_format: str) -> str:
+    f = output_format.upper()
+    if f == "ALIGNED":
+        return format_aligned(columns, rows)
+    if f in ("CSV", "CSV_HEADER"):
+        return format_separated(columns, rows, ",", f.endswith("HEADER"))
+    if f in ("TSV", "TSV_HEADER"):
+        return format_separated(columns, rows, "\t", f.endswith("HEADER"))
+    if f == "JSON":
+        return format_json(columns, rows)
+    raise ValueError(f"unknown output format {output_format!r}")
+
+
 def run_statement(client: StatementClient, sql: str,
-                  out=sys.stdout) -> None:
+                  out=None, output_format: str = "ALIGNED") -> None:
+    out = out if out is not None else sys.stdout
     try:
         res = client.execute(sql)
     except QueryFailed as e:
         print(f"Query failed: {e}", file=sys.stderr)
         return
     if res.columns:
-        print(format_aligned(res.columns, res.rows), file=out)
-    print(f"({len(res.rows)} row{'s' if len(res.rows) != 1 else ''})",
-          file=out)
+        print(format_rows(res.columns, res.rows, output_format), file=out)
+    if output_format.upper() == "ALIGNED":
+        print(f"({len(res.rows)} row{'s' if len(res.rows) != 1 else ''})",
+              file=out)
 
 
 def main(argv=None) -> int:
@@ -64,6 +105,13 @@ def main(argv=None) -> int:
     ap.add_argument("--user", default="presto")
     ap.add_argument("--execute", "-e", default=None,
                     help="run this statement and exit")
+    ap.add_argument("--output-format", default="ALIGNED",
+                    choices=["ALIGNED", "CSV", "CSV_HEADER", "TSV",
+                             "TSV_HEADER", "JSON"],
+                    help="result rendering (reference presto-cli "
+                         "OutputFormat)")
+    ap.add_argument("--password", default=None,
+                    help="password for HTTP basic authentication")
     ap.add_argument("--sf", type=float, default=0.01,
                     help="tpch scale factor for the embedded server")
     args = ap.parse_args(argv)
@@ -79,12 +127,13 @@ def main(argv=None) -> int:
         print(f"embedded server at {url}", file=sys.stderr)
 
     client = StatementClient(url, user=args.user, catalog=args.catalog,
-                             schema=args.schema)
+                             schema=args.schema, password=args.password)
     try:
         if args.execute is not None:
             for stmt in args.execute.split(";"):
                 if stmt.strip():
-                    run_statement(client, stmt)
+                    run_statement(client, stmt,
+                                  output_format=args.output_format)
             return 0
         buf = ""
         while True:
@@ -99,7 +148,8 @@ def main(argv=None) -> int:
                 if stmt.strip():
                     if stmt.strip().lower() in ("quit", "exit"):
                         return 0
-                    run_statement(client, stmt)
+                    run_statement(client, stmt,
+                                  output_format=args.output_format)
         return 0
     finally:
         if embedded is not None:

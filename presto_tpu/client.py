@@ -33,18 +33,25 @@ class StatementClient:
     def __init__(self, base_url: str, user: str = "presto",
                  catalog: Optional[str] = None,
                  schema: Optional[str] = None,
-                 timeout: float = 3600.0):
+                 timeout: float = 3600.0,
+                 password: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.user = user
         self.catalog = catalog
         self.schema = schema
         self.timeout = timeout
+        self.password = password
         self.session_properties: Dict[str, str] = {}
 
     # -- protocol ------------------------------------------------------------
     def _request(self, url: str, method: str = "GET",
                  body: Optional[bytes] = None):
         headers = {"X-Presto-User": self.user}
+        if self.password is not None:
+            import base64
+            raw = f"{self.user}:{self.password}".encode()
+            headers["Authorization"] = \
+                "Basic " + base64.b64encode(raw).decode()
         if self.catalog:
             headers["X-Presto-Catalog"] = self.catalog
         if self.schema:

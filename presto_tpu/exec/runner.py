@@ -120,6 +120,10 @@ class LocalRunner:
             if not isinstance(stmt.statement, A.Query):
                 raise ValueError("EXPLAIN requires a query")
             import time as _time
+            if stmt.analyze and (stmt.type != "logical"
+                                 or stmt.format != "text"):
+                raise ValueError(
+                    "EXPLAIN ANALYZE does not take TYPE/FORMAT options")
             t0 = _time.perf_counter()
             plan = optimize(plan_query(stmt.statement, session), session)
             if stmt.type == "validate":
@@ -131,9 +135,6 @@ class LocalRunner:
                 doc = _json.dumps(plan_io(plan), indent=2)
                 return QueryResult(["Query Plan"], [T.VARCHAR],
                                    [(line,) for line in doc.split("\n")])
-            if stmt.analyze and stmt.format != "text":
-                raise ValueError(
-                    "EXPLAIN ANALYZE only supports FORMAT TEXT")
             stats = None
             if stmt.analyze:
                 # EXPLAIN ANALYZE: run the query with per-operator stats,

@@ -60,16 +60,23 @@ def print_distributed_plan(plan: LogicalPlan) -> str:
     EXPLAIN (TYPE DISTRIBUTED) (reference PlanPrinter.textDistributedPlan
     over PlanFragmenter output)."""
     from .fragmenter import fragment_plan
-    fp = fragment_plan(plan.root)
     lines: List[str] = []
-    for frag in fp.fragments:
-        out = frag.output
-        spec = "" if out is None else (
-            f" => {out.kind}" + (f"{list(out.keys)}"
-                                 if out.kind == "partition" else ""))
-        lines.append(f"Fragment {frag.id} [{frag.partitioning}]{spec}")
-        _walk(frag.root, 1, lines)
-        lines.append("")
+
+    def render(root: PlanNode) -> None:
+        fp = fragment_plan(root)
+        for frag in fp.fragments:
+            out = frag.output
+            spec = "" if out is None else (
+                f" => {out.kind}" + (f"{list(out.keys)}"
+                                     if out.kind == "partition" else ""))
+            lines.append(f"Fragment {frag.id} [{frag.partitioning}]{spec}")
+            _walk(frag.root, 1, lines)
+            lines.append("")
+
+    render(plan.root)
+    for i, init in enumerate(plan.init_plans):
+        lines.append(f"InitPlan[{i}]:")
+        render(init)
     return "\n".join(lines).rstrip()
 
 

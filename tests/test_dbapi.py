@@ -1,0 +1,163 @@
+"""DB-API 2.0 interface and CLI output formats (reference presto-jdbc
+PrestoConnection/PrestoResultSet; presto-cli OutputFormat)."""
+import json
+
+import pytest
+
+from presto_tpu import dbapi
+
+
+@pytest.fixture(scope="module")
+def server():
+    from presto_tpu.exec.runner import LocalRunner
+    from presto_tpu.server.protocol import PrestoTpuServer
+    srv = PrestoTpuServer(runner=LocalRunner(tpch_sf=0.001))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def conn(server):
+    c = dbapi.connect(port=server.port, catalog="tpch", schema="default")
+    yield c
+    c.close()
+
+
+def test_module_globals():
+    assert dbapi.apilevel == "2.0"
+    assert dbapi.paramstyle == "qmark"
+
+
+def test_basic_query(conn):
+    cur = conn.cursor()
+    cur.execute("select n_name, n_nationkey from nation "
+                "where n_nationkey < 3 order by 2")
+    assert cur.rowcount == 3
+    assert [d[0] for d in cur.description] == ["n_name", "n_nationkey"]
+    assert cur.fetchone() == ("ALGERIA", 0)
+    assert cur.fetchmany(1) == [("ARGENTINA", 1)]
+    assert cur.fetchall() == [("BRAZIL", 2)]
+    assert cur.fetchone() is None
+
+
+def test_cursor_iteration(conn):
+    cur = conn.cursor()
+    cur.execute("select n_nationkey from nation order by 1 limit 4")
+    assert [r[0] for r in cur] == [0, 1, 2, 3]
+
+
+def test_qmark_parameters(conn):
+    cur = conn.cursor()
+    cur.execute("select n_name from nation where n_nationkey = ?", (3,))
+    assert cur.fetchall() == [("CANADA",)]
+    cur.execute("select n_name from nation where n_name = ?", ("PERU",))
+    assert cur.fetchall() == [("PERU",)]
+
+
+def test_string_escaping(conn):
+    cur = conn.cursor()
+    cur.execute("select ? as v", ("it's",))
+    assert cur.fetchall() == [("it's",)]
+
+
+def test_question_mark_in_string_literal(conn):
+    cur = conn.cursor()
+    cur.execute("select '?' as q, ? as v", (7,))
+    assert cur.fetchall() == [("?", 7)]
+
+
+def test_parameter_count_mismatch(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select ? as v", (1, 2))
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select ?, ? ", (1,))
+
+
+def test_date_parameter(conn):
+    import datetime
+    cur = conn.cursor()
+    cur.execute("select ? < date '2021-01-01'",
+                (datetime.date(2020, 5, 5),))
+    assert cur.fetchall() == [(True,)]
+
+
+def test_error_maps_to_database_error(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.DatabaseError):
+        cur.execute("select no_such from nation")
+
+
+def test_closed_cursor_rejects(conn):
+    cur = conn.cursor()
+    cur.close()
+    with pytest.raises(dbapi.InterfaceError):
+        cur.execute("select 1")
+
+
+def test_context_managers(server):
+    with dbapi.connect(port=server.port, catalog="tpch") as c:
+        with c.cursor() as cur:
+            cur.execute("select count(*) from region")
+            assert cur.fetchone() == (5,)
+
+
+def test_placeholder_in_comment_ignored(conn):
+    cur = conn.cursor()
+    cur.execute("select ? as v -- trailing comment?", (5,))
+    assert cur.fetchall() == [(5,)]
+    cur.execute("select ? as v /* block ? comment */", (6,))
+    assert cur.fetchall() == [(6,)]
+
+
+def test_escaped_quote_in_string(conn):
+    cur = conn.cursor()
+    cur.execute("select 'it''s' as s, ? as v", (1,))
+    assert cur.fetchall() == [("it's", 1)]
+
+
+def test_empty_params_with_placeholder_rejected(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select ? as v", ())
+
+
+def test_commit_without_transaction_ok(conn):
+    conn.commit()
+    conn.rollback()
+
+
+# -- CLI output formats ------------------------------------------------------
+
+COLS = [("a", "bigint"), ("b", "varchar")]
+ROWS = [(1, "x,y"), (2, None)]
+
+
+def test_format_csv():
+    from presto_tpu.cli import format_rows
+    out = format_rows(COLS, ROWS, "CSV")
+    assert out == '"1","x,y"\n"2",'
+    assert format_rows(COLS, ROWS, "CSV_HEADER").startswith('"a","b"\n')
+
+
+def test_format_tsv():
+    from presto_tpu.cli import format_rows
+    assert format_rows(COLS, [(1, "a\tb")], "TSV") == "1\ta\\tb"
+
+
+def test_format_json():
+    from presto_tpu.cli import format_rows
+    lines = format_rows(COLS, ROWS, "JSON").split("\n")
+    assert json.loads(lines[0]) == {"a": 1, "b": "x,y"}
+    assert json.loads(lines[1]) == {"a": 2, "b": None}
+
+
+def test_cli_execute_csv(server, capsys):
+    from presto_tpu.cli import main
+    rc = main(["--server", f"http://127.0.0.1:{server.port}",
+               "--catalog", "tpch", "--output-format", "CSV_HEADER",
+               "-e", "select n_nationkey from nation order by 1 limit 2"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().split("\n")
+    assert out == ['"n_nationkey"', '"0"', '"1"']

@@ -56,6 +56,20 @@ def test_explain_graphviz(runner):
     assert text.startswith("digraph") and "->" in text
 
 
+def test_explain_analyze_rejects_options(runner):
+    with pytest.raises(ValueError, match="ANALYZE"):
+        runner.execute("explain (type distributed) analyze select 1")
+    with pytest.raises(ValueError, match="ANALYZE"):
+        runner.execute("explain (format json) analyze select 1")
+
+
+def test_explain_distributed_includes_init_plans(runner):
+    text = text_of(runner, "explain (type distributed) "
+                           "select n_name, (select max(r_regionkey) "
+                           "from region) mx from nation")
+    assert "InitPlan" in text and "region" in text
+
+
 def test_explain_default_unchanged(runner):
     text = text_of(runner, f"explain {Q}")
     assert "Output" in text and "TableScan" in text
