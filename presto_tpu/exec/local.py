@@ -367,28 +367,36 @@ class _Executor:
         import threading
 
         conn = self.session.catalogs.get(node.catalog)
-        pushdown = node.pushdown or None
-        dyn = self.dynamic_pushdown.get(node)
-        if dyn:
-            # intersect per column: connectors keep one bound per name,
-            # so appending would let a wider dynamic bound shadow a
-            # tighter WHERE-derived one
-            merged: Dict[str, List] = {}
-            for name, lo, hi in list(pushdown or ()) + dyn:
-                b = merged.setdefault(name, [lo, hi])
-                if lo is not None:
-                    b[0] = lo if b[0] is None else max(b[0], lo)
-                if hi is not None:
-                    b[1] = hi if b[1] is None else min(b[1], hi)
-            pushdown = tuple((n, lo, hi)
-                             for n, (lo, hi) in merged.items())
+
+        def current_pushdown():
+            """Re-evaluated per split: dynamic (join build) bounds may
+            arrive while earlier splits are already streaming — later
+            splits still benefit (the reference's dynamic filters race
+            the probe scan the same way)."""
+            pushdown = node.pushdown or None
+            dyn = self.dynamic_pushdown.get(node)
+            if dyn:
+                # intersect per column: connectors keep one bound per
+                # name, so appending would let a wider dynamic bound
+                # shadow a tighter WHERE-derived one
+                merged: Dict[str, List] = {}
+                for name, lo, hi in list(pushdown or ()) + dyn:
+                    b = merged.setdefault(name, [lo, hi])
+                    if lo is not None:
+                        b[0] = lo if b[0] is None else max(b[0], lo)
+                    if hi is not None:
+                        b[1] = hi if b[1] is None else min(b[1], hi)
+                pushdown = tuple((n, lo, hi)
+                                 for n, (lo, hi) in merged.items())
+            return pushdown
+
         n_threads = int(self.session.properties.get("scan_threads", 2))
         splits = conn.split_manager.splits(
             node.table, max(n_threads, 1))
         if n_threads <= 1 or len(splits) <= 1:
             for split in splits:
                 src = conn.page_source(split, list(node.columns),
-                                       pushdown=pushdown,
+                                       pushdown=current_pushdown(),
                                        rows_per_batch=self.rows_per_batch)
                 yield from src.batches()
             return
@@ -677,16 +685,27 @@ class _Executor:
             return
         # grouped: partial per input batch, hierarchical merge (spillable
         # state, hash-partitioned by group keys under memory pressure),
-        # final per state / per spilled partition
+        # final per state / per spilled partition. With task_concurrency
+        # > 1, partials run on N driver threads over a round-robin local
+        # exchange (reference AddLocalExchanges + multi-driver pipelines)
+        from .local_exchange import parallel_drivers
         from .spill import AggSpillBuffer
         key_idx = list(range(len(group)))
         buf = AggSpillBuffer(self.pool, "hash-agg", key_idx, aggs,
                              self.spill_partitions)
+        concurrency = int(self.session.properties.get(
+            "task_concurrency", 1))
         try:
-            for b in self.run(node.child):
-                buf.add_partial(
-                    b if step == "final"
-                    else grouped_aggregate(b, group, aggs, mode="partial"))
+            if step == "final":
+                partials = self.run(node.child)
+            else:
+                partials = parallel_drivers(
+                    self.run(node.child),
+                    lambda b: grouped_aggregate(b, group, aggs,
+                                                mode="partial"),
+                    concurrency)
+            for p in partials:
+                buf.add_partial(p)
             yield from buf.results(final=step != "partial")
         finally:
             buf.close()
@@ -709,10 +728,19 @@ class _Executor:
                     "residual predicate on LEFT JOIN")
             residual_fn = self.checked_filter(residual, _plan_schema(node))
 
+        from .local_exchange import exchange_source
         from .spill import HostPartitionStore, SpillableBuildBuffer
         buf = SpillableBuildBuffer(self.pool, "join-build",
                                    list(node.right_keys),
                                    self.spill_partitions)
+        # inter-pipeline overlap: start the probe side's scan/decode in a
+        # background producer while the build side drains — the role of
+        # the reference's concurrently-running build and probe pipelines
+        # within one task (PhasedExecutionSchedule starts both stages)
+        probe_ex = None
+        if bool_property(self.session, "probe_prefetch", True):
+            probe_ex = exchange_source(self.run(node.left), "single", 1,
+                                       buffer_batches=4)
         try:
             for b in self.run(node.right):
                 buf.add(b)

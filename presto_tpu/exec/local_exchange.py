@@ -1,0 +1,180 @@
+"""Local exchange: batch redistribution between pipelines in one process.
+
+The role of the reference's intra-task exchange (reference
+presto-main/.../operator/exchange/LocalExchange.java:105-125 dispatching
+SINGLE / FIXED_BROADCAST / FIXED_ARBITRARY / FIXED_HASH /
+FIXED_PASSTHROUGH partitioning, LocalPartitionGenerator): producers push
+device batches into bounded per-consumer queues and N consumer iterators
+drain them. On a single TPU chip the device serializes kernels, so the
+parallelism this buys is HOST-side: overlapping host staging/decode with
+device dispatch, and letting independent pipeline stages (join build vs
+probe scan) run concurrently — the same reason the reference runs
+multiple drivers per task (execution/executor/TaskExecutor.java).
+
+Modes:
+- single:      every batch to consumer 0
+- broadcast:   every batch to every consumer (by reference — batches are
+               immutable device values)
+- round_robin: batch i to consumer i % n (FIXED_ARBITRARY's role)
+- hash:        rows split by key hash; consumer c gets the sub-batch
+               whose rows hash to c (FIXED_HASH; same splitmix64 row
+               hash as the distributed exchange, so colocation
+               agreements hold)
+- passthrough: producer p feeds consumer p 1:1 (FIXED_PASSTHROUGH)
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+from ..batch import Batch
+
+_DONE = object()
+
+MODES = ("single", "broadcast", "round_robin", "hash", "passthrough")
+
+
+class LocalExchange:
+    def __init__(self, mode: str, n_consumers: int,
+                 key_cols: Optional[Sequence[int]] = None,
+                 buffer_batches: int = 4):
+        assert mode in MODES, mode
+        if mode == "hash" and not key_cols:
+            raise ValueError("hash mode needs key columns")
+        self.mode = mode
+        self.n = n_consumers
+        self.key_cols = list(key_cols or ())
+        self._queues = [_queue.Queue(maxsize=buffer_batches)
+                        for _ in range(n_consumers)]
+        self._rr = 0
+        self._failed: Optional[BaseException] = None
+        self._closed = threading.Event()
+
+    # -- producer side -------------------------------------------------------
+    def push(self, batch: Batch, producer: int = 0) -> None:
+        if self.mode == "single":
+            self._put(0, batch)
+        elif self.mode == "broadcast":
+            for c in range(self.n):
+                self._put(c, batch)
+        elif self.mode == "round_robin":
+            self._put(self._rr % self.n, batch)
+            self._rr += 1
+        elif self.mode == "passthrough":
+            self._put(producer % self.n, batch)
+        else:    # hash
+            from ..parallel.exchange import hash_partition_ids
+            pid = hash_partition_ids(batch, self.key_cols, self.n)
+            for c in range(self.n):
+                keep = batch.row_mask & (pid == c)
+                self._put(c, Batch(batch.schema, batch.columns, keep))
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        """Signal end-of-stream (or failure) to every consumer."""
+        if error is not None:
+            self._failed = error
+        for c in range(self.n):
+            self._put(c, _DONE, force=True)
+
+    def close(self) -> None:
+        """Consumer-side abort: unblock producers (e.g. LIMIT satisfied)."""
+        self._closed.set()
+        for q in self._queues:
+            try:
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
+
+    def _put(self, c: int, item, force: bool = False) -> None:
+        while not self._closed.is_set():
+            try:
+                self._queues[c].put(item, timeout=0.1)
+                return
+            except _queue.Full:
+                if force:
+                    continue
+        if force:    # DONE must always land so consumers terminate
+            try:
+                self._queues[c].put_nowait(item)
+            except _queue.Full:
+                pass
+
+    # -- consumer side -------------------------------------------------------
+    def consumer(self, c: int) -> Iterator[Batch]:
+        q = self._queues[c]
+        while True:
+            item = q.get()
+            if item is _DONE:
+                if self._failed is not None:
+                    raise self._failed
+                return
+            yield item
+
+    def consumers(self) -> List[Iterator[Batch]]:
+        return [self.consumer(c) for c in range(self.n)]
+
+
+def exchange_source(batches: Iterator[Batch], mode: str, n_consumers: int,
+                    key_cols: Optional[Sequence[int]] = None,
+                    buffer_batches: int = 4) -> LocalExchange:
+    """Spawn a producer thread draining ``batches`` into a LocalExchange —
+    the driver-decoupling shape of LocalExchangeSourceOperator."""
+    ex = LocalExchange(mode, n_consumers, key_cols, buffer_batches)
+
+    def produce() -> None:
+        try:
+            for b in batches:
+                ex.push(b)
+        except BaseException as e:   # surfaced on the consumer side
+            ex.finish(e)
+            return
+        ex.finish()
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    return ex
+
+
+def parallel_drivers(batches: Iterator[Batch],
+                     driver_fn: Callable[[Batch], Batch],
+                     concurrency: int,
+                     buffer_batches: int = 4) -> Iterator[Batch]:
+    """Fan ``batches`` over N driver threads each applying ``driver_fn``,
+    yielding results as they complete (unordered) — the multi-driver
+    pipeline of reference SqlTaskExecution (one driver per split,
+    TaskExecutor time-slicing). Device kernels still serialize on the
+    chip; the win is overlapping the drivers' host-side work."""
+    if concurrency <= 1:
+        for b in batches:
+            yield driver_fn(b)
+        return
+    ex = exchange_source(batches, "round_robin", concurrency,
+                         buffer_batches=buffer_batches)
+    out: _queue.Queue = _queue.Queue(maxsize=concurrency * 2)
+    errors: List[BaseException] = []
+
+    def drive(c: int) -> None:
+        try:
+            for b in ex.consumer(c):
+                out.put(("row", driver_fn(b)))
+        except BaseException as e:
+            errors.append(e)
+        finally:
+            out.put(("done", None))
+
+    for c in range(concurrency):
+        threading.Thread(target=drive, args=(c,), daemon=True).start()
+    done = 0
+    try:
+        while done < concurrency:
+            kind, item = out.get()
+            if kind == "done":
+                done += 1
+                continue
+            yield item
+    finally:
+        ex.close()
+    if errors:
+        raise errors[0]
