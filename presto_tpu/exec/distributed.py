@@ -469,7 +469,10 @@ class DistributedExecutor(_Executor):
             return
         exprs = tuple(self._resolve(e) for e in node.exprs)
         fn = unnest_expand_fn(exprs, node.ordinality, _ps(node))
-        yield self._pad_shardable(fn(_to_host(b)))
+        out, err = fn(_to_host(b))
+        if err is not None:
+            self.error_flags.append(err)
+        yield self._pad_shardable(out)
 
     def _WindowNode(self, node) -> Iterator[Batch]:
         from ..ops.window import WindowSpec, evaluate_window

@@ -185,6 +185,11 @@ def unnest_expand_fn(exprs, ordinality: bool, schema: Schema):
         if not inputs:
             inputs = [Val(b.row_mask, b.row_mask, T.BOOLEAN)]
         arrs = [eval_expr(e, inputs) for e in exprs]
+        # row-level errors raised inside the array expressions (e.g.
+        # UNNEST(transform(a, x -> 1/x))) must fail the query, matching
+        # compile_projection(errors=True)
+        from ..expr.compiler import _err_scalar
+        err_scalar = _err_scalar([a.err for a in arrs], b.row_mask)
         widths = [a.data[0].shape[1] for a in arrs]
         L = max(widths)
         cap = b.capacity
@@ -215,7 +220,7 @@ def unnest_expand_fn(exprs, ordinality: bool, schema: Schema):
             cols.append(Column(T.BIGINT,
                                (slot + 1).astype(jnp.int64).reshape(-1),
                                out_mask, None))
-        return Batch(schema, cols, out_mask)
+        return Batch(schema, cols, out_mask), err_scalar
 
     return expand
 
@@ -427,7 +432,8 @@ class _Executor:
                     return
                 try:
                     src = conn.page_source(
-                        splits[i], list(node.columns), pushdown=pushdown,
+                        splits[i], list(node.columns),
+                        pushdown=current_pushdown(),
                         rows_per_batch=self.rows_per_batch)
                     for b in src.batches():
                         if not put(queues[i], b):
@@ -529,7 +535,10 @@ class _Executor:
         fn = unnest_expand_fn(exprs, node.ordinality, _plan_schema(node))
         compact = self._compactor()
         for b in self.run(node.child):
-            yield compact(fn(b))
+            out, err = fn(b)
+            if err is not None:
+                self.error_flags.append(err)
+            yield compact(out)
 
     def _GroupIdNode(self, node: GroupIdNode) -> Iterator[Batch]:
         """One replica batch per grouping set: absent keys get their
@@ -741,13 +750,18 @@ class _Executor:
         if bool_property(self.session, "probe_prefetch", True):
             probe_ex = exchange_source(self.run(node.left), "single", 1,
                                        buffer_batches=4)
+
+        def probe_stream() -> Iterator[Batch]:
+            return (probe_ex.consumer(0) if probe_ex is not None
+                    else self.run(node.left))
         try:
             for b in self.run(node.right):
                 buf.add(b)
             build = buf.finish()
             if isinstance(build, HostPartitionStore):
                 yield from self._partitioned_join(
-                    node, build, payload, payload_names, residual_fn)
+                    node, build, payload, payload_names, residual_fn,
+                    probe_stream())
                 return
             dyn = None
             if (node.join_type == "inner" and build is not None
@@ -758,7 +772,7 @@ class _Executor:
                 if dyn:
                     self._push_dynamic_bounds(node.left, dyn)
             compact = self._compactor()
-            for probe in self.run(node.left):
+            for probe in probe_stream():
                 if build is None:
                     if node.join_type == "inner":
                         continue
@@ -772,6 +786,8 @@ class _Executor:
                     out = residual_fn(out)
                 yield compact(out)
         finally:
+            if probe_ex is not None:
+                probe_ex.close()
             buf.close()
 
     def _push_dynamic_bounds(self, probe: PlanNode,
@@ -812,15 +828,19 @@ class _Executor:
             self.dynamic_pushdown.setdefault(node, []).extend(extra)
 
     def _partitioned_join(self, node: JoinNode, store, payload,
-                          payload_names, residual_fn) -> Iterator[Batch]:
+                          payload_names, residual_fn,
+                          probe_batches: Optional[Iterator[Batch]] = None
+                          ) -> Iterator[Batch]:
         """Spilled-build probe: stage the probe side host-partitioned by
         the same key hash, then join partition-serially so only one build
         partition plus one probe chunk is device-resident at a time
         (reference GenericPartitioningSpiller.java probe protocol)."""
         from .spill import HostPartitionStore
         pstore: Optional[HostPartitionStore] = None
+        if probe_batches is None:
+            probe_batches = self.run(node.left)
         try:
-            for probe in self.run(node.left):
+            for probe in probe_batches:
                 if pstore is None:
                     pstore = HostPartitionStore(probe.schema, store.n,
                                                 pool=self.pool)

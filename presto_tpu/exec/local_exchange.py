@@ -126,10 +126,19 @@ def exchange_source(batches: Iterator[Batch], mode: str, n_consumers: int,
     def produce() -> None:
         try:
             for b in batches:
+                if ex._closed.is_set():
+                    # consumer aborted (LIMIT satisfied / query failed):
+                    # stop driving the upstream subplan, don't just drop
+                    # its batches
+                    break
                 ex.push(b)
         except BaseException as e:   # surfaced on the consumer side
             ex.finish(e)
             return
+        finally:
+            close = getattr(batches, "close", None)
+            if close is not None:
+                close()
         ex.finish()
 
     t = threading.Thread(target=produce, daemon=True)

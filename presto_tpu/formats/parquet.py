@@ -371,6 +371,15 @@ def _decode_stat(stats: Dict[int, object], col: ParquetColumn):
     # prefer min_value/max_value (field 6/5) over deprecated min/max (2/1)
     mn = dec(stats.get(6, stats.get(2)))
     mx = dec(stats.get(5, stats.get(1)))
+    if col.ts_mult != 1:
+        # stats are in the file's physical timestamp unit; convert to the
+        # engine's micros exactly like _storage_fix converts values (the
+        # floor in nanos->micros is monotonic, so converted stats remain
+        # valid bounds for converted data)
+        if mn is not None:
+            mn = int(_storage_fix(col, np.asarray([mn], dtype=np.int64))[0])
+        if mx is not None:
+            mx = int(_storage_fix(col, np.asarray([mx], dtype=np.int64))[0])
     return mn, mx
 
 
