@@ -93,10 +93,20 @@ def _stage(conn, table, cols, rows_per_batch, device: bool):
         n += cn
     dev = []
     if device:
+        # chunk the device copy at 2^23 rows: one 2^26-capacity batch made
+        # the combined filter+8-agg kernel fault on v5e (each half of the
+        # kernel runs fine at 2^26; the fused whole does not), and chunking
+        # additionally reuses one compiled kernel, pipelines dispatch, and
+        # caps HBM peaks. Chunks stay far above the size where per-launch
+        # overhead matters.
+        chunk_rows = 1 << 23
         arrays = [np.concatenate([h[i] for h in host])
                   for i in range(len(cols))]
-        dev = [Batch.from_arrays(schema, arrays, dictionaries=dicts,
-                                 num_rows=n)]
+        for lo in range(0, n, chunk_rows):
+            cn = min(chunk_rows, n - lo)
+            dev.append(Batch.from_arrays(
+                schema, [a[lo:lo + cn] for a in arrays],
+                dictionaries=dicts, num_rows=cn))
     return dev, host, n, schema
 
 
@@ -205,10 +215,12 @@ def bench_q1(sf: float):
             Column(T.DOUBLE, charge, valid & b.columns[5].validity, None),
         ]
         ext = Batch(ext_schema, cols, mask)
-        # <= 6 distinct (returnflag, linestatus) groups per chunk: a
-        # fixed 128-slot compaction needs no host sync
-        return grouped_aggregate(ext, [0, 1], aggs,
-                                 mode="partial").compact(128, check=False)
+        # <= 12 possible (returnflag, linestatus) slots: emit the partial
+        # straight at 128-slot capacity — materializing a partial at the
+        # 2^26 input capacity (13 state cols x 67M x 8B ~ 7GB) OOMs HBM
+        # at SF10, which is what killed the round-2 bench
+        return grouped_aggregate(ext, [0, 1], aggs, mode="partial",
+                                 output_capacity=128)
 
     @jax.jit
     def q1_final(parts):
@@ -216,8 +228,13 @@ def bench_q1(sf: float):
         return grouped_aggregate(states, [0, 1], aggs, mode="final")
 
     def run_device():
+        import jax.numpy as jnp
         out = q1_final([q1_partial(b) for b in dev])
-        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+        # scalar readback: on the tunneled backend block_until_ready
+        # returns before remote execution completes, so force the whole
+        # chain (and pay one honest result-delivery RTT, like the other
+        # configs' result readbacks)
+        float(jnp.sum(out.columns[2].data))
         return out
 
     def run_numpy():
@@ -294,25 +311,32 @@ def bench_q3(sf: float):
                  & semi_join_mask(orders, cust, [1], [0]))
         return Batch(orders.schema, orders.columns, omask)
 
-    @jax.jit
-    def probe(li: Batch, build: Batch) -> Batch:
-        lmask = li.row_mask & (li.columns[3].data > D_Q3)
-        li = Batch(li.schema, li.columns, lmask)
-        j = lookup_join(li, build, [0], [0], payload=[2, 3],
-                        payload_names=["o_orderdate", "o_shippriority"],
-                        join_type="inner")
-        # j: l_orderkey, l_extendedprice, l_discount, l_shipdate,
-        #    o_orderdate, o_shippriority
-        rev = j.columns[1].data * (1.0 - j.columns[2].data)
-        fields = [("l_orderkey", T.BIGINT),
-                  ("o_orderdate", j.schema.types[4]),
-                  ("o_shippriority", j.schema.types[5]),
-                  ("revenue", T.DOUBLE)]
-        cols = [j.columns[0], j.columns[4], j.columns[5],
-                Column(T.DOUBLE, rev,
-                       j.columns[1].validity & j.columns[2].validity, None)]
-        ext = Batch(Schema(fields), cols, j.row_mask)
-        return grouped_aggregate(ext, [0, 1, 2], aggs, mode="partial")
+    def probe_fn(scap):
+        @jax.jit
+        def probe(li: Batch, build: Batch) -> Batch:
+            lmask = li.row_mask & (li.columns[3].data > D_Q3)
+            li = Batch(li.schema, li.columns, lmask)
+            j = lookup_join(li, build, [0], [0], payload=[2, 3],
+                            payload_names=["o_orderdate", "o_shippriority"],
+                            join_type="inner")
+            # j: l_orderkey, l_extendedprice, l_discount, l_shipdate,
+            #    o_orderdate, o_shippriority
+            rev = j.columns[1].data * (1.0 - j.columns[2].data)
+            fields = [("l_orderkey", T.BIGINT),
+                      ("o_orderdate", j.schema.types[4]),
+                      ("o_shippriority", j.schema.types[5]),
+                      ("revenue", T.DOUBLE)]
+            cols = [j.columns[0], j.columns[4], j.columns[5],
+                    Column(T.DOUBLE, rev,
+                           j.columns[1].validity & j.columns[2].validity,
+                           None)]
+            ext = Batch(Schema(fields), cols, j.row_mask)
+            # group count <= filtered orders: emit partials at the
+            # build-bounded capacity, not the 2^26 probe capacity (whose
+            # state columns would not fit HBM at SF10+)
+            return grouped_aggregate(ext, [0, 1, 2], aggs, mode="partial",
+                                     output_capacity=scap)
+        return probe
 
     def merge_fn(scap):
         @jax.jit
@@ -343,6 +367,7 @@ def bench_q3(sf: float):
         live_build = int(jnp.sum(build.row_mask))      # one host sync
         scap = bucket_capacity(max(live_build, 1))
         merge = merge_fn(scap)
+        probe = probe_fn(scap)
         parts, state = [], None
         for b in device_chunks():
             parts.append(probe(b, build))
@@ -648,8 +673,11 @@ def main() -> None:
     import sys
 
     _enable_compile_cache()
+    # SF10 default: at SF1 the ~100ms tunnel readback RTT dominates the
+    # device's few ms of compute and the ratio measures latency, not
+    # throughput
     sf_q6 = float(os.environ.get("BENCH_SF_Q6",
-                                 os.environ.get("BENCH_SF", "1")))
+                                 os.environ.get("BENCH_SF", "10")))
     sf_q1 = float(os.environ.get("BENCH_SF_Q1", "10"))
     sf_q3 = float(os.environ.get("BENCH_SF_Q3", "10"))
     sf_ds = float(os.environ.get("BENCH_SF_DS", "1"))

@@ -747,7 +747,17 @@ class _Executor:
         # the reference's concurrently-running build and probe pipelines
         # within one task (PhasedExecutionSchedule starts both stages)
         probe_ex = None
-        if bool_property(self.session, "probe_prefetch", True):
+        # don't prefetch a probe whose scan a dynamic filter could prune:
+        # starting the scan before the build side finishes would read the
+        # splits before the bounds exist (the reference equally delays the
+        # probe scan while dynamic filters are being collected)
+        dyn_prunable = (
+            node.join_type == "inner"
+            and bool_property(self.session, "enable_dynamic_filtering",
+                              True)
+            and self._dynamic_scan_target(node.left) is not None)
+        if (bool_property(self.session, "probe_prefetch", True)
+                and not dyn_prunable):
             probe_ex = exchange_source(self.run(node.left), "single", 1,
                                        buffer_batches=4)
 
@@ -790,19 +800,15 @@ class _Executor:
                 probe_ex.close()
             buf.close()
 
-    def _push_dynamic_bounds(self, probe: PlanNode,
-                             dyn: List[Tuple[int, int, int]]) -> None:
-        """Runtime scan pushdown: if the probe chain maps the join keys
-        straight to scan columns (identity projections only), hand the
-        build side's [lo, hi] to the scan so connectors prune on stats
-        (reference sql/DynamicFilters.java:43 + the probe-side filter of
-        LocalDynamicFiltersCollector; v319 collects build-side values and
-        filters the probe scan the same way)."""
+    def _dynamic_scan_target(self, probe: PlanNode):
+        """(scan node, out-index -> scan-column mapping) when the probe
+        chain maps columns straight to a scan through filters and identity
+        projections; None otherwise."""
         mapping = {i: i for i in range(len(probe.fields))}
         node = probe
         while True:
             if node in self._ever_shared:
-                return      # replayed subtree feeds other consumers too
+                return None  # replayed subtree feeds other consumers too
             if isinstance(node, FilterNode):
                 node = node.child
                 continue
@@ -817,7 +823,21 @@ class _Executor:
                 continue
             break
         if not isinstance(node, TableScanNode) or not mapping:
+            return None
+        return node, mapping
+
+    def _push_dynamic_bounds(self, probe: PlanNode,
+                             dyn: List[Tuple[int, int, int]]) -> None:
+        """Runtime scan pushdown: if the probe chain maps the join keys
+        straight to scan columns (identity projections only), hand the
+        build side's [lo, hi] to the scan so connectors prune on stats
+        (reference sql/DynamicFilters.java:43 + the probe-side filter of
+        LocalDynamicFiltersCollector; v319 collects build-side values and
+        filters the probe scan the same way)."""
+        target = self._dynamic_scan_target(probe)
+        if target is None:
             return
+        node, mapping = target
         extra = []
         for key_idx, lo, hi in dyn:
             scan_i = mapping.get(key_idx)

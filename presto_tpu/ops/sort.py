@@ -79,25 +79,36 @@ def _sortable(col: Column, key: SortKey) -> List[jnp.ndarray]:
     return [null_rank.astype(jnp.int32), data]
 
 
-def sort_batch(batch: Batch, keys: Sequence[SortKey]) -> Batch:
-    """Stable sort of live rows by keys; dead rows go to the end."""
+def sort_permutation(batch: Batch, keys: Sequence[SortKey]) -> jnp.ndarray:
+    """Stable sort permutation of rows by keys; dead rows sort last.
+
+    Only key operands plus a row index enter ``lax.sort`` — TPU
+    variadic-sort compile time grows superlinearly with operand count
+    (measured ~215s cold for 10 operands vs ~20s for keys+iota on v5e),
+    so payloads are always gathered by the permutation instead."""
     dead_rank = jnp.where(batch.row_mask, 0, 1).astype(jnp.int32)
     operands = [dead_rank]
     for k in keys:
         operands.extend(_sortable(batch.columns[k.column], k))
-    num_keys = len(operands)
-    payload = [batch.row_mask]
-    for c in batch.columns:
-        payload.append(c.data)
-        payload.append(c.validity)
-    out = jax.lax.sort(operands + payload, num_keys=num_keys, is_stable=True)
-    sorted_payload = out[num_keys:]
-    new_mask = sorted_payload[0]
-    cols = []
-    for i, c in enumerate(batch.columns):
-        cols.append(Column(c.type, sorted_payload[1 + 2 * i],
-                           sorted_payload[2 + 2 * i], c.dictionary))
-    return Batch(batch.schema, cols, new_mask)
+    idx = jnp.arange(batch.capacity, dtype=jnp.int32)
+    out = jax.lax.sort(operands + [idx], num_keys=len(operands),
+                       is_stable=True)
+    return out[-1]
+
+
+def permute_batch(batch: Batch, perm: jnp.ndarray) -> Batch:
+    """Gather every row-aligned array of a batch by ``perm``."""
+    cols = [Column(c.type,
+                   jax.tree_util.tree_map(
+                       lambda a: jnp.take(a, perm, axis=0), c.data),
+                   jnp.take(c.validity, perm, axis=0), c.dictionary)
+            for c in batch.columns]
+    return Batch(batch.schema, cols, jnp.take(batch.row_mask, perm, axis=0))
+
+
+def sort_batch(batch: Batch, keys: Sequence[SortKey]) -> Batch:
+    """Stable sort of live rows by keys; dead rows go to the end."""
+    return permute_batch(batch, sort_permutation(batch, keys))
 
 
 def limit(batch: Batch, n: int) -> Batch:

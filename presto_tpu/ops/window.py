@@ -80,15 +80,18 @@ def evaluate_window(
     for k in order_by:
         operands.extend(_sortable(batch.columns[k.column], k))
     n_ops = len(operands)
-    payload: List[jnp.ndarray] = [batch.row_mask,
-                                  jnp.arange(cap, dtype=jnp.int32)]
-    for c in batch.columns:
-        payload.append(c.data)
-        payload.append(c.validity)
-    out = jax.lax.sort(operands + payload, num_keys=n_ops, is_stable=True)
+    # sort keys + row index only; gather payload by the permutation (TPU
+    # variadic-sort compile time scales badly with operand count — see
+    # ops/sort.py sort_permutation)
+    out = jax.lax.sort(operands + [jnp.arange(cap, dtype=jnp.int32)],
+                       num_keys=n_ops, is_stable=True)
     s_ops = out[:n_ops]
-    mask = out[n_ops]
-    s_cols = out[n_ops + 2:]
+    perm = out[-1]
+    mask = jnp.take(batch.row_mask, perm, axis=0)
+    s_cols = []
+    for c in batch.columns:
+        s_cols.append(jnp.take(c.data, perm, axis=0))
+        s_cols.append(jnp.take(c.validity, perm, axis=0))
 
     idx = jnp.arange(cap, dtype=jnp.int64)
 
