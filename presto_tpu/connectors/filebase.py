@@ -1,25 +1,87 @@
 """Shared base for file-format directory connectors (ORC, Parquet).
 
-The minimal shape of the reference's Hive connector read path (reference
-presto-hive/.../HivePageSourceProvider.java:58,85 dispatching each split
-to a format page source; BackgroundHiveSplitLoader.java listing files
-into splits): schema = directory, table = subdirectory (or a single
-``.<ext>`` file), one split per file, footer statistics drive pruning.
-Concrete connectors supply (extension, reader factory); readers are
-cached by (path, mtime) since planning asks for schema/stats repeatedly
-and footers are ranged reads anyway.
+The minimal shape of the reference's Hive connector (reference
+presto-hive/.../HiveMetadata.java, HivePageSourceProvider.java:58,85
+dispatching each split to a format page source;
+BackgroundHiveSplitLoader.java:262 listing partitions/files into splits):
+schema = directory, table = subdirectory (or a single ``.<ext>`` file),
+one split per file, footer statistics drive pruning.
+
+Hive-style partitioning: a table directory may contain nested
+``key=value`` subdirectories; the keys become trailing table columns
+whose constant values attach per split, and scan pushdown bounds prune
+whole partitions before any file IO (reference
+HivePartitionManager.java partition pruning). A ``CREATE TABLE ... WITH
+(partitioned_by = ARRAY['k'])`` write routes rows into those directories
+(reference HiveMetadata.finishInsert + HivePageSink partition routing).
+
+Concrete connectors supply (extension, reader factory, writer hook);
+readers are cached by (path, mtime) since planning asks for schema/stats
+repeatedly and footers are ranged reads anyway.
 """
 from __future__ import annotations
 
 import os
+import threading
+import uuid
 from collections import OrderedDict
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..batch import Schema
+import numpy as np
+
+from .. import types as T
+from ..batch import Batch, Column, Schema
 from .spi import (
     Connector, ConnectorMetadata, ConnectorSplitManager, PageSource, Split,
     TableHandle, TableStats,
 )
+
+
+def _parse_partition_value(raw: str):
+    """Hive path convention: values are strings in the path; int-looking
+    values are served as BIGINT (the common date_sk-style layout)."""
+    try:
+        return int(raw), T.BIGINT
+    except ValueError:
+        return raw, T.VARCHAR
+
+
+class _EmptySource(PageSource):
+    def batches(self):
+        return iter(())
+
+
+class _PartitionedSource(PageSource):
+    """Wraps a file page source, appending constant partition columns and
+    re-projecting to the requested column order."""
+
+    def __init__(self, inner: PageSource, columns: Sequence[str],
+                 part_fields, part_values):
+        self.inner = inner
+        self.columns = list(columns)
+        self.part_fields = part_fields        # [(name, type)]
+        self.part_values = part_values        # parallel python values
+
+    def batches(self):
+        import jax.numpy as jnp
+        for b in self.inner.batches():
+            by_name = dict(zip(b.schema.names,
+                               zip(b.columns, b.schema.types)))
+            for (name, t), v in zip(self.part_fields, self.part_values):
+                if t.is_string:
+                    col = Column(t, jnp.zeros(b.capacity, dtype=jnp.int32),
+                                 b.row_mask, (str(v),))
+                else:
+                    col = Column(t, jnp.full(b.capacity, t.to_storage(v),
+                                             dtype=t.storage_dtype),
+                                 b.row_mask, None)
+                by_name[name] = (col, t)
+            cols = [by_name[c][0] for c in self.columns]
+            fields = [(c, by_name[c][1]) for c in self.columns]
+            yield Batch(Schema(fields), cols, b.row_mask)
+
+    def close(self):
+        self.inner.close()
 
 
 class FileConnectorBase(Connector):
@@ -34,6 +96,11 @@ class FileConnectorBase(Connector):
         self._splits = _SplitManager(self)
         self._readers: "OrderedDict[Tuple[str, float], object]" = \
             OrderedDict()
+        self._write_lock = threading.Lock()
+        self._declared_parts: Dict[str, List[str]] = {}
+        #: per-table partition-field cache: page_source runs once per
+        #: split and must not re-walk the directory tree per file
+        self._pfields_cache: Dict[str, List[Tuple[str, T.Type]]] = {}
 
     # -- format hooks --------------------------------------------------------
     def open_reader(self, path: str):
@@ -42,6 +109,11 @@ class FileConnectorBase(Connector):
     def make_page_source(self, path: str, columns: Sequence[str],
                          pushdown) -> PageSource:
         raise NotImplementedError
+
+    def write_file(self, path: str, schema: Schema, batches) -> int:
+        """Write one file of this connector's format; return row count."""
+        raise NotImplementedError(
+            f"catalog {self.name!r} is not writable")
 
     # -- shared machinery ----------------------------------------------------
     def reader(self, path: str):
@@ -55,20 +127,61 @@ class FileConnectorBase(Connector):
             self._readers.move_to_end(key)
         return r
 
-    def table_files(self, table: str) -> List[str]:
+    # -- partition discovery -------------------------------------------------
+    def partition_keys(self, table: str) -> List[str]:
+        """Partition column names, from the first key=value dir chain."""
         path = os.path.join(self.root, table)
+        keys: List[str] = []
+        while os.path.isdir(path):
+            sub = sorted(d for d in os.listdir(path)
+                         if "=" in d
+                         and os.path.isdir(os.path.join(path, d)))
+            if not sub:
+                break
+            keys.append(sub[0].split("=", 1)[0])
+            path = os.path.join(path, sub[0])
+        return keys
+
+    def partitioned_files(self, table: str) -> List[Tuple[str, Tuple]]:
+        """[(file path, partition value strings)] under hive layout."""
+        base = os.path.join(self.root, table)
         ext = self.extension
-        if os.path.isdir(path):
-            files = sorted(
-                os.path.join(path, f) for f in os.listdir(path)
-                if f.endswith(ext))
-            if not files:
-                raise KeyError(
-                    f"unknown {self.name} table {table!r} (empty dir)")
-            return files
-        if os.path.isfile(path + ext):
-            return [path + ext]
-        raise KeyError(f"unknown {self.name} table {table!r}")
+        if not os.path.isdir(base):
+            if os.path.isfile(base + ext):
+                return [(base + ext, ())]
+            raise KeyError(f"unknown {self.name} table {table!r}")
+        out: List[Tuple[str, Tuple]] = []
+
+        def walk(path: str, values: Tuple) -> None:
+            for e in sorted(os.listdir(path)):
+                full = os.path.join(path, e)
+                if os.path.isdir(full) and "=" in e:
+                    walk(full, values + (e.split("=", 1)[1],))
+                elif e.endswith(ext):
+                    out.append((full, values))
+
+        walk(base, ())
+        if not out:
+            raise KeyError(
+                f"unknown {self.name} table {table!r} (empty dir)")
+        return out
+
+    def table_files(self, table: str) -> List[str]:
+        return [f for f, _ in self.partitioned_files(table)]
+
+    def _partition_fields(self, table: str) -> List[Tuple[str, T.Type]]:
+        cached = self._pfields_cache.get(table)
+        if cached is not None:
+            return cached
+        keys = self.partition_keys(table)
+        if not keys:
+            out: List[Tuple[str, T.Type]] = []
+        else:
+            _, values = self.partitioned_files(table)[0]
+            out = [(k, _parse_partition_value(v)[1])
+                   for k, v in zip(keys, values)]
+        self._pfields_cache[table] = out
+        return out
 
     @property
     def metadata(self) -> ConnectorMetadata:
@@ -81,7 +194,121 @@ class FileConnectorBase(Connector):
     def page_source(self, split: Split, columns: Sequence[str],
                     pushdown=None, rows_per_batch: int = 1 << 17
                     ) -> PageSource:
-        return self.make_page_source(split.info[0], columns, pushdown)
+        path = split.info[0]
+        part_values = split.info[1] if len(split.info) > 1 else ()
+        pfields = self._partition_fields(split.table.table)
+        pnames = [n for n, _ in pfields]
+        if pushdown:
+            # partition pruning BEFORE any file IO (reference
+            # HivePartitionManager prunes partitions from the metastore
+            # listing; dynamic-filter bounds land here too)
+            for name, lo, hi in pushdown:
+                if name not in pnames:
+                    continue
+                raw = part_values[pnames.index(name)]
+                v, _t = _parse_partition_value(raw)
+                if not isinstance(v, int):
+                    continue
+                if (lo is not None and v < lo) or \
+                        (hi is not None and v > hi):
+                    return _EmptySource()
+        file_cols = [c for c in columns if c not in pnames]
+        file_pushdown = (tuple(p for p in pushdown if p[0] not in pnames)
+                         if pushdown else None)
+        inner = self.make_page_source(path, file_cols, file_pushdown)
+        if not pnames:
+            return inner
+        sel = [(f, _parse_partition_value(v)[0])
+               for f, v in zip(pfields, part_values) if f[0] in columns]
+        return _PartitionedSource(inner, columns,
+                                  [f for f, _ in sel], [v for _, v in sel])
+
+    # -- write surface (reference HiveMetadata + HivePageSink) --------------
+    @property
+    def tables(self) -> Dict[str, None]:
+        try:
+            return {t: None for t in self._metadata.list_tables()}
+        except FileNotFoundError:
+            return {}
+
+    def create_table(self, name: str, schema: Schema,
+                     if_not_exists: bool = False,
+                     partitioned_by: Sequence[str] = ()) -> None:
+        path = os.path.join(self.root, name)
+        if os.path.isdir(path) or os.path.isfile(path + self.extension):
+            if if_not_exists:
+                return
+            raise ValueError(f"table {name!r} already exists")
+        for k in partitioned_by:
+            if k not in schema.names:
+                raise ValueError(
+                    f"partition column {k!r} not in table schema")
+        os.makedirs(path)
+        self._declared_parts[name] = list(partitioned_by)
+        self._pfields_cache.pop(name, None)
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        import shutil
+        path = os.path.join(self.root, name)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.isfile(path + self.extension):
+            os.remove(path + self.extension)
+        elif not if_exists:
+            raise KeyError(f"table {name!r} does not exist")
+        self._declared_parts.pop(name, None)
+        self._pfields_cache.pop(name, None)
+
+    def append(self, name: str, batch: Batch) -> int:
+        part_keys = self._declared_parts.get(name)
+        if part_keys is None:
+            part_keys = self.partition_keys(name)
+        base = os.path.join(self.root, name)
+        if not os.path.isdir(base):
+            raise KeyError(f"table {name!r} does not exist")
+        # unique per-write file id: sequence numbers from a fresh
+        # process would silently clobber files written by an earlier one
+        fid = uuid.uuid4().hex[:12]
+        self._pfields_cache.pop(name, None)
+        if not part_keys:
+            path = os.path.join(base, f"part-{fid}{self.extension}")
+            return self.write_file(path, batch.schema, [batch])
+        # route rows into key=value directories (HivePageSink role);
+        # partition columns move to the path, data columns to the files
+        names = list(batch.schema.names)
+        part_idx = [names.index(k) for k in part_keys]
+        data_idx = [i for i in range(len(names)) if i not in part_idx]
+        data_schema = Schema([(names[i], batch.schema.types[i])
+                              for i in data_idx])
+        mask = np.asarray(batch.row_mask)
+        part_cols = []
+        for i in part_idx:
+            c = batch.columns[i]
+            arr = np.asarray(c.data)
+            if c.type.is_string:
+                vocab = c.dictionary or ()
+                part_cols.append(np.asarray(
+                    [vocab[v] if 0 <= v < len(vocab) else ""
+                     for v in arr.tolist()], dtype=object))
+            else:
+                part_cols.append(arr)
+        n = 0
+        live = np.nonzero(mask)[0]
+        keys_here = {tuple(pc[r] for pc in part_cols) for r in live}
+        import jax.numpy as jnp
+        for kv in sorted(keys_here, key=str):
+            sel = mask.copy()
+            for pc, v in zip(part_cols, kv):
+                sel &= pc == v
+            sub = Batch(data_schema, [batch.columns[i] for i in data_idx],
+                        batch.row_mask & jnp.asarray(sel))
+            d = base
+            for k, v in zip(part_keys, kv):
+                d = os.path.join(d, f"{k}={v}")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"part-{fid}{self.extension}")
+            n += self.write_file(path, data_schema, [sub])
+        return n
 
 
 class _Metadata(ConnectorMetadata):
@@ -105,7 +332,12 @@ class _Metadata(ConnectorMetadata):
 
     def table_schema(self, table: TableHandle) -> Schema:
         files = self.conn.table_files(table.table)
-        return self.conn.reader(files[0]).schema
+        file_schema = self.conn.reader(files[0]).schema
+        pfields = self.conn._partition_fields(table.table)
+        if not pfields:
+            return file_schema
+        return Schema(list(zip(file_schema.names, file_schema.types))
+                      + pfields)
 
     def table_stats(self, table: TableHandle) -> TableStats:
         rows = 0.0
@@ -119,5 +351,5 @@ class _SplitManager(ConnectorSplitManager):
         self.conn = conn
 
     def splits(self, table: TableHandle, desired: int = 1) -> List[Split]:
-        return [Split(table, (f,))
-                for f in self.conn.table_files(table.table)]
+        return [Split(table, (f, values))
+                for f, values in self.conn.partitioned_files(table.table)]

@@ -38,6 +38,10 @@ class OrcConnector(FileConnectorBase):
     def open_reader(self, path: str) -> OrcReader:
         return OrcReader(path)
 
+    def write_file(self, path: str, schema, batches) -> int:
+        from ..formats.orc_writer import write_orc
+        return write_orc(path, schema, batches)
+
     def make_page_source(self, path, columns, pushdown) -> PageSource:
         # engine pushdown: ((column, lo, hi), ...) -> {column: (lo, hi)}
         min_max = ({name: (lo, hi) for name, lo, hi in pushdown}

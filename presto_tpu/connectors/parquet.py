@@ -35,5 +35,19 @@ class ParquetConnector(FileConnectorBase):
     def open_reader(self, path: str) -> ParquetReader:
         return ParquetReader(path)
 
+    def write_file(self, path: str, schema, batches) -> int:
+        import numpy as np
+        from ..formats.parquet import write_parquet
+        cols = [[] for _ in schema.names]
+        n = 0
+        for b in batches:
+            rows = b.to_pylist()
+            n += len(rows)
+            for r in rows:
+                for i, v in enumerate(r):
+                    cols[i].append(v)
+        write_parquet(path, schema, cols)
+        return n
+
     def make_page_source(self, path, columns, pushdown) -> PageSource:
         return _ParquetPageSource(self, path, columns, pushdown)

@@ -87,6 +87,57 @@ class HeartbeatFailureDetector:
                 if self.failures[u] < self.max_consecutive]
 
 
+class ClusterMemoryManager:
+    """Coordinator-side memory guard (reference
+    memory/ClusterMemoryManager.java + TotalReservationLowMemoryKiller):
+    polls workers' heartbeat memory payloads; while the cluster-wide
+    reservation exceeds ``limit_bytes``, kills the query holding the
+    most memory (DELETE /v1/query/{id} on every worker)."""
+
+    def __init__(self, runner: "ClusterRunner", limit_bytes: int,
+                 interval_s: float = 0.5):
+        self.runner = runner
+        self.limit = limit_bytes
+        self.interval_s = interval_s
+        self.killed: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def poll_once(self) -> Dict[str, int]:
+        totals: Dict[str, int] = {}
+        for url in self.runner.detector.active():
+            try:
+                info = self.runner._request(f"{url}/v1/info")
+            except Exception:
+                continue
+            for qid, b in info.get("queryMemory", {}).items():
+                totals[qid] = totals.get(qid, 0) + int(b)
+        return totals
+
+    def enforce(self, totals: Dict[str, int]) -> None:
+        live = {q: b for q, b in totals.items() if q not in self.killed}
+        if not live or sum(live.values()) <= self.limit:
+            return
+        victim = max(live, key=live.get)
+        self.killed[victim] = live[victim]
+        for url in list(self.runner.worker_urls):
+            try:
+                self.runner._request(f"{url}/v1/query/{victim}",
+                                     method="DELETE")
+            except Exception:
+                continue
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.enforce(self.poll_once())
+
+
 class ClusterRunner:
     """Executes SELECT queries across worker processes; everything else
     (DDL, SET, EXPLAIN) falls through to the embedded LocalRunner."""
@@ -105,6 +156,16 @@ class ClusterRunner:
         self.detector = HeartbeatFailureDetector(worker_urls)
         if heartbeat:
             self.detector.start()
+        self.memory_manager: Optional[ClusterMemoryManager] = None
+        limit = self.session.properties.get("cluster_memory_limit")
+        if limit:
+            self.enable_memory_manager(int(limit))
+
+    def enable_memory_manager(self, limit_bytes: int,
+                              interval_s: float = 0.5) -> None:
+        self.memory_manager = ClusterMemoryManager(self, limit_bytes,
+                                                   interval_s)
+        self.memory_manager.start()
 
     # -- HTTP helpers --------------------------------------------------------
     def _request(self, url: str, method: str = "GET",

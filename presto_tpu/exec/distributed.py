@@ -39,7 +39,8 @@ from ..expr import ir
 from ..expr.compiler import compile_filter, compile_projection
 from ..ops.aggregation import AggSpec, global_aggregate, grouped_aggregate
 from ..ops.join import (
-    expand_join, lookup_join, match_count_max, semi_join_mask,
+    build_match_mask, expand_join, lookup_join, match_count_max,
+    semi_join_mask,
 )
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
 from ..parallel.exchange import (
@@ -333,15 +334,16 @@ class DistributedExecutor(_Executor):
         # residual row error here degrades to dropped-row semantics
         residual_fn = (compile_filter(residual, _plan_schema(node))
                        if residual is not None else None)
-        if residual_fn is not None and node.join_type == "left":
-            raise NotImplementedError("residual predicate on LEFT JOIN")
+        if residual_fn is not None and node.join_type in ("left", "full"):
+            raise NotImplementedError(
+                f"residual predicate on {node.join_type.upper()} JOIN")
         payload = list(range(len(node.right.fields)))
         payload_names = [f"$b{i}" for i in payload]
         out_schema = _plan_schema(node)
 
         if build is None:
             for probe in self.run(node.left):
-                if node.join_type == "left":
+                if node.join_type in ("left", "full"):
                     yield self._null_extend(probe, node)
             return
 
@@ -354,14 +356,19 @@ class DistributedExecutor(_Executor):
             # FIXED_HASH: build repartitioned by join key over ICI once
             build_side = self._repartitioner(rkeys)(build)
 
+        # FULL OUTER probes like LEFT; the unmatched-build tail is emitted
+        # after the probe stream (per shard — the optimizer forces
+        # partitioned distribution, so each build row lives on one shard)
+        jt = "left" if node.join_type == "full" else node.join_type
+
         def local_probe(probe_l: Batch, build_l: Batch,
                         maxk: int) -> Batch:
             if node.build_unique:
                 out = lookup_join(probe_l, build_l, lkeys, rkeys,
-                                  payload, payload_names, node.join_type)
+                                  payload, payload_names, jt)
             else:
                 out = expand_join(probe_l, build_l, lkeys, rkeys,
-                                  payload, payload_names, node.join_type,
+                                  payload, payload_names, jt,
                                   max_matches=maxk)
             out = Batch(out_schema, out.columns, out.row_mask)
             return residual_fn(out) if residual_fn else out
@@ -375,6 +382,11 @@ class DistributedExecutor(_Executor):
 
         repart_probe = None if replicated else self._repartitioner(lkeys)
         join_fns: Dict[int, object] = {}
+        track_full = node.join_type == "full"
+        match_fn = (self._smap(
+            lambda p, b: build_match_mask(p, b, lkeys, rkeys), 2)
+            if track_full else None)
+        build_matched = None
         for probe in self.run(node.left):
             if repart_probe is not None:
                 probe = repart_probe(probe)
@@ -388,7 +400,28 @@ class DistributedExecutor(_Executor):
                 fn = join_fns[maxk] = self._smap(
                     lambda p, b, _k=maxk: local_probe(p, b, _k), 2,
                     replicated_in=(1,) if replicated else ())
+            if track_full:
+                m = match_fn(probe, build_side)
+                build_matched = (m if build_matched is None
+                                 else build_matched | m)
             yield fn(probe, build_side)
+        if track_full:
+            left_fields = node.left.fields
+
+            def local_tail(b_l: Batch, matched_l) -> Batch:
+                mask = b_l.row_mask & ~matched_l
+                novalid = jnp.zeros(b_l.capacity, dtype=bool)
+                cols = [Column(f.type,
+                               jnp.zeros(b_l.capacity,
+                                         dtype=f.type.storage_dtype),
+                               novalid, () if f.type.is_string else None)
+                        for f in left_fields]
+                cols.extend(b_l.columns)
+                return Batch(out_schema, cols, mask)
+
+            if build_matched is None:
+                build_matched = jnp.zeros_like(build_side.row_mask)
+            yield self._smap(local_tail, 2)(build_side, build_matched)
 
     def _SemiJoinNode(self, node: SemiJoinNode) -> Iterator[Batch]:
         build = self._drain(node.filtering)

@@ -27,9 +27,12 @@ from ..expr.compiler import compile_filter, compile_projection
 from ..expr.rewrite import rewrite as ir_rewrite
 from ..ops.aggregation import AggSpec
 from ..ops.jitcache import global_aggregate_jit as global_aggregate, grouped_aggregate_jit as grouped_aggregate
-from ..ops.join import (
-    expand_join, lookup_join, match_count_max, semi_join_mask,
+from ..ops.jitcache import (
+    build_key_ranks_jit, build_match_mask_jit, expand_join_jit,
+    lookup_join_jit, match_count_max_jit, prepare_build_jit,
+    prepare_direct_jit, semi_join_mask_jit,
 )
+from ..ops.join import expand_join, semi_join_mask
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
 from ..planner.plan import (
     AggregationNode, DistinctNode, FilterNode, GroupIdNode, JoinNode,
@@ -73,21 +76,35 @@ def run_init_plans(ex, plan: LogicalPlan) -> None:
 def execute_plan(plan: LogicalPlan, session: Session,
                  rows_per_batch: int = 1 << 17, stats=None,
                  collect_rows: bool = True) -> QueryResult:
+    from .taskexec import GLOBAL as scheduler
     ex = _Executor(session, rows_per_batch, stats=stats)
-    run_init_plans(ex, plan)
-    root = plan.root
-    rows: List[tuple] = []
-    if collect_rows:
-        out_batches = list(ex.run(root.child))
+    handle = (scheduler.task(name=str(id(ex)))
+              if bool_property(session, "fair_scheduling", True) else None)
+    try:
+        run_init_plans(ex, plan)
+        root = plan.root
+        rows: List[tuple] = []
+        out_batches: List[Batch] = []
+        # one fair-scheduler quantum per produced output batch: concurrent
+        # queries interleave at batch granularity by cumulative device
+        # time (the reference's TaskExecutor 1s-quantum role)
+        it = ex.run(root.child)
+        sentinel = object()
+        while True:
+            b = scheduler.run_quantum(handle,
+                                      lambda: next(it, sentinel))
+            if b is sentinel:
+                break
+            if collect_rows:
+                out_batches.append(b)
         ex.check_errors()
-        rows = [r for b in out_batches for r in b.to_pylist()]
-    else:
-        # EXPLAIN ANALYZE: drain for stats, skip row materialization
-        for _ in ex.run(root.child):
-            pass
-        ex.check_errors()
-    return QueryResult(names=[f.name for f in root.fields],
-                       types=[f.type for f in root.fields], rows=rows)
+        if collect_rows:
+            rows = [r for b in out_batches for r in b.to_pylist()]
+        return QueryResult(names=[f.name for f in root.fields],
+                           types=[f.type for f in root.fields], rows=rows)
+    finally:
+        if handle is not None:
+            handle.close()
 
 
 def _plan_schema(node: PlanNode) -> Schema:
@@ -729,12 +746,12 @@ class _Executor:
                     if node.residual is not None else None)
         residual_fn = None
         if residual is not None:
-            if node.join_type == "left":
-                # residual on a left join only filters matched rows'
+            if node.join_type in ("left", "full"):
+                # residual on an outer join only filters matched rows'
                 # payload, not probe rows — approximate by filtering
-                # (correct for inner; left-join residuals are rare)
+                # (correct for inner; outer-join residuals are rare)
                 raise NotImplementedError(
-                    "residual predicate on LEFT JOIN")
+                    f"residual predicate on {node.join_type.upper()} JOIN")
             residual_fn = self.checked_filter(residual, _plan_schema(node))
 
         from .local_exchange import exchange_source
@@ -782,6 +799,19 @@ class _Executor:
                 if dyn:
                     self._push_dynamic_bounds(node.left, dyn)
             compact = self._compactor()
+            track_full = node.join_type == "full" and build is not None
+            build_matched = None
+            if build is not None:
+                # compact a sparse build before sorting it: probe-side
+                # binary searches walk a table sized by CAPACITY, so a
+                # 10%-live build would cost 10x the gathers it needs
+                # (reference PagesIndex compacts build pages the same way)
+                scap = bucket_capacity(max(build.host_count(), 1))
+                if scap < build.capacity:
+                    from ..ops.jitcache import compact_jit
+                    build = compact_jit(build, scap)
+            prep = (self._prepare_join_build(build, node.right_keys)
+                    if build is not None else None)
             for probe in probe_stream():
                 if build is None:
                     if node.join_type == "inner":
@@ -790,11 +820,29 @@ class _Executor:
                 else:
                     if dyn:
                         probe = _apply_dynamic_bounds(probe, dyn)
-                    out = self._probe(node, probe, build, payload,
-                                      payload_names)
+                    for out in self._probe_batches(node, probe, build,
+                                                   payload, payload_names,
+                                                   prep):
+                        if residual_fn is not None:
+                            out = residual_fn(out)
+                        yield compact(out)
+                    if track_full:
+                        m = build_match_mask_jit(probe, build,
+                                                 list(node.left_keys),
+                                                 list(node.right_keys),
+                                                 prep)
+                        build_matched = (m if build_matched is None
+                                         else build_matched | m)
+                    continue
                 if residual_fn is not None:
                     out = residual_fn(out)
                 yield compact(out)
+            if track_full:
+                # FULL OUTER tail: build rows no probe row ever matched,
+                # null-extended on the probe side (reference
+                # LookupOuterOperator over the visited-positions bitmap)
+                yield compact(self._null_extend_build(
+                    build, node, build_matched))
         finally:
             if probe_ex is not None:
                 probe_ex.close()
@@ -866,40 +914,131 @@ class _Executor:
                                                 pool=self.pool)
                 pstore.add(probe, list(node.left_keys))
             if pstore is None:
+                if node.join_type == "full":
+                    # no probe rows at all: every build row is unmatched
+                    for p in range(store.n):
+                        bpart = store.partition_batch(p)
+                        if bpart is not None:
+                            yield self._null_extend_build(bpart, node, None)
                 return
             for p in range(store.n):
                 bpart = store.partition_batch(p)
+                part_matched = None
+                part_prep = None
                 for probe_p in pstore.partition_batches(
                         p, self.rows_per_batch):
                     if bpart is None:
-                        if node.join_type == "left":
+                        if node.join_type in ("left", "full"):
                             yield self._null_extend(probe_p, node)
                         continue
-                    out = self._probe(node, probe_p, bpart, payload,
-                                      payload_names)
-                    yield residual_fn(out) if residual_fn is not None \
-                        else out
+                    if part_prep is None:
+                        part_prep = self._prepare_join_build(
+                            bpart, node.right_keys)
+                    for out in self._probe_batches(node, probe_p, bpart,
+                                                   payload, payload_names,
+                                                   part_prep):
+                        yield residual_fn(out) if residual_fn is not None \
+                            else out
+                    if node.join_type == "full":
+                        m = build_match_mask_jit(probe_p, bpart,
+                                                 list(node.left_keys),
+                                                 list(node.right_keys),
+                                                 part_prep)
+                        part_matched = (m if part_matched is None
+                                        else part_matched | m)
+                if node.join_type == "full" and bpart is not None:
+                    yield self._null_extend_build(bpart, node,
+                                                  part_matched)
         finally:
             if pstore is not None:
                 pstore.close()
 
-    def _probe(self, node: JoinNode, probe: Batch, build: Batch,
-               payload, payload_names) -> Batch:
-        """One probe batch against the finished build side: unique-key fast
-        path, or capacity-expanded many-to-many (reference JoinProbe fast
-        path vs PositionLinks chains)."""
+    #: per-kernel expansion cap: one skewed key would otherwise scale the
+    #: expand_join output (probe_capacity x max_matches) without bound;
+    #: past this the executor slices the build into bounded-multiplicity
+    #: chunks via build_key_ranks
+    SKEW_MATCH_LIMIT = 64
+
+    #: largest (max-min+1) key span served by a direct-address lookup
+    #: table (2^26 slots x 2 x i32 = 512MB of HBM); wider spans fall back
+    #: to the composite binary search
+    DIRECT_SPAN_LIMIT = 1 << 26
+
+    def _prepare_join_build(self, build: Batch, keys):
+        """LookupSource choice (reference HashBuilderOperator's
+        BigintGroupByHash-vs-MultiChannel split): a single integer key
+        with a bounded host-known range gets a direct-address table —
+        O(1) gathers per probe lane on hardware where random gathers
+        dominate join cost; anything else gets the sorted composite
+        search."""
+        import numpy as np
+        keys = tuple(keys)
+        if len(keys) == 1:
+            c = build.columns[keys[0]]
+            if isinstance(c.type, _DYN_TYPES):
+                live = np.asarray(build.row_mask) & np.asarray(c.validity)
+                if live.any():
+                    data = np.asarray(c.data)[live]
+                    lo, hi = int(data.min()), int(data.max())
+                    span = hi - lo + 1
+                    if 0 < span <= self.DIRECT_SPAN_LIMIT:
+                        return prepare_direct_jit(
+                            build, keys, lo, bucket_capacity(span))
+        return prepare_build_jit(build, keys)
+
+    def _probe_batches(self, node: JoinNode, probe: Batch, build: Batch,
+                       payload, payload_names,
+                       prepared=None) -> Iterator[Batch]:
+        schema = _plan_schema(node)
+        lkeys, rkeys = list(node.left_keys), list(node.right_keys)
+        if prepared is None:
+            prepared = prepare_build_jit(build, rkeys)
+        # FULL OUTER probes like LEFT; the executor emits the
+        # unmatched-build tail separately
+        jt = "left" if node.join_type == "full" else node.join_type
         if node.build_unique:
-            out = lookup_join(
-                probe, build, list(node.left_keys), list(node.right_keys),
-                payload, payload_names, node.join_type)
-        else:
-            maxk = int(match_count_max(
-                probe, build, list(node.left_keys), list(node.right_keys)))
-            out = expand_join(
-                probe, build, list(node.left_keys), list(node.right_keys),
-                payload, payload_names, node.join_type,
-                max_matches=bucket_capacity(max(maxk, 1), minimum=1))
-        return Batch(_plan_schema(node), out.columns, out.row_mask)
+            out = lookup_join_jit(probe, build, lkeys, rkeys,
+                                  payload, payload_names, jt, prepared)
+            yield Batch(schema, out.columns, out.row_mask)
+            return
+        maxk = int(match_count_max_jit(probe, build, lkeys, rkeys,
+                                       prepared))
+        limit = self.SKEW_MATCH_LIMIT
+        if maxk <= limit:
+            out = expand_join_jit(
+                probe, build, lkeys, rkeys, payload, payload_names, jt,
+                bucket_capacity(max(maxk, 1), minimum=1), prepared)
+            yield Batch(schema, out.columns, out.row_mask)
+            return
+        # skew fallback: chunk the build by within-key occurrence rank so
+        # each expand stays bounded. Ranks are dense from 0, so a probe
+        # row with any match always matches in chunk 0 — chunk 0 keeps the
+        # outer-join behavior, later chunks join inner.
+        ranks = build_key_ranks_jit(build, rkeys, prepared)
+        for c in range(0, maxk, limit):
+            sub = Batch(build.schema, build.columns,
+                        build.row_mask & (ranks >= c)
+                        & (ranks < c + limit))
+            out = expand_join_jit(
+                probe, sub, lkeys, rkeys, payload, payload_names,
+                jt if c == 0 else "inner", limit, None)
+            yield Batch(schema, out.columns, out.row_mask)
+
+    def _null_extend_build(self, build: Batch, node: JoinNode,
+                           matched) -> Batch:
+        """Unmatched build rows as output rows with NULL probe columns."""
+        cap = build.capacity
+        mask = build.row_mask
+        if matched is not None:
+            mask = mask & ~matched
+        novalid = jnp.zeros(cap, dtype=bool)
+        cols = []
+        for f in node.left.fields:
+            cols.append(Column(
+                f.type, jnp.zeros(cap, dtype=f.type.storage_dtype),
+                novalid, () if f.type.is_string else None))
+        cols.extend(build.columns)
+        return Batch(_plan_schema(node), cols, mask)
 
     def _null_extend(self, probe: Batch, node: JoinNode) -> Batch:
         cols = list(probe.columns)
@@ -938,6 +1077,8 @@ class _Executor:
         build = self._drain(node.filtering)
         skeys = list(node.source_keys)
         fkeys = list(node.filtering_keys)
+        prep = (self._prepare_join_build(build, fkeys)
+                if build is not None else None)
         for b in self.run(node.source):
             if build is None:
                 if node.negated:
@@ -947,11 +1088,12 @@ class _Executor:
                                 jnp.zeros_like(b.row_mask))
                 continue
             if node.residual is None:
-                mask = semi_join_mask(b, build, skeys, fkeys,
-                                      negated=node.negated,
-                                      null_aware=node.null_aware)
+                mask = semi_join_mask_jit(b, build, skeys, fkeys,
+                                          node.negated, node.null_aware,
+                                          prep)
             else:
-                maxk = int(match_count_max(b, build, skeys, fkeys))
+                maxk = int(match_count_max_jit(b, build, skeys, fkeys,
+                                               prep))
                 mask = mark_exists_mask(
                     b, build, skeys, fkeys, node.residual, node.negated,
                     bucket_capacity(max(maxk, 1), minimum=1), ex=self)

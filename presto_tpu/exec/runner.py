@@ -342,7 +342,18 @@ class LocalRunner:
         schema, batches = self._run_to_batches(stmt.query, session)
         if table in conn.tables and stmt.if_not_exists:
             return QueryResult(["rows"], [T.BIGINT], [(0,)])
-        conn.create_table(table, schema, if_not_exists=stmt.if_not_exists)
+        props = dict(getattr(stmt, "properties", ()) or ())
+        part_by = props.pop("partitioned_by", ())
+        if props:
+            raise ValueError(
+                f"unknown table properties: {sorted(props)}")
+        if part_by:
+            conn.create_table(table, schema,
+                              if_not_exists=stmt.if_not_exists,
+                              partitioned_by=list(part_by))
+        else:
+            conn.create_table(table, schema,
+                              if_not_exists=stmt.if_not_exists)
         n = 0
         for b in batches:
             n += conn.append(table, Batch(schema, b.columns, b.row_mask))

@@ -1,0 +1,117 @@
+"""Device-time fair scheduling of concurrent queries.
+
+The role of the reference's TaskExecutor + MultilevelSplitQueue +
+PrioritizedSplitRunner (reference presto-main/.../execution/executor/
+TaskExecutor.java:79, MultilevelSplitQueue.java:43-44,
+PrioritizedSplitRunner.java:43): worker threads time-slice drivers by
+cumulative CPU so short queries are not starved behind long scans.
+
+TPU reshape: the contended resource is the one device's dispatch stream,
+and the natural quantum is "produce one output batch" (one fused chain
+of kernel launches) rather than a 1s wall-clock slice. Each concurrent
+query registers a task; before every quantum the driver passes through
+``run_quantum``, which grants the device to the eligible task in the
+LOWEST level (levels by cumulative device seconds, same thresholds as
+the reference: 0/1/10/60/300s), breaking ties by least in-level usage.
+A long-running query climbs levels and yields to fresh short queries —
+the multilevel feedback queue, without threads owning the device.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, TypeVar
+
+#: level thresholds in cumulative device seconds (reference
+#: MultilevelSplitQueue.LEVEL_THRESHOLD_SECONDS = {0, 1, 10, 60, 300})
+LEVEL_THRESHOLDS = (0.0, 1.0, 10.0, 60.0, 300.0)
+
+R = TypeVar("R")
+
+
+class TaskHandle:
+    def __init__(self, scheduler: "DeviceScheduler", name: str):
+        self.scheduler = scheduler
+        self.name = name
+        self.device_seconds = 0.0
+        self.quanta = 0
+        self.closed = False
+
+    @property
+    def level(self) -> int:
+        lv = 0
+        for i, t in enumerate(LEVEL_THRESHOLDS):
+            if self.device_seconds >= t:
+                lv = i
+        return lv
+
+    def priority(self):
+        return (self.level, self.device_seconds)
+
+    def close(self) -> None:
+        self.scheduler.remove(self)
+
+
+class DeviceScheduler:
+    """One per process (one device); tasks round through it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._tasks: List[TaskHandle] = []
+        self._waiting: List[TaskHandle] = []
+        self._running: Optional[TaskHandle] = None
+        self._running_depth = 0
+
+    def task(self, name: str = "") -> TaskHandle:
+        h = TaskHandle(self, name)
+        with self._lock:
+            self._tasks.append(h)
+        return h
+
+    def remove(self, handle: TaskHandle) -> None:
+        with self._cv:
+            handle.closed = True
+            if handle in self._tasks:
+                self._tasks.remove(handle)
+            self._cv.notify_all()
+
+    def _eligible(self, handle: TaskHandle) -> bool:
+        if self._running is handle:
+            return True       # re-entrant: tasks of one query (pipeline
+            # stages feeding each other) must not serialize against
+            # themselves — only against OTHER queries
+        if self._running is not None:
+            return False
+        best = min(self._waiting, key=TaskHandle.priority)
+        return best is handle
+
+    def run_quantum(self, handle: Optional[TaskHandle],
+                    fn: Callable[[], R]) -> R:
+        """Run ``fn`` (one batch's worth of device dispatches) when it is
+        this task's turn; account its wall time as device time."""
+        if handle is None:
+            return fn()
+        with self._cv:
+            self._waiting.append(handle)
+            while not self._eligible(handle):
+                self._cv.wait(timeout=1.0)
+            self._waiting.remove(handle)
+            self._running = handle
+            self._running_depth += 1
+        t0 = time.perf_counter()
+        try:
+            return fn()
+        finally:
+            dt = time.perf_counter() - t0
+            with self._cv:
+                handle.device_seconds += dt
+                handle.quanta += 1
+                self._running_depth -= 1
+                if self._running_depth == 0:
+                    self._running = None
+                self._cv.notify_all()
+
+
+#: process-wide scheduler (one real device per process)
+GLOBAL = DeviceScheduler()

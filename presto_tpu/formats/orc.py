@@ -83,10 +83,19 @@ class OrcReader:
         self.columns: List[OrcColumn] = []
         for name, tid in zip(root.field_names, root.subtypes):
             t = self.tail.types[tid]
-            if t.kind not in _ORC_TO_ENGINE:
+            if t.kind == "decimal":
+                if (t.precision or 38) > 18:
+                    # engine decimals are i64-backed (<= 18 digits) until
+                    # the 2xi64 int128 path lands
+                    raise NotImplementedError(
+                        f"ORC decimal({t.precision},{t.scale}) exceeds "
+                        "the supported precision 18")
+                engine_t = T.DecimalType(t.precision or 38, t.scale or 0)
+            elif t.kind not in _ORC_TO_ENGINE:
                 raise NotImplementedError(
                     f"ORC column type {t.kind!r} is not supported")
-            engine_t = _ORC_TO_ENGINE[t.kind]
+            else:
+                engine_t = _ORC_TO_ENGINE[t.kind]
             if t.kind in ("varchar", "char") and t.max_length:
                 engine_t = T.varchar(t.max_length)
             self.columns.append(OrcColumn(name, tid, t.kind, engine_t))
@@ -232,6 +241,40 @@ class OrcReader:
             vals = _assemble_ieee(u8, n_values, width)
             out = scatter_i64(vals)
             return Column(c.type, out.astype(jnp.float64),
+                          jnp.asarray(validity), None)
+        if c.orc_kind == "decimal":
+            # DATA = zigzag base-128 varint unscaled values, SECONDARY =
+            # per-value scale (reference stream/DecimalInputStream.java);
+            # rescale to the declared scale and store i64
+            from .orc_rle import decode_rle_v2_numpy
+            scales = decode_rle_v2_numpy(
+                streams.get("secondary", b""), n_values, signed=True)
+            target = c.type.scale
+            mant = np.empty(n_values, dtype=np.int64)
+            pos = 0
+            for i in range(n_values):
+                result = 0
+                shift = 0
+                while True:
+                    b = data[pos]
+                    pos += 1
+                    result |= (b & 0x7F) << shift
+                    if not b & 0x80:
+                        break
+                    shift += 7
+                # exact python-int arithmetic, bounds-checked into i64
+                v = (result >> 1) ^ -(result & 1)
+                d = target - int(scales[i])
+                if d > 0:
+                    v *= 10 ** d
+                elif d < 0:
+                    v //= 10 ** (-d)
+                if not -(2 ** 63) <= v < 2 ** 63:
+                    raise OverflowError(
+                        f"decimal value out of i64 range in {c.name!r}")
+                mant[i] = v
+            out = scatter_i64(jnp.asarray(mant))
+            return Column(c.type, out.astype(c.type.storage_dtype),
                           jnp.asarray(validity), None)
         if c.orc_kind in ("string", "varchar", "char"):
             return self._decode_string(c, enc, footer, streams, cap,

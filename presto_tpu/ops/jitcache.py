@@ -46,3 +46,116 @@ def _global(aggs, mode):
 def global_aggregate_jit(batch, aggs: Sequence[AggSpec],
                          mode: str = "single"):
     return _global(tuple(aggs), mode)(batch)
+
+
+# -- join kernels ------------------------------------------------------------
+# (reference: HashBuilderOperator builds one LookupSource reused by every
+# probe; here prepare_build_jit sorts the build once and the probe-side
+# kernels take the prepared arrays as a pytree argument)
+
+from .join import (  # noqa: E402
+    build_key_ranks, build_match_mask, expand_join, lookup_join,
+    match_count_max, prepare_build, semi_join_mask,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _prepare(key_cols):
+    return jax.jit(lambda b: prepare_build(b, key_cols))
+
+
+def prepare_build_jit(build, key_cols):
+    return _prepare(tuple(key_cols))(build)
+
+
+@functools.lru_cache(maxsize=None)
+def _lookup(pkeys, bkeys, payload, names, jt):
+    return jax.jit(lambda p, b, prep: lookup_join(
+        p, b, pkeys, bkeys, payload, names, jt, prepared=prep))
+
+
+def lookup_join_jit(probe, build, probe_keys, build_keys, payload,
+                    payload_names, join_type, prepared):
+    return _lookup(tuple(probe_keys), tuple(build_keys), tuple(payload),
+                   tuple(payload_names), join_type)(probe, build, prepared)
+
+
+@functools.lru_cache(maxsize=None)
+def _expand(pkeys, bkeys, payload, names, jt, max_matches):
+    return jax.jit(lambda p, b, prep: expand_join(
+        p, b, pkeys, bkeys, payload, names, jt, max_matches,
+        prepared=prep))
+
+
+def expand_join_jit(probe, build, probe_keys, build_keys, payload,
+                    payload_names, join_type, max_matches, prepared):
+    return _expand(tuple(probe_keys), tuple(build_keys), tuple(payload),
+                   tuple(payload_names), join_type,
+                   max_matches)(probe, build, prepared)
+
+
+@functools.lru_cache(maxsize=None)
+def _match_count(pkeys, bkeys):
+    return jax.jit(lambda p, b, prep: match_count_max(
+        p, b, pkeys, bkeys, prepared=prep))
+
+
+def match_count_max_jit(probe, build, probe_keys, build_keys, prepared):
+    return _match_count(tuple(probe_keys),
+                        tuple(build_keys))(probe, build, prepared)
+
+
+@functools.lru_cache(maxsize=None)
+def _match_mask(pkeys, bkeys):
+    return jax.jit(lambda p, b, prep: build_match_mask(
+        p, b, pkeys, bkeys, prepared=prep))
+
+
+def build_match_mask_jit(probe, build, probe_keys, build_keys, prepared):
+    return _match_mask(tuple(probe_keys),
+                       tuple(build_keys))(probe, build, prepared)
+
+
+@functools.lru_cache(maxsize=None)
+def _key_ranks(key_cols):
+    return jax.jit(lambda b, prep: build_key_ranks(
+        b, key_cols, prepared=prep))
+
+
+def build_key_ranks_jit(build, key_cols, prepared):
+    return _key_ranks(tuple(key_cols))(build, prepared)
+
+
+@functools.lru_cache(maxsize=None)
+def _semi(skeys, fkeys, negated, null_aware):
+    return jax.jit(lambda p, b, prep: semi_join_mask(
+        p, b, skeys, fkeys, negated, null_aware, prepared=prep))
+
+
+def semi_join_mask_jit(probe, build, probe_keys, build_keys,
+                       negated, null_aware, prepared):
+    return _semi(tuple(probe_keys), tuple(build_keys), negated,
+                 null_aware)(probe, build, prepared)
+
+
+@functools.lru_cache(maxsize=None)
+def _compact(capacity):
+    return jax.jit(lambda b: b.compact(capacity, check=False))
+
+
+def compact_jit(batch, capacity: int):
+    """Jitted Batch.compact — shrink a sparse batch to a bucketed
+    capacity (callers must know the live count fits)."""
+    return _compact(capacity)(batch)
+
+
+from .join import prepare_direct  # noqa: E402
+
+
+@functools.lru_cache(maxsize=None)
+def _prepare_direct(key_cols, size):
+    return jax.jit(lambda b, lo0: prepare_direct(b, key_cols, lo0, size))
+
+
+def prepare_direct_jit(build, key_cols, lo0, size: int):
+    return _prepare_direct(tuple(key_cols), size)(build, lo0)

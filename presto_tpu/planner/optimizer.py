@@ -39,7 +39,13 @@ BROADCAST_ROW_LIMIT = 2_000_000
 
 
 def optimize(plan: LogicalPlan, session: Session) -> LogicalPlan:
+    from .rules import iterative_optimize
+
     def pipeline(node: PlanNode) -> PlanNode:
+        # iterative simplify/merge/push rules to a fixpoint (reference
+        # IterativeOptimizer over the rule catalog), then the structural
+        # visitor passes (reference PlanOptimizers.java:252-412 ordering)
+        node = iterative_optimize(node)
         node = _rewrite_joins(node, session)
         node, _ = _prune(node, list(range(len(node.fields))))
         node = _implement_joins(node, session)
@@ -721,6 +727,12 @@ def _implement_joins(node: PlanNode, session: Session) -> PlanNode:
             exprs=tuple(ir.input_ref(remap[i], f.type)
                         for i, f in enumerate(node.fields)),
             fields=node.fields)
+    if node.join_type == "full":
+        # a replicated build would emit its unmatched-row tail once per
+        # shard; FULL OUTER must hash-partition both sides (reference
+        # DetermineJoinDistributionType.java mustPartition for FULL)
+        return dataclasses.replace(node, build_unique=right_unique,
+                                   distribution="partitioned")
     return dataclasses.replace(
         node, build_unique=right_unique,
         distribution=_distribution(node.right, rrows, session))

@@ -172,28 +172,30 @@ class _Planner:
         return ValuesNode(fields=fields, rows=tuple(out_rows))
 
     def plan_set_op(self, op: A.SetOperation) -> PlanNode:
-        if op.op != "union":
-            raise AnalysisError(f"{op.op.upper()} is not supported yet")
         left = self.plan_body(op.left)
         right = self.plan_body(op.right)
         if len(left.fields) != len(right.fields):
-            raise AnalysisError("UNION inputs have different column counts")
+            raise AnalysisError(
+                f"{op.op.upper()} inputs have different column counts")
         # coerce each side to common types
         out_fields = []
         for lf, rf in zip(left.fields, right.fields):
             t = T.common_super_type(lf.type, rf.type)
             if t is None:
                 raise AnalysisError(
-                    f"UNION column {lf.name}: incompatible types "
+                    f"{op.op.upper()} column {lf.name}: incompatible types "
                     f"{lf.type.display()} vs {rf.type.display()}")
             out_fields.append(Field(lf.name, t))
         left = _coerce_to(left, [f.type for f in out_fields])
         right = _coerce_to(right, [f.type for f in out_fields])
-        node: PlanNode = UnionNode(
-            children_=(left, right), fields=tuple(out_fields),
-            distinct=op.distinct)
-        if op.distinct:
-            node = DistinctNode(child=node)
+        if op.op == "union":
+            node: PlanNode = UnionNode(
+                children_=(left, right), fields=tuple(out_fields),
+                distinct=op.distinct)
+            if op.distinct:
+                node = DistinctNode(child=node)
+        else:
+            node = self._plan_intersect_except(op, left, right, out_fields)
         if op.order_by:
             scope = Scope(node.fields)
             keys = self._sort_keys(op.order_by, node, scope, {})
@@ -203,6 +205,55 @@ class _Planner:
         if op.limit is not None:
             node = LimitNode(child=node, count=op.limit)
         return node
+
+    def _plan_intersect_except(self, op: A.SetOperation, left: PlanNode,
+                               right: PlanNode,
+                               out_fields: List[Field]) -> PlanNode:
+        """Lower INTERSECT/EXCEPT to union-all + marker aggregation
+        (reference iterative/rule/ImplementIntersectAsUnion.java,
+        ImplementExceptAsUnion.java): tag each source's rows with
+        per-source presence markers, union, count markers per distinct
+        row value, then keep rows by marker counts."""
+        if not op.distinct:
+            raise AnalysisError(
+                f"{op.op.upper()} ALL is not supported")
+        n = len(out_fields)
+        m1 = Field("$m1", T.BIGINT)
+        m2 = Field("$m2", T.BIGINT)
+
+        def tagged(side: PlanNode, first: int) -> PlanNode:
+            exprs = [ir.input_ref(i, f.type)
+                     for i, f in enumerate(out_fields)]
+            exprs.append(ir.lit(first, T.BIGINT))
+            exprs.append(ir.lit(1 - first, T.BIGINT))
+            return ProjectNode(child=side, exprs=tuple(exprs),
+                               fields=tuple(out_fields) + (m1, m2))
+
+        u = UnionNode(children_=(tagged(left, 1), tagged(right, 0)),
+                      fields=tuple(out_fields) + (m1, m2), distinct=False)
+        agg = AggregationNode(
+            child=u, group_indices=tuple(range(n)),
+            aggs=(PlanAgg("sum", n, T.BIGINT, "$c1"),
+                  PlanAgg("sum", n + 1, T.BIGINT, "$c2")),
+            fields=tuple(out_fields) + (Field("$c1", T.BIGINT),
+                                        Field("$c2", T.BIGINT)))
+        zero = ir.lit(0, T.BIGINT)
+        in_left = ir.call("gt", T.BOOLEAN,
+                          ir.input_ref(n, T.BIGINT), zero)
+        if op.op == "intersect":
+            in_right = ir.call("gt", T.BOOLEAN,
+                               ir.input_ref(n + 1, T.BIGINT), zero)
+        else:     # except
+            in_right = ir.call("eq", T.BOOLEAN,
+                               ir.input_ref(n + 1, T.BIGINT), zero)
+        from ..expr.rewrite import combine_conjuncts
+        filt = FilterNode(child=agg,
+                          predicate=combine_conjuncts([in_left, in_right]))
+        return ProjectNode(
+            child=filt,
+            exprs=tuple(ir.input_ref(i, f.type)
+                        for i, f in enumerate(out_fields)),
+            fields=tuple(out_fields))
 
     # -- relations -----------------------------------------------------------
     def plan_relation(self, rel: A.Relation) -> PlanNode:
@@ -306,8 +357,6 @@ class _Planner:
             return JoinNode(
                 join_type="cross", left=left, right=right,
                 left_keys=(), right_keys=(), fields=combined)
-        if rel.join_type == "full":
-            raise AnalysisError("FULL OUTER JOIN is not supported yet")
         join_type = rel.join_type
         swapped = False
         if join_type == "right":

@@ -44,6 +44,30 @@ from ..sql.analyzer import AnalysisError
 
 PAGES_CONTENT_TYPE = "application/x-presto-tpu-pages"
 
+_query_handles: Dict[str, list] = {}
+_query_handles_lock = threading.Lock()
+
+
+def _query_handle(query_id: str):
+    from ..exec.taskexec import GLOBAL as scheduler
+    with _query_handles_lock:
+        ent = _query_handles.get(query_id)
+        if ent is None:
+            ent = _query_handles[query_id] = [scheduler.task(query_id), 0]
+        ent[1] += 1
+        return ent[0]
+
+
+def _release_query_handle(query_id: str) -> None:
+    with _query_handles_lock:
+        ent = _query_handles.get(query_id)
+        if ent is None:
+            return
+        ent[1] -= 1
+        if ent[1] <= 0:
+            del _query_handles[query_id]
+            ent[0].close()
+
 
 def frame_pages(pages: List[bytes]) -> bytes:
     """Length-prefix each page into one body."""
@@ -251,12 +275,26 @@ class Task:
         self._thread.start()
 
     def _run(self) -> None:
+        # one shared handle per QUERY: pipeline stages of a query feed
+        # each other pages and must never serialize behind their own
+        # query's scheduler turn (reference TaskExecutor groups splits
+        # under a per-task TaskHandle the same way)
+        handle = _query_handle(self.task_id.split(".")[0])
         try:
             ex = _TaskExecutor(self.session, self.rows_per_batch,
                                self.splits, self.sources, self.partition)
+            self.pool = ex.pool      # visible to /v1/info memory report
             ex.init_values = self.init_values
             ex.mark_shared([self.root])
-            for batch in ex.run(self.root):
+            # fair device scheduling across concurrent tasks: one quantum
+            # per produced batch (reference TaskExecutor time slicing)
+            it = ex.run(self.root)
+            sentinel = object()
+            while True:
+                batch = handle.scheduler.run_quantum(
+                    handle, lambda: next(it, sentinel))
+                if batch is sentinel:
+                    break
                 if batch.host_count() == 0:
                     continue
                 if self.output_kind == "partition":
@@ -276,6 +314,8 @@ class Task:
             self.error = f"{type(e).__name__}: {e}"
             self.state = "FAILED"
             self.buffer.fail(self.error)
+        finally:
+            _release_query_handle(self.task_id.split(".")[0])
 
     def abort(self) -> None:
         if self.state in ("PLANNED", "RUNNING"):
@@ -378,6 +418,10 @@ class _Handler(BaseHTTPRequestHandler):
                 task.abort()
             self._json(200, {"aborted": task is not None})
             return
+        if parts[:2] == ["v1", "query"] and len(parts) == 3:
+            n = self.worker.abort_query(parts[2])
+            self._json(200, {"aborted_tasks": n})
+            return
         self._json(404, {"error": "not found"})
 
 
@@ -419,14 +463,34 @@ class WorkerServer:
         return task
 
     def info(self) -> dict:
+        # per-query reserved bytes ride the heartbeat payload — the feed
+        # of the coordinator's cluster memory manager (reference
+        # memory/ClusterMemoryManager.java polls worker memory info)
+        queries: Dict[str, int] = {}
+        for t in list(self.tasks.values()):
+            pool = getattr(t, "pool", None)
+            if pool is None or t.state != "RUNNING":
+                continue
+            qid = t.task_id.split(".")[0]
+            queries[qid] = queries.get(qid, 0) + int(pool.reserved)
         return {
             "nodeId": self.node_id,
             "state": "SHUTTING_DOWN" if self.shutting_down else "ACTIVE",
             "uptime_s": time.time() - self.started_at,
-            "tasks": {s: sum(1 for t in self.tasks.values()
+            "tasks": {s: sum(1 for t in list(self.tasks.values())
                              if t.state == s)
                       for s in ("RUNNING", "FINISHED", "FAILED")},
+            "queryMemory": queries,
         }
+
+    def abort_query(self, query_id: str) -> int:
+        n = 0
+        for t in list(self.tasks.values()):
+            if t.task_id.split(".")[0] == query_id \
+                    and t.state in ("PLANNED", "RUNNING"):
+                t.abort()
+                n += 1
+        return n
 
     def begin_shutdown(self) -> None:
         """Drain: refuse new tasks, wait for active ones, then stop."""

@@ -423,6 +423,9 @@ class CreateTableAsSelect(Node):
     name: Tuple[str, ...]
     query: Query
     if_not_exists: bool = False
+    #: WITH (k = v, ...) table properties (reference
+    #: sql/tree/CreateTableAsSelect.java properties; e.g. partitioned_by)
+    properties: Tuple[Tuple[str, object], ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

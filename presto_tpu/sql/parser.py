@@ -294,8 +294,46 @@ class _Parser:
             self.expect_kw("exists")
             if_not_exists = True
         name = self.qualified_name()
+        props: List[Tuple[str, object]] = []
+        if self.accept_kw("with"):
+            self.expect_op("(")
+            while True:
+                key = self.identifier()
+                self.expect_op("=")
+                props.append((key, self._property_value()))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
         self.expect_kw("as")
-        return A.CreateTableAsSelect(name, self.query(), if_not_exists)
+        return A.CreateTableAsSelect(name, self.query(), if_not_exists,
+                                     properties=tuple(props))
+
+    def _property_value(self):
+        """Table property literal: string/number/bool or ARRAY[...] of
+        strings (reference sql/tree/Property.java values)."""
+        t = self.peek()
+        if t.kind == "IDENT" and t.text.lower() == "array":
+            self.next()
+            self.expect_op("[")
+            items: List[object] = []
+            if not self.accept_op("]"):
+                while True:
+                    items.append(self._property_value())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op("]")
+            return tuple(items)
+        t = self.next()
+        if t.kind == "STRING":
+            return t.text          # lexer already unquotes
+        if t.kind == "INTEGER":
+            return int(t.text)
+        if t.kind == "NUMBER":
+            return float(t.text)
+        if t.kind in ("IDENT", "KEYWORD") \
+                and t.text.lower() in ("true", "false"):
+            return t.text.lower() == "true"
+        raise SqlSyntaxError("expected property value", t.line, t.col)
 
     # -- queries ------------------------------------------------------------
     def query(self) -> A.Query:
@@ -332,16 +370,31 @@ class _Parser:
         return A.Query(body=body, with_=tuple(with_))
 
     def _set_expr(self) -> A.Node:
-        left = self._query_term()
-        while self.at_kw("union", "intersect", "except"):
+        # UNION/EXCEPT are left-associative peers; INTERSECT binds
+        # tighter (SqlBase.g4 queryTerm: setOperation precedence)
+        left = self._intersect_term()
+        while self.at_kw("union", "except"):
             op = self.next().text
             distinct = True
             if self.accept_kw("all"):
                 distinct = False
             else:
                 self.accept_kw("distinct")
-            right = self._query_term()
+            right = self._intersect_term()
             left = A.SetOperation(op, distinct, left, right)
+        return left
+
+    def _intersect_term(self) -> A.Node:
+        left = self._query_term()
+        while self.at_kw("intersect"):
+            self.next()
+            distinct = True
+            if self.accept_kw("all"):
+                distinct = False
+            else:
+                self.accept_kw("distinct")
+            right = self._query_term()
+            left = A.SetOperation("intersect", distinct, left, right)
         return left
 
     def _query_term(self) -> A.Node:

@@ -1,0 +1,79 @@
+"""Cluster memory manager: heartbeat memory payloads + biggest-query
+kill under cluster-wide pressure (reference
+memory/ClusterMemoryManager.java, TotalReservationLowMemoryKiller.java).
+"""
+import threading
+import time
+
+import pytest
+
+from presto_tpu.exec.cluster import (
+    ClusterMemoryManager, ClusterRunner, QueryFailedError,
+)
+from presto_tpu.server.worker import WorkerServer
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    workers = [WorkerServer(tpch_sf=SF) for _ in range(2)]
+    for w in workers:
+        w.start()
+    urls = [f"http://127.0.0.1:{w.port}" for w in workers]
+    runner = ClusterRunner(urls, tpch_sf=SF, heartbeat=False)
+    yield runner, workers
+    for w in workers:
+        w.stop()
+
+
+def test_heartbeat_reports_query_memory(cluster):
+    runner, workers = cluster
+    seen = {}
+
+    def snoop():
+        for _ in range(400):
+            for url in runner.worker_urls:
+                try:
+                    info = runner._request(f"{url}/v1/info")
+                except Exception:
+                    continue
+                for q, b in info.get("queryMemory", {}).items():
+                    seen[q] = max(seen.get(q, 0), b)
+            time.sleep(0.01)
+
+    t = threading.Thread(target=snoop, daemon=True)
+    t.start()
+    runner.execute(
+        "select l_orderkey, count(*) c from lineitem "
+        "group by 1 order by c desc limit 5")
+    time.sleep(0.1)
+    assert seen, "no queryMemory payload observed during execution"
+    assert max(seen.values()) > 0
+
+
+def test_kill_biggest_query_under_pressure(cluster):
+    runner, workers = cluster
+    # tiny cluster limit: the first poll that sees any reservation kills
+    # the (single) running query
+    mm = ClusterMemoryManager(runner, limit_bytes=1, interval_s=0.05)
+    mm.start()
+    try:
+        with pytest.raises(QueryFailedError):
+            for _ in range(20):   # retry loop: must die within budget
+                runner.execute(
+                    "select l_partkey, count(*), sum(l_extendedprice) "
+                    "from lineitem group by 1")
+    finally:
+        mm.stop()
+    assert mm.killed, "memory manager never killed a query"
+
+
+def test_enforce_picks_largest(cluster):
+    runner, _ = cluster
+    mm = ClusterMemoryManager(runner, limit_bytes=100)
+    mm.enforce({"cq_1": 60, "cq_2": 80})
+    assert list(mm.killed) == ["cq_2"]
+    # below the limit: no further kills
+    mm.enforce({"cq_1": 60})
+    assert list(mm.killed) == ["cq_2"]

@@ -1,0 +1,61 @@
+"""External recorded-answer checks.
+
+Expected values are transcribed from the reference's product-test
+fixtures (reference presto-product-tests/src/main/resources/sql-tests/
+testcases/tpch_connector/*.result — recorded outputs of Presto itself
+over the airlift dbgen tpch connector), plus TPC-spec-fixed table
+contents. They check our TPC-H connector against something OUTSIDE this
+repo's own code.
+
+Known divergence (documented): our generator is not dbgen
+bit-compatible (connectors/tpch.py:16) — per-order line counts draw from
+a different RNG stream, so tiny lineitem is 60472 vs dbgen's 60175.
+Spec-pinned tables (nation/region) and count formulas for the fixed-
+cardinality tables must match exactly.
+"""
+import pytest
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.exec.runner import LocalRunner
+    return LocalRunner(tpch_sf=0.01)
+
+
+# reference countXxxTiny.result values (dbgen tiny = SF 0.01)
+FIXED_COUNTS = {
+    "customer": 1500,
+    "orders": 15000,
+    "part": 2000,
+    "partsupp": 8000,
+    "supplier": 100,
+    "nation": 25,
+    "region": 5,
+}
+
+
+@pytest.mark.parametrize("table,want", sorted(FIXED_COUNTS.items()))
+def test_tiny_counts_match_reference(runner, table, want):
+    got = runner.execute(f"select count(*) from {table}").rows[0][0]
+    assert got == want
+
+
+def test_nation_contents_match_reference(runner):
+    # reference selectFromNationTiny.result (spec-fixed table)
+    got = runner.execute(
+        "select n_nationkey, n_name, n_regionkey from nation "
+        "order by n_nationkey").rows
+    want_head = [
+        (0, "ALGERIA", 0), (1, "ARGENTINA", 1), (2, "BRAZIL", 1),
+        (3, "CANADA", 1), (4, "EGYPT", 4), (5, "ETHIOPIA", 0),
+        (6, "FRANCE", 3),
+    ]
+    assert got[:7] == want_head
+    assert len(got) == 25
+
+
+def test_region_contents(runner):
+    got = runner.execute(
+        "select r_regionkey, r_name from region order by 1").rows
+    assert got == [(0, "AFRICA"), (1, "AMERICA"), (2, "ASIA"),
+                   (3, "EUROPE"), (4, "MIDDLE EAST")]

@@ -1,0 +1,161 @@
+"""Iterative rule engine + rule catalog unit tests, each asserted with
+the plan-pattern DSL (reference sql/planner/assertions/PlanMatchPattern
+.java + per-rule tests under sql/planner/iterative/rule/test/)."""
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.expr import ir
+from presto_tpu.planner.plan import (
+    DistinctNode, FilterNode, LimitNode, ProjectNode, SortKeySpec,
+    SortNode, TopNNode, UnionNode, ValuesNode,
+)
+from presto_tpu.planner.rules import (
+    Pattern, iterative_optimize, pattern,
+)
+from presto_tpu.sql.analyzer import Field
+
+
+def f(name="x", t=T.BIGINT):
+    return Field(name, t)
+
+
+def values(n_rows=3):
+    return ValuesNode(fields=(f(),), rows=tuple((i,) for i in range(n_rows)))
+
+
+def assert_plan(node, pat: Pattern):
+    """PlanMatchPattern.assertPlan analogue: the pattern must match the
+    node chain from the root."""
+    assert pat.matches(node), f"plan {node!r} does not match {pat!r}"
+
+
+def test_merge_limits():
+    plan = LimitNode(child=LimitNode(child=values(), count=2), count=5)
+    out = iterative_optimize(plan)
+    assert_plan(out, pattern(ValuesNode,
+                             where=lambda v: len(v.rows) == 2))
+
+
+def test_merge_limit_with_sort_to_topn():
+    plan = LimitNode(
+        child=SortNode(child=values(), keys=(SortKeySpec(0, True, None),)),
+        count=2)
+    out = iterative_optimize(plan)
+    assert_plan(out, pattern(
+        TopNNode, where=lambda n: n.count == 2,
+        child=pattern(ValuesNode)))
+
+
+def test_limit_zero_becomes_empty_values():
+    plan = LimitNode(child=values(), count=0)
+    out = iterative_optimize(plan)
+    assert_plan(out, pattern(ValuesNode,
+                             where=lambda v: len(v.rows) == 0))
+
+
+def test_merge_filters():
+    p = ir.call("gt", T.BOOLEAN, ir.input_ref(0, T.BIGINT),
+                ir.lit(1, T.BIGINT))
+    q = ir.call("lt", T.BOOLEAN, ir.input_ref(0, T.BIGINT),
+                ir.lit(5, T.BIGINT))
+    plan = FilterNode(child=FilterNode(child=values(), predicate=q),
+                      predicate=p)
+    out = iterative_optimize(plan)
+    assert_plan(out, pattern(FilterNode, child=pattern(ValuesNode)))
+
+
+def test_remove_true_filter():
+    plan = FilterNode(child=values(), predicate=ir.lit(True, T.BOOLEAN))
+    out = iterative_optimize(plan)
+    assert_plan(out, pattern(ValuesNode,
+                             where=lambda v: len(v.rows) == 3))
+
+
+def test_false_filter_becomes_empty():
+    plan = FilterNode(child=values(), predicate=ir.lit(False, T.BOOLEAN))
+    out = iterative_optimize(plan)
+    assert_plan(out, pattern(ValuesNode,
+                             where=lambda v: len(v.rows) == 0))
+
+
+def test_push_limit_through_project():
+    proj = ProjectNode(child=values(),
+                       exprs=(ir.input_ref(0, T.BIGINT),),
+                       fields=(f("y"),))
+    out = iterative_optimize(LimitNode(child=proj, count=2))
+    # limit reached the values leaf through the projection
+    assert_plan(out, pattern(
+        ProjectNode, child=pattern(ValuesNode,
+                                   where=lambda v: len(v.rows) == 2)))
+
+
+def test_push_limit_through_union():
+    u = UnionNode(children_=(values(5), values(5)), fields=(f(),),
+                  distinct=False)
+    out = iterative_optimize(LimitNode(child=u, count=2))
+    assert isinstance(out, LimitNode)
+    union = out.child
+    assert isinstance(union, UnionNode)
+    for c in union.children:
+        assert isinstance(c, ValuesNode) and len(c.rows) == 2
+
+
+def test_identity_projection_removed():
+    proj = ProjectNode(child=values(),
+                       exprs=(ir.input_ref(0, T.BIGINT),),
+                       fields=(f("x"),))
+    out = iterative_optimize(proj)
+    assert_plan(out, pattern(ValuesNode))
+
+
+def test_inline_projections():
+    inner = ProjectNode(
+        child=values(),
+        exprs=(ir.call("add", T.BIGINT, ir.input_ref(0, T.BIGINT),
+                       ir.lit(1, T.BIGINT)),),
+        fields=(f("a"),))
+    outer = ProjectNode(
+        child=inner,
+        exprs=(ir.call("mul", T.BIGINT, ir.input_ref(0, T.BIGINT),
+                       ir.lit(2, T.BIGINT)),),
+        fields=(f("b"),))
+    out = iterative_optimize(outer)
+    assert_plan(out, pattern(ProjectNode, child=pattern(ValuesNode)))
+    # composed expression: (x + 1) * 2
+    e = out.exprs[0]
+    assert isinstance(e, ir.Call) and e.name == "mul"
+    assert isinstance(e.args[0], ir.Call) and e.args[0].name == "add"
+
+
+def test_push_filter_through_project():
+    proj = ProjectNode(child=values(),
+                       exprs=(ir.input_ref(0, T.BIGINT),),
+                       fields=(f("y"),))
+    pred = ir.call("gt", T.BOOLEAN, ir.input_ref(0, T.BIGINT),
+                   ir.lit(0, T.BIGINT))
+    out = iterative_optimize(FilterNode(child=proj, predicate=pred))
+    # the renaming projection stays; the filter moved below it
+    assert_plan(out, pattern(
+        ProjectNode,
+        child=pattern(FilterNode, child=pattern(ValuesNode))))
+
+
+def test_distinct_over_distinct():
+    plan = DistinctNode(child=DistinctNode(child=values()))
+    out = iterative_optimize(plan)
+    assert_plan(out, pattern(DistinctNode, child=pattern(ValuesNode)))
+
+
+def test_end_to_end_queries_unchanged():
+    """Existing query results are unchanged with the rule engine on."""
+    from presto_tpu.exec.runner import LocalRunner
+    r = LocalRunner(tpch_sf=0.01)
+    rows = r.execute(
+        "select l_returnflag, count(*) from ("
+        "  select * from lineitem where l_quantity > 0 limit 1000"
+        ") t group by 1 order by 1").rows
+    assert sum(c for _, c in rows) == 1000
+    rows2 = r.execute(
+        "select * from (select 1 x union all select 2) t "
+        "order by x limit 1").rows
+    assert rows2 == [(1,)]
