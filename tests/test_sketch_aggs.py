@@ -154,3 +154,19 @@ def test_distributed_global_percentile(runner, dist):
     got = dist.execute(
         "select approx_percentile(l_quantity, 0.9) from lineitem").rows
     assert float(got[0][0]) == float(want[0][0])
+
+
+def test_distributed_approx_distinct(runner, dist):
+    """approx_distinct must survive the distributed exchange: the exact
+    mark-distinct lowering repartitions by (group, value), so shards
+    count disjoint value sets — trivially within any HLL error bound
+    (reference state/HyperLogLogState.java merges sketch states; exact
+    states merge by summing disjoint counts)."""
+    q = ("select l_returnflag, approx_distinct(l_suppkey) "
+         "from lineitem group by 1 order by 1")
+    assert dist.execute(q).rows == runner.execute(q).rows
+
+
+def test_distributed_global_approx_distinct(runner, dist):
+    q = "select approx_distinct(l_orderkey) from lineitem"
+    assert dist.execute(q).rows == runner.execute(q).rows
