@@ -716,6 +716,18 @@ def main() -> None:
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "3000"))
     t_start = time.perf_counter()
 
+    import signal
+
+    class _ConfigTimeout(Exception):
+        pass
+
+    def _on_alarm(signum, frame):
+        raise _ConfigTimeout()
+
+    alarm_ok = hasattr(signal, "SIGALRM")
+    if alarm_ok:
+        signal.signal(signal.SIGALRM, _on_alarm)
+
     results = []
     for name, sf, fn, prefix in (
             ("q6", sf_q6, bench_q6, "tpch"),
@@ -730,7 +742,19 @@ def main() -> None:
             continue
         print(f"[bench] {name} sf={sf:g} starting at {elapsed:.0f}s",
               file=sys.stderr, flush=True)
-        total, dev_s, np_s = fn(sf)
+        # per-config watchdog: one pathological compile/run must not eat
+        # every later config's slot (completed numbers stay reportable)
+        if alarm_ok:
+            signal.alarm(int(max(budget_s * 1.2 - elapsed, 120)))
+        try:
+            total, dev_s, np_s = fn(sf)
+        except _ConfigTimeout:
+            print(f"[bench] {name} exceeded its time slot; skipping",
+                  file=sys.stderr, flush=True)
+            continue
+        finally:
+            if alarm_ok:
+                signal.alarm(0)
         print(f"[bench] {name} done: {round(total / dev_s):,} rows/s "
               f"(vs {np_s / dev_s:.2f})", file=sys.stderr, flush=True)
         results.append({

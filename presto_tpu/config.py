@@ -1,0 +1,144 @@
+"""Config-file system: etc/config.properties + etc/catalog/*.properties.
+
+The role of the reference's airlift bootstrap config binding (reference
+server/PrestoServer.java:86 Bootstrap over @Config classes like
+ServerConfig/TaskManagerConfig; StaticCatalogStore loading
+etc/catalog/*.properties into ConnectorManager.createConnection, and
+spi/Plugin.java ConnectorFactories resolved by 'connector.name').
+
+Layout:
+
+    etc/
+      config.properties          node.id, coordinator, discovery.uri,
+                                 http-server.http.port, session defaults
+                                 (session.<name>=<value>)
+      catalog/
+        tpch.properties          connector.name=tpch
+                                 tpch.scale-factor=1
+        warehouse.properties     connector.name=orc
+                                 orc.root=/data/warehouse
+
+Connector factories are a plain registry keyed by ``connector.name`` —
+the plugin SPI's loading half (PluginManager.java:121's role without
+classloader isolation, which Python does not need).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+from .connectors.spi import CatalogManager
+
+
+def parse_properties(path: str) -> Dict[str, str]:
+    """key=value lines; '#' comments; whitespace-tolerant (the reference
+    uses java.util.Properties semantics)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"{path}: malformed line {line!r}")
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+# -- connector factory registry (the Plugin/ConnectorFactory role) ----------
+
+def _tpch_factory(props):
+    from .connectors.tpch import TpchConnector
+    return TpchConnector(sf=float(props.get("tpch.scale-factor", "1")))
+
+
+def _tpcds_factory(props):
+    from .connectors.tpcds import TpcdsConnector
+    return TpcdsConnector(sf=float(props.get("tpcds.scale-factor", "1")))
+
+
+def _memory_factory(props):
+    from .connectors.memory import MemoryConnector
+    return MemoryConnector()
+
+
+def _orc_factory(props):
+    from .connectors.orc import OrcConnector
+    return OrcConnector(props["orc.root"])
+
+
+def _parquet_factory(props):
+    from .connectors.parquet import ParquetConnector
+    return ParquetConnector(props["parquet.root"])
+
+
+CONNECTOR_FACTORIES: Dict[str, Callable] = {
+    "tpch": _tpch_factory,
+    "tpcds": _tpcds_factory,
+    "memory": _memory_factory,
+    "orc": _orc_factory,
+    "parquet": _parquet_factory,
+}
+
+
+def register_connector_factory(name: str, factory: Callable) -> None:
+    """Third-party connector registration (the Plugin.getConnectorFactories
+    surface)."""
+    CONNECTOR_FACTORIES[name] = factory
+
+
+def load_catalogs(etc_dir: str,
+                  catalogs: Optional[CatalogManager] = None
+                  ) -> CatalogManager:
+    """etc/catalog/*.properties -> mounted connectors (reference
+    StaticCatalogStore.loadCatalogs)."""
+    catalogs = catalogs or CatalogManager()
+    cat_dir = os.path.join(etc_dir, "catalog")
+    if not os.path.isdir(cat_dir):
+        return catalogs
+    for entry in sorted(os.listdir(cat_dir)):
+        if not entry.endswith(".properties"):
+            continue
+        props = parse_properties(os.path.join(cat_dir, entry))
+        name = entry[:-len(".properties")]
+        kind = props.get("connector.name")
+        if kind is None:
+            raise ValueError(f"{entry}: missing connector.name")
+        factory = CONNECTOR_FACTORIES.get(kind)
+        if factory is None:
+            raise ValueError(
+                f"{entry}: unknown connector.name {kind!r} "
+                f"(registered: {sorted(CONNECTOR_FACTORIES)})")
+        catalogs.register(name, factory(props))
+    # the system catalog reflects over everything mounted so far
+    from .connectors.system import SystemConnector
+    if "system" not in catalogs.names():
+        catalogs.register("system", SystemConnector(catalogs))
+    return catalogs
+
+
+class NodeConfig:
+    """Parsed etc/config.properties (reference ServerConfig +
+    NodeConfig + the session-default slice of SystemSessionProperties)."""
+
+    def __init__(self, props: Dict[str, str]):
+        self.props = props
+        self.node_id: Optional[str] = props.get("node.id")
+        self.coordinator = props.get("coordinator", "true") \
+            .lower() == "true"
+        self.http_port = int(props.get("http-server.http.port", "0"))
+        self.discovery_uri = props.get("discovery.uri")
+        self.catalog = props.get("session.catalog", "tpch")
+        self.schema = props.get("session.schema", "default")
+        #: session property defaults: session.<name>=<value>
+        self.session_defaults = {
+            k[len("session."):]: v for k, v in props.items()
+            if k.startswith("session.")
+            and k not in ("session.catalog", "session.schema")}
+
+
+def load_node_config(etc_dir: str) -> NodeConfig:
+    path = os.path.join(etc_dir, "config.properties")
+    return NodeConfig(parse_properties(path) if os.path.isfile(path)
+                      else {})

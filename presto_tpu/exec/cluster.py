@@ -50,14 +50,21 @@ class HeartbeatFailureDetector:
     failuredetector/HeartbeatFailureDetector.java:77,360 — the
     exponential-decay rate collapsed to a consecutive-failure budget)."""
 
-    def __init__(self, urls: List[str], interval_s: float = 5.0,
+    def __init__(self, urls, interval_s: float = 5.0,
                  max_consecutive: int = 3):
-        self.urls = list(urls)
+        # ``urls`` may be a static list or a zero-arg callable returning
+        # the current membership (discovery-fed, reference
+        # DiscoveryNodeManager feeding the failure detector)
+        self._source = urls if callable(urls) else (lambda: list(urls))
         self.interval_s = interval_s
         self.max_consecutive = max_consecutive
-        self.failures: Dict[str, int] = {u: 0 for u in urls}
+        self.failures: Dict[str, int] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    @property
+    def urls(self) -> List[str]:
+        return list(self._source())
 
     def start(self) -> None:
         self._thread.start()
@@ -80,11 +87,11 @@ class HeartbeatFailureDetector:
                 if self.ping(u):
                     self.failures[u] = 0
                 else:
-                    self.failures[u] += 1
+                    self.failures[u] = self.failures.get(u, 0) + 1
 
     def active(self) -> List[str]:
         return [u for u in self.urls
-                if self.failures[u] < self.max_consecutive]
+                if self.failures.get(u, 0) < self.max_consecutive]
 
 
 class ClusterMemoryManager:
@@ -142,18 +149,22 @@ class ClusterRunner:
     """Executes SELECT queries across worker processes; everything else
     (DDL, SET, EXPLAIN) falls through to the embedded LocalRunner."""
 
-    def __init__(self, worker_urls: List[str], catalogs=None,
+    def __init__(self, worker_urls: Optional[List[str]] = None,
+                 catalogs=None,
                  catalog: str = "tpch", schema: str = "default",
                  tpch_sf: float = 0.01, rows_per_batch: int = 1 << 17,
-                 heartbeat: bool = True):
-        self.worker_urls = list(worker_urls)
+                 heartbeat: bool = True, discovery=None):
+        # static URL list OR discovery-fed dynamic membership (reference
+        # DiscoveryNodeManager: workers join by announcing, any time)
+        self.discovery = discovery
+        self._static_urls = list(worker_urls or ())
         self.local = LocalRunner(catalogs=catalogs, catalog=catalog,
                                  schema=schema, tpch_sf=tpch_sf,
                                  rows_per_batch=rows_per_batch)
         self.session = self.local.session
         self.rows_per_batch = rows_per_batch
         self._seq = 0
-        self.detector = HeartbeatFailureDetector(worker_urls)
+        self.detector = HeartbeatFailureDetector(self._current_urls)
         if heartbeat:
             self.detector.start()
         self.memory_manager: Optional[ClusterMemoryManager] = None
@@ -166,6 +177,15 @@ class ClusterRunner:
         self.memory_manager = ClusterMemoryManager(self, limit_bytes,
                                                    interval_s)
         self.memory_manager.start()
+
+    def _current_urls(self) -> List[str]:
+        if self.discovery is not None:
+            return self.discovery.active_urls()
+        return list(self._static_urls)
+
+    @property
+    def worker_urls(self) -> List[str]:
+        return self._current_urls()
 
     # -- HTTP helpers --------------------------------------------------------
     def _request(self, url: str, method: str = "GET",

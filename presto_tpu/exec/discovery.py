@@ -1,0 +1,80 @@
+"""Discovery: dynamic worker membership by announcement.
+
+The role of the reference's embedded discovery service + node manager
+(reference metadata/DiscoveryNodeManager.java:68 tracking active workers
+from announcements; server/EmbeddedDiscoveryConfig.java; workers
+announce over airlift discovery and may join at any time = elastic
+scale-out). Workers POST /v1/announce to the coordinator on a heartbeat
+cadence; entries expire after a TTL so vanished workers drop out of
+scheduling without explicit deregistration.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Tuple
+
+
+class DiscoveryNodeManager:
+    """Coordinator-side registry of announced workers."""
+
+    def __init__(self, ttl_s: float = 15.0):
+        self.ttl_s = ttl_s
+        self._nodes: Dict[str, Tuple[str, float]] = {}
+        self._lock = threading.Lock()
+
+    def announce(self, node_id: str, url: str) -> None:
+        with self._lock:
+            self._nodes[node_id] = (url, time.monotonic())
+
+    def active_urls(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return sorted(url for url, seen in self._nodes.values()
+                          if now - seen <= self.ttl_s)
+
+    def nodes(self) -> List[dict]:
+        now = time.monotonic()
+        with self._lock:
+            return [{"nodeId": nid, "uri": url,
+                     "age_s": round(now - seen, 3),
+                     "active": now - seen <= self.ttl_s}
+                    for nid, (url, seen) in sorted(self._nodes.items())]
+
+
+class Announcer:
+    """Worker-side announce loop (the airlift Announcer role)."""
+
+    def __init__(self, discovery_uri: str, node_id: str, self_url: str,
+                 interval_s: float = 5.0):
+        self.discovery_uri = discovery_uri.rstrip("/")
+        self.node_id = node_id
+        self.self_url = self_url
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def announce_once(self) -> bool:
+        body = json.dumps({"nodeId": self.node_id,
+                           "uri": self.self_url}).encode()
+        req = urllib.request.Request(
+            f"{self.discovery_uri}/v1/announce", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5):
+                return True
+        except Exception:
+            return False
+
+    def start(self) -> None:
+        self.announce_once()
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.announce_once()

@@ -90,6 +90,13 @@ def repartition_by_hash(batch: Batch, key_cols: Sequence[int],
     to its full local batch); masks encode which slots are live.
     """
     pid = hash_partition_ids(batch, key_cols, n_partitions)
+    return repartition_by_ids(batch, pid, axis_name, n_partitions)
+
+
+def repartition_by_ids(batch: Batch, pid: jnp.ndarray,
+                       axis_name: str, n_partitions: int) -> Batch:
+    """Masked all-to-all by caller-supplied destination ids — the shared
+    engine under hash exchange AND range exchange (distributed sort)."""
     dest = jnp.arange(n_partitions, dtype=jnp.int32)[:, None]
     bucket_mask = batch.row_mask[None, :] & (pid[None, :] == dest)  # [n, C]
 

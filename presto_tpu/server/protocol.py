@@ -236,6 +236,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self) -> None:
+        if self.path == "/v1/announce":
+            # node-internal announcement (reference discovery service);
+            # not behind client auth, like reference internal comms
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n) or b"{}")
+            self._srv.discovery.announce(doc.get("nodeId", ""),
+                                         doc.get("uri", ""))
+            self._reply(202, {"announced": True})
+            return
         if self.path != "/v1/statement":
             self._reply(404, {"error": "not found"})
             return
@@ -263,6 +272,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, self._results_doc(q, 0, first=True))
 
     def do_GET(self) -> None:
+        if self.path == "/v1/service":
+            self._reply(200, {"services": self._srv.discovery.nodes()})
+            return
         if not self._authenticate():
             return
         if self.path.rstrip("/") == "/v1/resourceGroup":
@@ -393,6 +405,8 @@ class PrestoTpuServer:
         # time (the single shared device); pass a rootGroups/selectors
         # dict for real concurrency tiers
         self.resource_groups = ResourceGroupManager(resource_groups)
+        from ..exec.discovery import DiscoveryNodeManager
+        self.discovery = DiscoveryNodeManager()
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.presto = self      # type: ignore[attr-defined]
         self.port = self.httpd.server_address[1]

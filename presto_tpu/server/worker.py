@@ -449,11 +449,25 @@ class WorkerServer:
         self.node_id = node_id or f"worker-{self.port}"
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
+        self._announcer = None
 
     def start(self) -> None:
         self._thread.start()
 
+    def start_announcing(self, discovery_uri: str,
+                         advertised_host: str = "127.0.0.1",
+                         interval_s: float = 5.0) -> None:
+        """Join a coordinator by announcement (reference workers announce
+        via discovery and may join any time — elastic scale-out)."""
+        from ..exec.discovery import Announcer
+        self._announcer = Announcer(
+            discovery_uri, self.node_id,
+            f"http://{advertised_host}:{self.port}", interval_s)
+        self._announcer.start()
+
     def stop(self) -> None:
+        if self._announcer is not None:
+            self._announcer.stop()
         self.httpd.shutdown()
 
     def create_task(self, task_id: str, doc: dict) -> Task:
@@ -511,11 +525,29 @@ def main() -> None:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--tpch-sf", type=float, default=0.01)
     p.add_argument("--node-id", default=None)
+    p.add_argument("--etc-dir", default=None,
+                   help="config directory (config.properties + catalog/)")
+    p.add_argument("--coordinator", default=None,
+                   help="coordinator URL to announce to "
+                        "(overrides etc discovery.uri)")
     args = p.parse_args()
-    w = WorkerServer(host=args.host, port=args.port,
-                     node_id=args.node_id, tpch_sf=args.tpch_sf)
+    catalogs = None
+    node_id = args.node_id
+    port = args.port
+    discovery_uri = args.coordinator
+    if args.etc_dir:
+        from ..config import load_catalogs, load_node_config
+        cfg = load_node_config(args.etc_dir)
+        catalogs = load_catalogs(args.etc_dir)
+        node_id = node_id or cfg.node_id
+        port = port or cfg.http_port
+        discovery_uri = discovery_uri or cfg.discovery_uri
+    w = WorkerServer(catalogs=catalogs, host=args.host, port=port,
+                     node_id=node_id, tpch_sf=args.tpch_sf)
     print(json.dumps({"nodeId": w.node_id, "port": w.port}), flush=True)
     w.start()
+    if discovery_uri:
+        w.start_announcing(discovery_uri, advertised_host=args.host)
     try:
         while True:
             time.sleep(3600)

@@ -1,0 +1,109 @@
+"""Config-file system + discovery/announce membership.
+
+Reference: airlift bootstrap @Config binding over etc/config.properties,
+StaticCatalogStore over etc/catalog/*.properties (PrestoServer.java:86),
+and DiscoveryNodeManager.java:68 (workers join by announcing; vanished
+workers age out)."""
+import time
+
+import pytest
+
+
+def _write_etc(tmp_path, catalog_props):
+    etc = tmp_path / "etc"
+    (etc / "catalog").mkdir(parents=True)
+    (etc / "config.properties").write_text(
+        "node.id = test-node\n"
+        "coordinator=true\n"
+        "# a comment\n"
+        "session.catalog = tiny\n"
+        "session.schema = t\n"
+        "session.scan_threads = 3\n")
+    for name, props in catalog_props.items():
+        (etc / "catalog" / f"{name}.properties").write_text(props)
+    return str(etc)
+
+
+def test_load_catalogs_and_config(tmp_path):
+    from presto_tpu.config import load_catalogs, load_node_config
+    etc = _write_etc(tmp_path, {
+        "tiny": "connector.name=tpch\ntpch.scale-factor=0.01\n",
+        "mem": "connector.name=memory\n",
+    })
+    cfg = load_node_config(etc)
+    assert cfg.node_id == "test-node" and cfg.coordinator
+    assert cfg.catalog == "tiny"
+    assert cfg.session_defaults["scan_threads"] == "3"
+    catalogs = load_catalogs(etc)
+    assert set(catalogs.names()) >= {"tiny", "mem", "system"}
+    assert abs(catalogs.get("tiny").sf - 0.01) < 1e-12
+
+
+def test_catalog_file_errors(tmp_path):
+    from presto_tpu.config import load_catalogs
+    etc = _write_etc(tmp_path, {"bad": "no_connector_name=1\n"})
+    with pytest.raises(ValueError):
+        load_catalogs(etc)
+
+
+def test_orc_catalog_from_properties(tmp_path):
+    from presto_tpu.config import load_catalogs
+    (tmp_path / "wh").mkdir()
+    etc = _write_etc(tmp_path, {
+        "warehouse": f"connector.name=orc\norc.root={tmp_path}/wh\n"})
+    catalogs = load_catalogs(etc)
+    assert catalogs.get("warehouse").root == f"{tmp_path}/wh"
+
+
+def test_query_via_config_loaded_runner(tmp_path):
+    from presto_tpu.config import load_catalogs, load_node_config
+    from presto_tpu.exec.runner import LocalRunner
+    etc = _write_etc(tmp_path, {
+        "tiny": "connector.name=tpch\ntpch.scale-factor=0.01\n"})
+    cfg = load_node_config(etc)
+    r = LocalRunner(catalogs=load_catalogs(etc), catalog=cfg.catalog,
+                    schema=cfg.schema)
+    r.session.properties.update(cfg.session_defaults)
+    assert r.execute("select count(*) from nation").rows == [(25,)]
+
+
+def test_announce_and_ttl():
+    from presto_tpu.exec.discovery import DiscoveryNodeManager
+    d = DiscoveryNodeManager(ttl_s=0.2)
+    d.announce("w1", "http://h1:1")
+    d.announce("w2", "http://h2:2")
+    assert d.active_urls() == ["http://h1:1", "http://h2:2"]
+    time.sleep(0.3)
+    d.announce("w2", "http://h2:2")
+    assert d.active_urls() == ["http://h2:2"]
+    infos = {n["nodeId"]: n for n in d.nodes()}
+    assert infos["w1"]["active"] is False
+    assert infos["w2"]["active"] is True
+
+
+def test_worker_announces_to_statement_server():
+    """End-to-end: a worker joins a coordinator by announcement and a
+    discovery-fed ClusterRunner schedules on it."""
+    from presto_tpu.exec.cluster import ClusterRunner
+    from presto_tpu.server.protocol import PrestoTpuServer
+    from presto_tpu.server.worker import WorkerServer
+
+    srv = PrestoTpuServer()
+    srv.start() if hasattr(srv, "start") else srv._thread.start()
+    worker = WorkerServer(tpch_sf=0.01)
+    worker.start()
+    try:
+        worker.start_announcing(f"http://127.0.0.1:{srv.port}",
+                                interval_s=0.5)
+        deadline = time.time() + 10
+        while not srv.discovery.active_urls() and time.time() < deadline:
+            time.sleep(0.05)
+        urls = srv.discovery.active_urls()
+        assert urls == [f"http://127.0.0.1:{worker.port}"]
+        runner = ClusterRunner(discovery=srv.discovery, tpch_sf=0.01,
+                               heartbeat=False)
+        assert runner.execute(
+            "select count(*) from nation").rows == [(25,)]
+    finally:
+        worker.stop()
+        srv.httpd.shutdown()
