@@ -142,3 +142,35 @@ def load_node_config(etc_dir: str) -> NodeConfig:
     path = os.path.join(etc_dir, "config.properties")
     return NodeConfig(parse_properties(path) if os.path.isfile(path)
                       else {})
+
+
+def load_resource_groups(etc_dir: str):
+    """etc/resource-groups.json -> ResourceGroupManager config dict
+    (the file-backed half of reference
+    presto-resource-group-managers/.../FileResourceGroupConfigurationManager
+    .java; selectors/limits keep this engine's JSON shape)."""
+    import json as _json
+    path = os.path.join(etc_dir, "resource-groups.json")
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return _json.load(f)
+
+
+def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
+                    port: Optional[int] = None):
+    """Boot a statement server from a config directory — the
+    PrestoServer.run analogue (reference server/PrestoServer.java:86:
+    config binding, catalog store, resource groups, announce)."""
+    from .exec.runner import LocalRunner
+    from .server.protocol import PrestoTpuServer
+    cfg = load_node_config(etc_dir)
+    catalogs = load_catalogs(etc_dir)
+    runner = LocalRunner(catalogs=catalogs, catalog=cfg.catalog,
+                         schema=cfg.schema)
+    runner.session.properties.update(cfg.session_defaults)
+    srv = PrestoTpuServer(
+        runner=runner, host=host,
+        port=cfg.http_port if port is None else port,
+        resource_groups=load_resource_groups(etc_dir))
+    return srv, cfg

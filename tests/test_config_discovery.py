@@ -107,3 +107,36 @@ def test_worker_announces_to_statement_server():
     finally:
         worker.stop()
         srv.httpd.shutdown()
+
+
+def test_server_from_etc(tmp_path):
+    """Full coordinator bootstrap from a config directory: catalogs,
+    session defaults, resource groups (PrestoServer.run analogue)."""
+    import json
+    import urllib.request
+
+    etc = _write_etc(tmp_path, {
+        "tiny": "connector.name=tpch\ntpch.scale-factor=0.01\n"})
+    (tmp_path / "etc" / "resource-groups.json").write_text(json.dumps({
+        "rootGroups": [{"name": "global", "hardConcurrencyLimit": 4,
+                        "maxQueued": 10}],
+        "selectors": [{"group": "global"}],
+    }))
+    from presto_tpu.config import server_from_etc
+    srv, cfg = server_from_etc(str(etc))
+    srv.start()
+    try:
+        body = "select count(*) from nation".encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/statement", data=body,
+            method="POST", headers={"X-Presto-User": "t"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json.loads(resp.read())
+        while doc.get("nextUri"):
+            with urllib.request.urlopen(doc["nextUri"],
+                                        timeout=30) as resp:
+                nxt = json.loads(resp.read())
+            doc = {**nxt, "data": doc.get("data") or nxt.get("data")}
+        assert doc.get("data") == [[25]]
+    finally:
+        srv.httpd.shutdown()
