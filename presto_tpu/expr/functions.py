@@ -866,6 +866,19 @@ def _vocab_bool_fn(fn):
 
 
 register("starts_with")(_vocab_bool_fn(lambda s, p: s.startswith(p)))
+register("ends_with")(_vocab_bool_fn(lambda s, p: s.endswith(p)))
+# reference operator/scalar/StringFunctions.java translate(): chars in
+# `from` map positionally to `to`; unmatched positions delete
+register("translate")(_vocab_transform(
+    lambda s, frm, to: s.translate(
+        {ord(c): (to[i] if i < len(to) else None)
+         for i, c in enumerate(frm)})))
+# deviation: the reference raises for unequal lengths
+# (StringFunctions.hammingDistance); the vocab-table evaluation path has
+# no per-entry error channel, so unequal lengths count their difference
+register("hamming_distance")(_vocab_int_fn(
+    lambda s, t: sum(a != b for a, b in zip(s, t))
+    + abs(len(s) - len(t))))
 
 
 def _presto_replacement(repl: str) -> str:
@@ -1251,13 +1264,14 @@ def infer_call_type(name: str, arg_types: List[Type]) -> Type:
     if name in ("year", "month", "day", "quarter", "day_of_week",
                 "day_of_year", "week", "year_of_week", "hour", "minute",
                 "second", "millisecond", "date_diff", "width_bucket",
-                "strpos", "codepoint", "levenshtein_distance", "bit_count",
+                "strpos", "codepoint", "levenshtein_distance",
+                "hamming_distance", "bit_count",
                 "url_extract_port", "bitwise_and", "bitwise_or",
                 "bitwise_xor", "bitwise_not", "bitwise_left_shift",
                 "bitwise_right_shift", "bitwise_arithmetic_shift_right"):
         return T.BIGINT
     if name in ("is_nan", "is_finite", "is_infinite", "starts_with",
-                "regexp_like"):
+                "ends_with", "regexp_like"):
         return T.BOOLEAN
     if name in ("greatest", "least"):
         out = arg_types[0]
@@ -1278,6 +1292,7 @@ def infer_call_type(name: str, arg_types: List[Type]) -> Type:
     if name == "from_unixtime":
         return T.TIMESTAMP
     if name in ("lower", "upper", "trim", "ltrim", "rtrim", "substr",
+                "translate",
                 "concat", "replace", "reverse", "lpad", "rpad", "split_part",
                 "regexp_extract", "regexp_replace", "json_extract_scalar",
                 "url_extract_protocol", "url_extract_host",

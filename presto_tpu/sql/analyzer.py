@@ -48,6 +48,7 @@ _FUNCTION_ALIASES = {
     "stddev": "stddev_samp", "variance": "var_samp",
     "var": "var_samp", "every": "bool_and",
     "dow": "day_of_week", "doy": "day_of_year",
+    "day_of_month": "day",
     "week_of_year": "week", "yow": "year_of_week",
 }
 

@@ -239,3 +239,12 @@ def test_functions_over_table_scan(runner):
     for d, dow, wk in rows:
         iso = d.isocalendar()
         assert dow == iso[2] and wk == iso[1]
+
+
+def test_string_function_additions(runner):
+    rows = runner.execute(
+        "select ends_with('hello', 'llo'), ends_with('hello', 'x'), "
+        "translate('abcde', 'bd', 'XY'), translate('abc', 'b', ''), "
+        "hamming_distance('karolin', 'kathrin'), "
+        "day_of_month(date '2024-03-07')").rows
+    assert rows == [(True, False, "aXcYe", "ac", 3, 7)]
