@@ -31,3 +31,13 @@ class QueryError(RuntimeError):
         self.code = code
         self.name = ERROR_NAMES.get(code, f"ERROR_{code}")
         super().__init__(message or self.name)
+
+
+class QueryCancelledError(RuntimeError):
+    """Raised by the executor when a cancel request interrupts a running
+    query between batch quanta (the role of the reference's
+    dispatcher/DispatchManager.java:134 cancel semantics: a DELETE on the
+    statement URI must stop in-flight work, not just mark state)."""
+
+    def __init__(self, message: str = "Query was canceled"):
+        super().__init__(message)

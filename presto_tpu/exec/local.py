@@ -75,9 +75,10 @@ def run_init_plans(ex, plan: LogicalPlan) -> None:
 
 def execute_plan(plan: LogicalPlan, session: Session,
                  rows_per_batch: int = 1 << 17, stats=None,
-                 collect_rows: bool = True) -> QueryResult:
+                 collect_rows: bool = True, cancel_event=None) -> QueryResult:
     from .taskexec import GLOBAL as scheduler
     ex = _Executor(session, rows_per_batch, stats=stats)
+    ex.cancel_event = cancel_event
     handle = (scheduler.task(name=str(id(ex)))
               if bool_property(session, "fair_scheduling", True) else None)
     try:
@@ -90,13 +91,24 @@ def execute_plan(plan: LogicalPlan, session: Session,
         # time (the reference's TaskExecutor 1s-quantum role)
         it = ex.run(root.child)
         sentinel = object()
-        while True:
-            b = scheduler.run_quantum(handle,
-                                      lambda: next(it, sentinel))
-            if b is sentinel:
-                break
-            if collect_rows:
-                out_batches.append(b)
+        try:
+            while True:
+                # cancellation interrupts between quanta, like the
+                # reference Driver checking its DriverYieldSignal/state
+                # between page moves (operator/Driver.java:262;
+                # DispatchManager.java:134)
+                ex._check_cancel()
+                b = scheduler.run_quantum(handle,
+                                          lambda: next(it, sentinel))
+                if b is sentinel:
+                    break
+                if collect_rows:
+                    out_batches.append(b)
+        finally:
+            # closing the generator runs suspended finally blocks (the
+            # threaded scan's stop.set()) so cancel/error doesn't leave
+            # prefetch workers spinning
+            it.close()
         ex.check_errors()
         if collect_rows:
             rows = [r for b in out_batches for r in b.to_pylist()]
@@ -249,6 +261,9 @@ class _Executor:
         self.rows_per_batch = rows_per_batch
         self.init_values: List[object] = []
         self.stats = stats
+        # set by execute_plan: a threading.Event checked per scan batch
+        # so a DELETE-cancel interrupts a query mid-drain
+        self.cancel_event = None
         # device int32 scalars from error-checking kernels; reduced to one
         # host sync by check_errors() after the plan drains
         self.error_flags: List = []
@@ -271,6 +286,12 @@ class _Executor:
         self.spill_partitions = int(
             session.properties.get("spill_partitions", 16))
         session.last_memory_stats = self.pool.stats
+
+    def _check_cancel(self) -> None:
+        ev = self.cancel_event
+        if ev is not None and ev.is_set():
+            from ..errors import QueryCancelledError
+            raise QueryCancelledError()
 
     def checked_filter(self, pred: ir.Expr, schema: Schema):
         """Compiled filter that feeds row errors into this query's
@@ -420,7 +441,9 @@ class _Executor:
                 src = conn.page_source(split, list(node.columns),
                                        pushdown=current_pushdown(),
                                        rows_per_batch=self.rows_per_batch)
-                yield from src.batches()
+                for b in src.batches():
+                    self._check_cancel()
+                    yield b
             return
 
         DONE = object()
@@ -472,6 +495,7 @@ class _Executor:
                         break
                     if isinstance(item, BaseException):
                         raise item
+                    self._check_cancel()
                     yield item
         finally:
             stop.set()

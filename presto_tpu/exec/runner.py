@@ -56,7 +56,7 @@ class LocalRunner:
     # -- public API -----------------------------------------------------------
     def execute(self, sql: str,
                 properties: Optional[Dict[str, object]] = None,
-                user: str = "") -> QueryResult:
+                user: str = "", cancel_event=None) -> QueryResult:
         """Run one statement. ``properties`` overlays per-query session
         properties without mutating the shared session (needed for
         concurrent queries under resource groups; the reference builds a
@@ -75,7 +75,8 @@ class LocalRunner:
         t0 = _time.perf_counter()
         error: Optional[str] = None
         try:
-            out = self._execute_stmt(stmt, properties, user)
+            out = self._execute_stmt(stmt, properties, user,
+                                     cancel_event=cancel_event)
             entry.state = "FINISHED"
             return out
         except Exception as e:
@@ -100,7 +101,7 @@ class LocalRunner:
     # -- statement dispatch ---------------------------------------------------
     def _execute_stmt(self, stmt: A.Node,
                       properties: Optional[Dict[str, object]] = None,
-                      user: str = "") -> QueryResult:
+                      user: str = "", cancel_event=None) -> QueryResult:
         import dataclasses as _dc
         session = self.session
         secured = bool(self.access_control.catalog_rules)
@@ -115,7 +116,8 @@ class LocalRunner:
                 properties={**session.properties, **(properties or {})})
         if isinstance(stmt, A.Query):
             plan = optimize(plan_query(stmt, session), session)
-            return execute_plan(plan, session, self.rows_per_batch)
+            return execute_plan(plan, session, self.rows_per_batch,
+                                cancel_event=cancel_event)
         if isinstance(stmt, A.Explain):
             if not isinstance(stmt.statement, A.Query):
                 raise ValueError("EXPLAIN requires a query")
@@ -145,7 +147,8 @@ class LocalRunner:
                 stats.planning_s = _time.perf_counter() - t0
                 t1 = _time.perf_counter()
                 execute_plan(plan, session, self.rows_per_batch,
-                             stats=stats, collect_rows=False)
+                             stats=stats, collect_rows=False,
+                             cancel_event=cancel_event)
                 stats.total_wall_s = _time.perf_counter() - t1
             if stmt.type == "distributed":
                 if stmt.format != "text":
@@ -217,9 +220,11 @@ class LocalRunner:
             self.transactions.rollback(user=user)
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
         if isinstance(stmt, A.CreateTableAsSelect):
-            return self._ctas(stmt, session, user)
+            return self._ctas(stmt, session, user,
+                              cancel_event=cancel_event)
         if isinstance(stmt, A.InsertInto):
-            return self._insert(stmt, session, user)
+            return self._insert(stmt, session, user,
+                                cancel_event=cancel_event)
         if isinstance(stmt, A.DropTable):
             conn, table = self._writable(stmt.name, user)
             conn.drop_table(table, if_exists=stmt.if_exists)
@@ -272,7 +277,8 @@ class LocalRunner:
                     f"Incorrect number of parameters: expected {want} "
                     f"but found {len(stmt.args)}")
             bound = substitute_parameters(prepared, list(stmt.args))
-            return self._execute_stmt(bound, properties, user)
+            return self._execute_stmt(bound, properties, user,
+                                      cancel_event=cancel_event)
         if isinstance(stmt, A.DescribeOutput):
             prepared = self.session.prepared.get(stmt.name)
             if prepared is None:
@@ -318,12 +324,14 @@ class LocalRunner:
         self.transactions.touch_for_write(catalog, conn, user=user)
         return conn, name[-1]
 
-    def _run_to_batches(self, query: A.Query, session=None):
+    def _run_to_batches(self, query: A.Query, session=None,
+                        cancel_event=None):
         from ..batch import Schema
         from .local import _Executor, run_init_plans
         session = session or self.session
         plan = optimize(plan_query(query, session), session)
         ex = _Executor(session, self.rows_per_batch)
+        ex.cancel_event = cancel_event
         run_init_plans(ex, plan)
         root = plan.root
         schema = Schema([(f.name, f.type) for f in root.fields])
@@ -335,11 +343,12 @@ class LocalRunner:
         return schema, iter(out)
 
     def _ctas(self, stmt: A.CreateTableAsSelect, session=None,
-              user: str = "") -> QueryResult:
+              user: str = "", cancel_event=None) -> QueryResult:
         conn, table = self._writable(stmt.name, user)
         # the source query plans against the SECURED per-query session:
         # INSERT ... SELECT must not read catalogs the user cannot SELECT
-        schema, batches = self._run_to_batches(stmt.query, session)
+        schema, batches = self._run_to_batches(stmt.query, session,
+                                               cancel_event=cancel_event)
         if table in conn.tables and stmt.if_not_exists:
             return QueryResult(["rows"], [T.BIGINT], [(0,)])
         props = dict(getattr(stmt, "properties", ()) or ())
@@ -360,9 +369,10 @@ class LocalRunner:
         return QueryResult(["rows"], [T.BIGINT], [(n,)])
 
     def _insert(self, stmt: A.InsertInto, session=None,
-                user: str = "") -> QueryResult:
+                user: str = "", cancel_event=None) -> QueryResult:
         conn, table = self._writable(stmt.name, user)
-        schema, batches = self._run_to_batches(stmt.query, session)
+        schema, batches = self._run_to_batches(stmt.query, session,
+                                               cancel_event=cancel_event)
         n = 0
         for b in batches:
             n += conn.append(table, Batch(schema, b.columns, b.row_mask))

@@ -71,6 +71,10 @@ class _Query:
         self._next_token = 0
         self._last_page: Optional[Tuple[int, Optional[List]]] = None
         self._page_lock = threading.Lock()
+        # guards state transitions: cancel() and the producer thread race,
+        # and FAILED must never become FINISHED (the reference's
+        # QueryStateMachine rejects transitions out of terminal states)
+        self._state_lock = threading.Lock()
         self._cancelled = threading.Event()
         self._runner = runner
         self._overrides = session_overrides
@@ -92,7 +96,7 @@ class _Query:
             try:
                 res = self._runner.execute(
                     self.sql, properties=dict(self._overrides),
-                    user=self.user)
+                    user=self.user, cancel_event=self._cancelled)
             finally:
                 if self._admission is not None:
                     self._admission.release()
@@ -116,15 +120,22 @@ class _Query:
                 page = [[_json_value(v) for v in r]
                         for r in rows[i:i + ROWS_PER_PAGE]]
                 self._put_page(page)
-            self.state = "FINISHED"
+            # a cancel that raced completion must keep the FAILED/
+            # USER_CANCELED verdict set by cancel() (the reference's
+            # QueryStateMachine refuses FAILED->FINISHED transitions)
+            with self._state_lock:
+                if not self._cancelled.is_set():
+                    self.state = "FINISHED"
         except Exception as e:  # surfaced as QueryError, not a 500
-            self.state = "FAILED"
-            self.error = {
-                "message": str(e),
-                "errorCode": 1,
-                "errorName": type(e).__name__,
-                "errorType": "USER_ERROR",
-            }
+            with self._state_lock:
+                if not self._cancelled.is_set():
+                    self.state = "FAILED"
+                    self.error = {
+                        "message": str(e),
+                        "errorCode": 1,
+                        "errorName": type(e).__name__,
+                        "errorType": "USER_ERROR",
+                    }
             self._put_page(None)
         self._put_page(None)          # end-of-stream sentinel
 
@@ -163,11 +174,12 @@ class _Query:
             return page
 
     def cancel(self) -> None:
-        self._cancelled.set()
-        self.state = "FAILED"
-        self.error = {"message": "Query was canceled", "errorCode": 1,
-                      "errorName": "USER_CANCELED",
-                      "errorType": "USER_ERROR"}
+        with self._state_lock:
+            self._cancelled.set()
+            self.state = "FAILED"
+            self.error = {"message": "Query was canceled", "errorCode": 1,
+                          "errorName": "USER_CANCELED",
+                          "errorType": "USER_ERROR"}
         while True:                   # unblock/starve the producer
             try:
                 self._pages.get_nowait()

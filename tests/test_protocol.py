@@ -79,11 +79,70 @@ def test_raw_protocol_shape(server):
     assert doc2["data"] == [[1]]
 
 
-def test_cancel(server, client):
-    doc = StatementClient(f"http://127.0.0.1:{server.port}")
-    pages = doc.pages("select count(*) from lineitem")
-    first = next(pages)
-    req = urllib.request.Request(first["nextUri"], method="DELETE")
-    urllib.request.urlopen(req)
-    q = server.queries[first["id"]]
-    assert q.state == "FAILED"
+def test_cancel():
+    """DELETE-cancel must interrupt a RUNNING query, not just mark state:
+    the scan below is deterministically slow (>= 4s of per-batch delays),
+    so the cancel always lands mid-execution, and the executor's per-batch
+    cancel check (exec/local.py _check_cancel) must stop the producer
+    thread long before the scan could finish (reference
+    dispatcher/DispatchManager.java:134 cancel semantics)."""
+    import time
+
+    from presto_tpu.connectors.spi import CatalogManager
+    from presto_tpu.connectors.tpch import TpchConnector
+
+    class _SlowConnector:
+        def __init__(self, inner, delay_s):
+            self._inner = inner
+            self.name = inner.name
+            self.delay_s = delay_s
+
+        @property
+        def metadata(self):
+            return self._inner.metadata
+
+        @property
+        def split_manager(self):
+            return self._inner.split_manager
+
+        def page_source(self, split, columns, pushdown=None,
+                        rows_per_batch=1 << 17):
+            inner = self._inner.page_source(
+                split, columns, pushdown=pushdown,
+                rows_per_batch=rows_per_batch)
+            delay = self.delay_s
+
+            class _PS:
+                def batches(self):
+                    for b in inner.batches():
+                        time.sleep(delay)
+                        yield b
+            return _PS()
+
+    catalogs = CatalogManager()
+    catalogs.register("tpch", _SlowConnector(TpchConnector(sf=0.001), 0.05))
+    srv = PrestoTpuServer(LocalRunner(catalogs=catalogs,
+                                      rows_per_batch=64))
+    srv.start()
+    try:
+        doc = StatementClient(f"http://127.0.0.1:{srv.port}")
+        pages = doc.pages("select count(*) from lineitem")
+        first = next(pages)
+        q = srv.queries[first["id"]]
+        deadline = time.time() + 10
+        while q.state == "QUEUED" and time.time() < deadline:
+            time.sleep(0.01)
+        assert q.state == "RUNNING"      # slow scan: cancel lands mid-run
+        t0 = time.time()
+        req = urllib.request.Request(first["nextUri"], method="DELETE")
+        urllib.request.urlopen(req)
+        assert q.state == "FAILED"
+        assert q.error["errorName"] == "USER_CANCELED"
+        # the producer must be interrupted promptly: the remaining scan
+        # alone would take seconds of injected delay
+        q._thread.join(timeout=3.0)
+        assert not q._thread.is_alive()
+        assert time.time() - t0 < 3.0
+        assert q.state == "FAILED"       # completion must not overwrite
+    finally:
+        srv.stop()
