@@ -42,13 +42,7 @@ from ..planner.plan import (
 from ..planner.planner import InitPlanRef, LogicalPlan, Session
 
 
-def bool_property(session: Session, name: str, default: bool) -> bool:
-    """Session properties arrive as strings from SET SESSION / HTTP
-    headers; parse the usual spellings instead of trusting truthiness."""
-    v = session.properties.get(name, default)
-    if isinstance(v, str):
-        return v.strip().lower() not in ("false", "0", "off", "no", "")
-    return bool(v)
+from ..planner.planner import bool_property  # noqa: F401 (re-export)
 
 
 @dataclasses.dataclass
@@ -125,27 +119,6 @@ def _plan_schema(node: PlanNode) -> Schema:
 
 _DYN_TYPES = (T.BigintType, T.IntegerType, T.SmallintType, T.TinyintType,
               T.DateType)
-
-
-def _dynamic_bounds(build: Batch, build_keys: Sequence[int],
-                    probe_keys: Sequence[int]
-                    ) -> List[Tuple[int, int, int]]:
-    """Build-side [min, max] per integer-like join key (one host sync;
-    the build side is already fully drained when this runs). Returns
-    [(probe_key_index, lo, hi), ...]."""
-    import numpy as np
-    out: List[Tuple[int, int, int]] = []
-    mask = np.asarray(build.row_mask)
-    for bk, pk in zip(build_keys, probe_keys):
-        col = build.columns[bk]
-        if not isinstance(col.type, _DYN_TYPES):
-            continue
-        live = mask & np.asarray(col.validity)
-        if not live.any():
-            continue
-        data = np.asarray(col.data)[live]
-        out.append((pk, int(data.min()), int(data.max())))
-    return out
 
 
 def _apply_dynamic_bounds(probe: Batch,
@@ -523,13 +496,17 @@ class _Executor:
         (reference operator/project/PageProcessor.java). Selective
         filters/joins leave mostly-dead lanes, and every downstream
         sort-based kernel pays for capacity, not liveness. Checks batches
-        >16K capacity; after the first batch that doesn't shrink >=4x it
+        >128K capacity; after the first batch that doesn't shrink >=4x it
         stops checking (selectivity is near-uniform across an operator's
         batches), so a non-selective stream pays exactly one sync."""
         state = {"check": self.compact_streams}
 
         def maybe_compact(b: Batch) -> Batch:
-            if not state["check"] or b.capacity <= (1 << 14):
+            # the 2^17 floor: below it, downstream kernels over the
+            # uncompacted capacity cost less than the ~100ms tunnel RTT
+            # of the liveness readback (measured: sub-128K operators were
+            # paying 10x their kernel time in compaction syncs)
+            if not state["check"] or b.capacity <= (1 << 17):
                 return b
             tgt = bucket_capacity(b.host_count())
             if tgt * 4 <= b.capacity:
@@ -815,11 +792,17 @@ class _Executor:
                     probe_stream())
                 return
             dyn = None
-            if (node.join_type == "inner" and build is not None
+            summary = None
+            if build is not None:
+                # ONE fused readback for live count + per-key bounds: the
+                # tunneled backend pays a full RTT per sync, so the
+                # compaction size, direct-table bounds, and dynamic-filter
+                # bounds all come from the same device reduction
+                summary = self._build_summary(build, node.right_keys)
+            if (node.join_type == "inner" and summary is not None
                     and bool_property(self.session,
                                       "enable_dynamic_filtering", True)):
-                dyn = _dynamic_bounds(build, node.right_keys,
-                                      node.left_keys)
+                dyn = self._summary_bounds(summary, node.left_keys)
                 if dyn:
                     self._push_dynamic_bounds(node.left, dyn)
             compact = self._compactor()
@@ -830,11 +813,12 @@ class _Executor:
                 # binary searches walk a table sized by CAPACITY, so a
                 # 10%-live build would cost 10x the gathers it needs
                 # (reference PagesIndex compacts build pages the same way)
-                scap = bucket_capacity(max(build.host_count(), 1))
+                scap = bucket_capacity(max(int(summary[0]), 1))
                 if scap < build.capacity:
                     from ..ops.jitcache import compact_jit
                     build = compact_jit(build, scap)
-            prep = (self._prepare_join_build(build, node.right_keys)
+            prep = (self._prepare_join_build(build, node.right_keys,
+                                             summary=summary)
                     if build is not None else None)
             for probe in probe_stream():
                 if build is None:
@@ -988,26 +972,47 @@ class _Executor:
     #: to the composite binary search
     DIRECT_SPAN_LIMIT = 1 << 26
 
-    def _prepare_join_build(self, build: Batch, keys):
+    def _build_summary(self, build: Batch, keys):
+        """Host copy of the fused build reduction: [live_count,
+        lo_0, hi_0, lo_1, hi_1, ...] over the given key columns (one
+        readback; see ops/jitcache.py build_summary_jit)."""
+        import numpy as np
+
+        from ..ops.jitcache import build_summary_jit
+        int_flags = tuple(isinstance(build.columns[k].type, _DYN_TYPES)
+                          for k in keys)
+        return np.asarray(build_summary_jit(build, tuple(keys), int_flags))
+
+    @staticmethod
+    def _summary_bounds(summary, out_keys):
+        """[(out_key, lo, hi), ...] for the integer keys in a summary
+        (non-integer keys carry the (0, -1) empty sentinel)."""
+        out = []
+        for i, pk in enumerate(out_keys):
+            lo, hi = int(summary[1 + 2 * i]), int(summary[2 + 2 * i])
+            if lo <= hi:
+                out.append((pk, lo, hi))
+        return out
+
+    def _prepare_join_build(self, build: Batch, keys, summary=None):
         """LookupSource choice (reference HashBuilderOperator's
         BigintGroupByHash-vs-MultiChannel split): a single integer key
         with a bounded host-known range gets a direct-address table —
         O(1) gathers per probe lane on hardware where random gathers
         dominate join cost; anything else gets the sorted composite
-        search."""
-        import numpy as np
+        search. Key bounds come from the caller's fused build summary
+        (no extra sync)."""
         keys = tuple(keys)
-        if len(keys) == 1:
-            c = build.columns[keys[0]]
-            if isinstance(c.type, _DYN_TYPES):
-                live = np.asarray(build.row_mask) & np.asarray(c.validity)
-                if live.any():
-                    data = np.asarray(c.data)[live]
-                    lo, hi = int(data.min()), int(data.max())
-                    span = hi - lo + 1
-                    if 0 < span <= self.DIRECT_SPAN_LIMIT:
-                        return prepare_direct_jit(
-                            build, keys, lo, bucket_capacity(span))
+        if len(keys) == 1 and isinstance(build.columns[keys[0]].type,
+                                         _DYN_TYPES):
+            if summary is None:
+                summary = self._build_summary(build, keys)
+            if int(summary[0]) > 0:
+                lo, hi = int(summary[1]), int(summary[2])
+                span = hi - lo + 1
+                if 0 < span <= self.DIRECT_SPAN_LIMIT:
+                    return prepare_direct_jit(
+                        build, keys, lo, bucket_capacity(span))
         return prepare_build_jit(build, keys)
 
     def _probe_batches(self, node: JoinNode, probe: Batch, build: Batch,

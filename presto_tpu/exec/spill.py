@@ -278,15 +278,20 @@ class SpillableBuildBuffer:
         self.spilled = False
 
     def add(self, b: Batch) -> None:
-        if self.spilled:
-            self._stage(b)
-            return
-        nb = batch_device_bytes(b)
-        if self.ctx.pool.try_reserve(nb, self.ctx):
-            self.device.append(b)
-        else:
-            self.ctx.revoke()   # spills everything accumulated so far
-            self._stage(b)
+        # pool lock: the pool's revoke path calls _spill_all from OTHER
+        # threads (build drain on the main thread vs probe-prefetch); an
+        # unsynchronized revoke both stages and leaves batches visible to
+        # a concurrent consumer — duplicated rows
+        with self.ctx.pool.lock:
+            if self.spilled:
+                self._stage(b)
+                return
+            nb = batch_device_bytes(b)
+            if self.ctx.pool.try_reserve(nb, self.ctx):
+                self.device.append(b)
+            else:
+                self.ctx.revoke()  # spills everything accumulated so far
+                self._stage(b)
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:
@@ -307,13 +312,14 @@ class SpillableBuildBuffer:
     def finish(self):
         # once the build is handed to the prober, revoking can no longer
         # free its device memory — keep the reservation, end revocability
-        self.ctx.pin()
-        if self.spilled:
-            return self.store
-        if not self.device:
-            return None
-        return (self.device[0] if len(self.device) == 1
-                else concat_batches(self.device))
+        with self.ctx.pool.lock:
+            self.ctx.pin()
+            if self.spilled:
+                return self.store
+            if not self.device:
+                return None
+            return (self.device[0] if len(self.device) == 1
+                    else concat_batches(self.device))
 
     def close(self) -> None:
         self.ctx.close()
@@ -342,17 +348,20 @@ class AggSpillBuffer:
         self.spilled = False
 
     def add_partial(self, partial: Batch) -> None:
-        if self.spilled:
-            self._stage(partial)
-            return
-        nb = batch_device_bytes(partial)
-        if self.ctx.pool.try_reserve(nb, self.ctx):
-            self.device.append(partial)
-            if len(self.device) >= self.merge_every:
-                self._merge_device()
-        else:
-            self.ctx.revoke()
-            self._stage(partial)
+        # pool lock: revoke callbacks (_spill_all) arrive from other
+        # threads mid-merge; see SpillableBuildBuffer.add
+        with self.ctx.pool.lock:
+            if self.spilled:
+                self._stage(partial)
+                return
+            nb = batch_device_bytes(partial)
+            if self.ctx.pool.try_reserve(nb, self.ctx):
+                self.device.append(partial)
+                if len(self.device) >= self.merge_every:
+                    self._merge_device()
+            else:
+                self.ctx.revoke()
+                self._stage(partial)
 
     def _merge_device(self) -> None:
         merged = grouped_aggregate(concat_batches(self.device),
@@ -386,12 +395,17 @@ class AggSpillBuffer:
         """Final rows (default) or merged partial states (``final=False``,
         the PARTIAL-step output shipped to a downstream exchange)."""
         mode = "final" if final else "merge"
-        self.ctx.pin()   # consumers hold the yielded state from here on
-        if not self.spilled:
-            if not self.device:
+        with self.ctx.pool.lock:
+            # consumers hold the yielded state from here on; snapshot the
+            # device list under the lock so a late revoke can't re-stage
+            # what we are about to yield
+            self.ctx.pin()
+            spilled, device = self.spilled, list(self.device)
+        if not spilled:
+            if not device:
                 return
-            states = (self.device[0] if len(self.device) == 1
-                      else concat_batches(self.device))
+            states = (device[0] if len(device) == 1
+                      else concat_batches(device))
             yield grouped_aggregate(states, self.key_idx, self.aggs,
                                     mode=mode)
             return
@@ -426,16 +440,19 @@ class SortSpillBuffer:
         self.spilled = False
 
     def add(self, b: Batch) -> None:
-        self.schema = b.schema
-        if self.spilled:
-            self._stage(b)
-            return
-        nb = batch_device_bytes(b)
-        if self.ctx.pool.try_reserve(nb, self.ctx):
-            self.device.append(b)
-        else:
-            self.ctx.revoke()
-            self._stage(b)
+        # pool lock: cross-thread revoke callbacks; see
+        # SpillableBuildBuffer.add
+        with self.ctx.pool.lock:
+            self.schema = b.schema
+            if self.spilled:
+                self._stage(b)
+                return
+            nb = batch_device_bytes(b)
+            if self.ctx.pool.try_reserve(nb, self.ctx):
+                self.device.append(b)
+            else:
+                self.ctx.revoke()
+                self._stage(b)
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:
@@ -456,12 +473,14 @@ class SortSpillBuffer:
         return freed
 
     def results(self, rows_per_batch: int) -> Iterator[Batch]:
-        self.ctx.pin()
-        if not self.spilled:
-            if not self.device:
+        with self.ctx.pool.lock:
+            self.ctx.pin()
+            spilled, device = self.spilled, list(self.device)
+        if not spilled:
+            if not device:
                 return
-            merged = (self.device[0] if len(self.device) == 1
-                      else concat_batches(self.device))
+            merged = (device[0] if len(device) == 1
+                      else concat_batches(device))
             yield sort_batch(merged, self.keys)
             return
         yield from self._host_sorted(rows_per_batch)

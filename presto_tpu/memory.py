@@ -19,6 +19,7 @@ execution/MemoryRevokingScheduler.java:46) re-shaped for a device runtime:
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Callable, List, Optional
 
 UNLIMITED = 1 << 62
@@ -61,6 +62,15 @@ class QueryMemoryPool:
         self.reserved = 0
         self.stats = MemoryStats()
         self._contexts: List["OperatorMemoryContext"] = []
+        # one re-entrant lock serializes pool accounting AND the spill
+        # buffers' state transitions: a build side draining on the main
+        # thread can trigger revoke callbacks into buffers owned by the
+        # probe-prefetch thread (exec/local.py probe_prefetch), and an
+        # unsynchronized revoke double-stages batches a concurrent merge
+        # is also consuming (observed as duplicated aggregate inputs).
+        # Re-entrant because a buffer's reserve under the lock can revoke
+        # the same thread's other buffers.
+        self.lock = threading.RLock()
 
     def context(self, name: str,
                 revoke_cb: Optional[Callable[[], int]] = None
@@ -72,16 +82,18 @@ class QueryMemoryPool:
     def try_reserve(self, n: int, ctx: "OperatorMemoryContext") -> bool:
         """Reserve n bytes for ctx; revokes other revocable contexts
         (largest first) if needed. False = caller must spill itself."""
-        if n > self.limit:
-            return False  # can never fit: don't force futile spills
-        if self.reserved + n > self.limit:
-            self._revoke_others(self.reserved + n - self.limit, ctx)
-        if self.reserved + n > self.limit:
-            return False
-        self.reserved += n
-        ctx.bytes += n
-        self.stats.peak_bytes = max(self.stats.peak_bytes, self.reserved)
-        return True
+        with self.lock:
+            if n > self.limit:
+                return False  # can never fit: don't force futile spills
+            if self.reserved + n > self.limit:
+                self._revoke_others(self.reserved + n - self.limit, ctx)
+            if self.reserved + n > self.limit:
+                return False
+            self.reserved += n
+            ctx.bytes += n
+            self.stats.peak_bytes = max(self.stats.peak_bytes,
+                                        self.reserved)
+            return True
 
     def reserve(self, n: int, ctx: "OperatorMemoryContext") -> None:
         """Like try_reserve but raising — for state that cannot spill."""
@@ -133,15 +145,18 @@ class OperatorMemoryContext:
         # spilled-byte accounting happens at the staging site (the buffer
         # knows what it moved to host), not here — a revoke that finds an
         # empty buffer frees nothing yet later adds still stage
-        freed = self._revoke_cb() if self._revoke_cb is not None else 0
-        self.release_all()
-        return freed
+        with self.pool.lock:
+            freed = self._revoke_cb() if self._revoke_cb is not None else 0
+            self.release_all()
+            return freed
 
     def release_all(self) -> None:
-        self.pool.reserved -= self.bytes
-        self.bytes = 0
+        with self.pool.lock:
+            self.pool.reserved -= self.bytes
+            self.bytes = 0
 
     def close(self) -> None:
-        self.release_all()
-        if self in self.pool._contexts:
-            self.pool._contexts.remove(self)
+        with self.pool.lock:
+            self.release_all()
+            if self in self.pool._contexts:
+                self.pool._contexts.remove(self)

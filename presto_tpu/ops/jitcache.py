@@ -159,3 +159,35 @@ def _prepare_direct(key_cols, size):
 
 def prepare_direct_jit(build, key_cols, lo0, size: int):
     return _prepare_direct(tuple(key_cols), size)(build, lo0)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_summary(key_cols, int_flags):
+    import jax.numpy as jnp
+
+    def run(b):
+        live = b.row_mask
+        out = [jnp.sum(live.astype(jnp.int64))]
+        for k, is_int in zip(key_cols, int_flags):
+            if not is_int:
+                out += [jnp.int64(0), jnp.int64(-1)]
+                continue
+            c = b.columns[k]
+            ok = live & c.validity
+            data = c.data.astype(jnp.int64)
+            out.append(jnp.min(jnp.where(ok, data,
+                                         jnp.iinfo(jnp.int64).max)))
+            out.append(jnp.max(jnp.where(ok, data,
+                                         jnp.iinfo(jnp.int64).min)))
+        return jnp.stack(out)
+    return jax.jit(run)
+
+
+def build_summary_jit(build, key_cols, int_flags):
+    """One fused device reduction for everything the executor needs to
+    know about a drained join build: [live_count, (lo, hi) per key].
+    Non-integer keys report (0, -1). The caller reads it back ONCE — on
+    the tunneled backend every separate readback costs a full RTT plus a
+    flush of queued async work, and the previous code paid three (live
+    count, direct-table bounds, dynamic-filter bounds)."""
+    return _build_summary(tuple(key_cols), tuple(int_flags))(build)

@@ -1,0 +1,289 @@
+"""Direct-address (scatter) grouped aggregation for bounded integer keys.
+
+The TPU-native analogue of the reference's dense-integer group-by fast
+path (reference presto-main/.../operator/BigintGroupByHash.java: when a
+single BIGINT key fits a bounded range, group ids come from the value
+itself and the hash table degenerates to an array). Here the "array" is
+the scatter target of ``jax.ops.segment_sum``: slot = key - lo.
+
+Why this exists (measured on v5e, 67M rows -> 16.8M segments):
+
+- ``segment_sum`` over f64/i64 runs ~8.6s (both are double-wide
+  emulations on this chip), while the identical scatter over f32/i32
+  runs ~0.6-0.8s — a 14x cliff at the 32-bit boundary.
+- The sort-based path (ops/aggregation.py) pays a large-operand
+  ``lax.sort`` plus permutation gathers; for a key that is already a
+  bounded integer the scatter path skips both.
+
+So exact 64-bit sums are computed as a few 32-bit scatters: split each
+value into base-2^w digits with w chosen so a segment's digit-sum cannot
+exceed 2^31 (i32 exactness), segment-sum each digit in i32, and
+recombine the per-segment digit sums in i64. The caller supplies
+``max_rows_per_segment`` (e.g. a join-key multiplicity bound, or the
+batch row count) and the value bit-width; both are host-static so the
+digit plan compiles into the kernel.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..batch import Batch, Column, Schema, bucket_capacity
+from .aggregation import AggSpec
+
+
+def _digit_plan(value_bits: int, max_rows_per_segment: int):
+    """(width, n_digits): i32 digit sums stay < 2^31 exactly."""
+    head = max(int(math.ceil(math.log2(max(max_rows_per_segment, 1) + 1))),
+               0)
+    w = 31 - head
+    if w <= 0:
+        raise ValueError(
+            f"max_rows_per_segment={max_rows_per_segment} leaves no i32 "
+            "digit headroom; use the sort-based aggregation path")
+    return w, max(int(math.ceil(value_bits / w)), 1)
+
+
+def segment_sum_exact(values: jnp.ndarray, seg: jnp.ndarray,
+                      num_segments: int, max_rows_per_segment: int,
+                      value_bits: int = 62,
+                      indices_are_sorted: bool = False) -> jnp.ndarray:
+    """Exact i64 segment sums of non-negative i64 values via i32 digit
+    scatters. ``value_bits`` bounds each value (< 2^value_bits);
+    ``value_bits + log2(max_rows_per_segment)`` must stay < 63."""
+    values = values.astype(jnp.int64)
+    w, d = _digit_plan(value_bits, max_rows_per_segment)
+    out = jnp.zeros(num_segments, dtype=jnp.int64)
+    mask = jnp.int64((1 << w) - 1)
+    for k in range(d):
+        digit = ((values >> (k * w)) & mask).astype(jnp.int32)
+        s = jax.ops.segment_sum(digit, seg, num_segments=num_segments,
+                                indices_are_sorted=indices_are_sorted)
+        out = out + (s.astype(jnp.int64) << (k * w))
+    return out
+
+
+def segment_count(seg: jnp.ndarray, live: jnp.ndarray, num_segments: int,
+                  indices_are_sorted: bool = False) -> jnp.ndarray:
+    """Per-segment live-row counts via one i32 scatter (counts < 2^31)."""
+    ones = jnp.where(live, jnp.int32(1), jnp.int32(0))
+    c = jax.ops.segment_sum(ones, seg, num_segments=num_segments,
+                            indices_are_sorted=indices_are_sorted)
+    return c.astype(jnp.int64)
+
+
+def _as_int_data(col: Column):
+    """(int64 data, value_bits, scale, is_float) for a column whose values
+    are exactly representable as scaled integers on the scatter path:
+    ints/dates/decimals directly; bools as 0/1. Returns None for float or
+    string columns (those stay on the sort path)."""
+    t = col.type
+    if isinstance(t, T.DecimalType):
+        return col.data.astype(jnp.int64), 63, None, False
+    if col.data.dtype == jnp.bool_:
+        return col.data.astype(jnp.int64), 1, None, False
+    if jnp.issubdtype(col.data.dtype, jnp.integer):
+        bits = min(jnp.iinfo(col.data.dtype).bits, 62)
+        return col.data.astype(jnp.int64), bits, None, False
+    return None
+
+
+def supported_direct(aggs: Sequence[AggSpec], batch: Batch) -> bool:
+    """True when every aggregate fits the scatter path: sum/avg/count over
+    integer-like inputs, count_star, min/max over 32-bit-safe ints."""
+    for a in aggs:
+        if a.fn == "count_star" or a.fn == "count":
+            continue
+        if a.fn not in ("sum", "avg", "min", "max"):
+            return False
+        c = batch.columns[a.input]
+        if a.fn in ("min", "max"):
+            if c.dictionary is not None:
+                return False
+            if not (jnp.issubdtype(c.data.dtype, jnp.integer)
+                    or c.data.dtype == jnp.bool_):
+                return False
+            continue
+        if _as_int_data(c) is None:
+            return False
+    return True
+
+
+def grouped_aggregate_direct(
+    batch: Batch,
+    key_index: int,
+    lo: int,
+    span: int,
+    aggs: Sequence[AggSpec],
+    mode: str = "partial",
+    max_group_rows: Optional[int] = None,
+    sorted_keys: bool = False,
+    liveness: str = "counts",
+    nonnegative: bool = False,
+) -> Batch:
+    """Group by ONE integer key with host-known bounds [lo, lo+span) via
+    direct-address scatters; no sort, no boundary pass.
+
+    Output rows sit at slot (key - lo); slot ``span`` collects NULL-key
+    rows (SQL GROUP BY treats NULL as a group). Capacity is
+    bucket_capacity(span + 1); slots beyond the live domain are dead.
+
+    mode 'partial' emits the same state-column layout as
+    ops.aggregation.grouped_aggregate (states are ordinary columns, so
+    merge/final interoperate); mode 'single' emits finalized outputs.
+
+    ``liveness='skip'`` omits the count scatter that marks which slots
+    saw rows — every in-span slot is emitted live with additive
+    identities (sum 0 / count 0) for untouched groups. Only callers that
+    post-filter groups (e.g. a bench top-n over sum>0) may use it.
+    ``nonnegative=True`` asserts every summed value is >= 0, halving the
+    scatter count (signed data otherwise scatters positive and negative
+    magnitudes separately).
+    """
+    assert mode in ("partial", "single")
+    key_col = batch.columns[key_index]
+    n_rows = batch.capacity
+    max_rows = max_group_rows if max_group_rows is not None else n_rows
+    cap = bucket_capacity(span + 1)
+    live_row = batch.row_mask
+    kvalid = key_col.validity
+    key = key_col.data.astype(jnp.int64)
+    in_span = (key >= lo) & (key < lo + span)
+    # dead rows and (defensively) out-of-span keys go to a trash slot
+    # past the null group; they must not pollute slot sums
+    slot = jnp.where(live_row & kvalid & in_span, key - lo,
+                     jnp.where(live_row & ~kvalid, span, cap))
+    slot = slot.astype(jnp.int32)
+
+    cnt_star = None
+    if liveness != "skip" or any(a.fn == "count_star" for a in aggs):
+        cnt_star = segment_count(slot, live_row, cap,
+                                 indices_are_sorted=sorted_keys)
+
+    out_cols: List[Column] = []
+    out_fields: List = []
+    if cnt_star is not None:
+        slot_live = cnt_star > 0
+    else:
+        slot_live = jnp.ones(cap, dtype=bool)
+    out_mask = slot_live & (jnp.arange(cap) <= span)
+
+    # key column: slot index decodes straight back to the key value
+    key_data = (jnp.arange(cap, dtype=jnp.int64) + lo).astype(
+        key_col.data.dtype)
+    key_valid = out_mask & (jnp.arange(cap) < span)
+    out_fields.append((batch.schema.names[key_index], key_col.type))
+    out_cols.append(Column(key_col.type, key_data, key_valid,
+                           key_col.dictionary))
+
+    for agg in aggs:
+        base = agg.name or agg.fn
+        if agg.fn == "count_star":
+            cnt = cnt_star
+            if mode == "partial":
+                out_fields.append((f"{base}$cnt", T.BIGINT))
+                out_cols.append(Column(T.BIGINT, cnt, out_mask, None))
+            else:
+                out_fields.append((base, agg.output_type))
+                out_cols.append(Column(agg.output_type, cnt, out_mask,
+                                       None))
+            continue
+        c = batch.columns[agg.input]
+        valid = c.validity & live_row
+        if agg.mask is not None:
+            valid = valid & batch.columns[agg.mask].data.astype(bool)
+        if agg.fn in ("count",):
+            cnt = segment_count(slot, valid, cap,
+                                indices_are_sorted=sorted_keys)
+            name = f"{base}$cnt" if mode == "partial" else base
+            out_fields.append((name, T.BIGINT if mode == "partial"
+                               else agg.output_type))
+            out_cols.append(Column(T.BIGINT, cnt, out_mask, None))
+            continue
+        if agg.fn in ("min", "max"):
+            if c.data.dtype == jnp.bool_:
+                use32 = True
+            else:
+                use32 = jnp.iinfo(c.data.dtype).bits <= 32
+            dt = jnp.int32 if use32 else jnp.int64
+            x = c.data.astype(dt)
+            if agg.fn == "min":
+                sent = jnp.iinfo(dt).max
+                r = jax.ops.segment_min(
+                    jnp.where(valid, x, sent), slot, num_segments=cap,
+                    indices_are_sorted=sorted_keys)
+            else:
+                sent = jnp.iinfo(dt).min
+                r = jax.ops.segment_max(
+                    jnp.where(valid, x, sent), slot, num_segments=cap,
+                    indices_are_sorted=sorted_keys)
+            cnt = segment_count(slot, valid, cap,
+                                indices_are_sorted=sorted_keys)
+            val = r.astype(c.data.dtype)
+            if mode == "partial":
+                out_fields += [(f"{base}$val", c.type),
+                               (f"{base}$cnt", T.BIGINT)]
+                out_cols += [Column(c.type, val, out_mask & (cnt > 0),
+                                    None),
+                             Column(T.BIGINT, cnt, out_mask, None)]
+            else:
+                out_fields.append((base, agg.output_type))
+                out_cols.append(Column(agg.output_type, val,
+                                       out_mask & (cnt > 0), None))
+            continue
+        # sum / avg over integer-like data
+        conv = _as_int_data(c)
+        assert conv is not None, \
+            f"direct path requires integer-like input for {agg.fn}"
+        data, bits, _, _ = conv
+        cnt = segment_count(slot, valid, cap,
+                            indices_are_sorted=sorted_keys)
+        if nonnegative:
+            vals = jnp.where(valid, data, 0)
+            s = segment_sum_exact(vals, slot, cap, max_rows,
+                                  value_bits=bits,
+                                  indices_are_sorted=sorted_keys)
+        else:
+            # signed inputs: scatter positive and negative magnitudes
+            # separately (the digit split needs non-negative values; a
+            # bias term would overflow i64 for wide types)
+            pos = jnp.where(valid, jnp.maximum(data, 0), 0)
+            neg = jnp.where(valid, jnp.maximum(-data, 0), 0)
+            s = (segment_sum_exact(pos, slot, cap, max_rows,
+                                   value_bits=bits,
+                                   indices_are_sorted=sorted_keys)
+                 - segment_sum_exact(neg, slot, cap, max_rows,
+                                     value_bits=bits,
+                                     indices_are_sorted=sorted_keys))
+        if mode == "partial":
+            st = agg.state_types()
+            out_fields += [(st[0][0], st[0][1]), (st[1][0], T.BIGINT)]
+            sum_t = st[0][1]
+            out_cols += [Column(sum_t, s.astype(sum_t.storage_dtype),
+                                out_mask & (cnt > 0), None),
+                         Column(T.BIGINT, cnt, out_mask, None)]
+        elif agg.fn == "sum":
+            out_fields.append((base, agg.output_type))
+            out_cols.append(Column(
+                agg.output_type, s.astype(agg.output_type.storage_dtype),
+                out_mask & (cnt > 0), None))
+        else:  # avg
+            out_fields.append((base, agg.output_type))
+            if isinstance(agg.output_type, T.DecimalType):
+                den = jnp.maximum(cnt, 1)
+                q = s.astype(jnp.float64) / den
+                out = (jnp.sign(q) * jnp.floor(
+                    jnp.abs(s).astype(jnp.float64) / den + 0.5)
+                ).astype(jnp.int64)
+            else:
+                out = s.astype(jnp.float64) / jnp.maximum(
+                    cnt, 1).astype(jnp.float64)
+            out_cols.append(Column(
+                agg.output_type, out.astype(
+                    agg.output_type.storage_dtype),
+                out_mask & (cnt > 0), None))
+    return Batch(Schema(out_fields), out_cols, out_mask)

@@ -137,6 +137,20 @@ class _Fragmenter:
         child, loc = self.visit(node.child)
         if loc in ("single", "any"):
             return dataclasses.replace(node, child=child), loc
+        if node.step == "partial":
+            # already split by the optimizer (partial-agg pushed through a
+            # join): states merge downstream, leave it in place
+            return dataclasses.replace(node, child=child), loc
+        if node.step == "final":
+            # pre-split final: hash-exchange the states by group key and
+            # finalize in a fixed stage (global finals gather to one task)
+            if node.group_indices:
+                src = self.cut(child, loc,
+                               OutputSpec("partition",
+                                          tuple(node.group_indices)))
+                return dataclasses.replace(node, child=src), "fixed"
+            src = self.cut(child, loc, OutputSpec("single"))
+            return dataclasses.replace(node, child=src), "single"
         if any(a.fn in _DRAIN_FNS for a in node.aggs):
             # drain-only aggregates (approx_percentile) have no mergeable
             # partial state: ship raw rows to one task and aggregate there

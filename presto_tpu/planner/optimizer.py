@@ -33,7 +33,7 @@ from .plan import (
     OutputNode, PlanNode, ProjectNode, SemiJoinNode, SortNode,
     TableScanNode, TopNNode, UnionNode, ValuesNode,
 )
-from .planner import LogicalPlan, Session
+from .planner import LogicalPlan, Session, bool_property
 
 BROADCAST_ROW_LIMIT = 2_000_000
 
@@ -49,6 +49,9 @@ def optimize(plan: LogicalPlan, session: Session) -> LogicalPlan:
         node = _rewrite_joins(node, session)
         node, _ = _prune(node, list(range(len(node.fields))))
         node = _implement_joins(node, session)
+        if bool_property(session, "push_partial_aggregation_through_join",
+                         True):
+            node = _push_partial_agg_through_join(node)
         return _attach_scan_pushdown(node)
     root = pipeline(plan.root)
     init = [pipeline(p) for p in plan.init_plans]
@@ -742,3 +745,165 @@ def _distribution(build: PlanNode, rows: float, session: Session) -> str:
     limit = session.properties.get("broadcast_join_row_limit",
                                    BROADCAST_ROW_LIMIT)
     return "replicated" if rows <= limit else "partitioned"
+
+
+# ---------------------------------------------------------------------------
+# Pass 4: eager aggregation — partial agg pushed through an inner join
+# ---------------------------------------------------------------------------
+
+#: aggregate functions with mergeable partial states the push understands
+_PUSHABLE_AGG_FNS = ("sum", "count", "count_star", "min", "max", "avg")
+
+
+def _push_partial_agg_through_join(node: PlanNode) -> PlanNode:
+    """Rewrite Agg(Project*(Join(L, R))) into
+    Final(Project(Join(Partial(Project(L)), R))) when every aggregate
+    input comes from the probe (left) side — the reference's
+    iterative/rule/PushPartialAggregationThroughJoin.java (+ the
+    PushPartialAggregationThroughExchange state-split machinery).
+
+    Correct for INNER joins regardless of build-key multiplicity: a
+    partial-state row replicated by k matches merges identically to its
+    k underlying rows (sum/count/min/max/avg states are replication-
+    linear), and whole partial groups match-or-drop together because the
+    left join keys are part of the partial grouping key. The win on this
+    hardware: the probe side shrinks to one state row per group BEFORE
+    the join, so probe gathers and the post-join group-by touch
+    group-count rows, not input rows."""
+    node = node.with_children(
+        [_push_partial_agg_through_join(c) for c in node.children])
+    if not isinstance(node, AggregationNode) or node.step != "single":
+        return node
+    out = _try_eager_agg(node)
+    return out if out is not None else node
+
+
+def _try_eager_agg(agg: AggregationNode) -> Optional[PlanNode]:
+    from .rules import _inline_into
+
+    if not agg.group_indices:
+        return None                  # global agg: partial is one row; no win
+    for a in agg.aggs:
+        if a.distinct or a.mask is not None \
+                or a.fn not in _PUSHABLE_AGG_FNS:
+            return None
+    chain: List[ProjectNode] = []
+    cur = agg.child
+    while isinstance(cur, ProjectNode):
+        chain.append(cur)
+        cur = cur.child
+    if not isinstance(cur, JoinNode) or cur.join_type != "inner" \
+            or cur.residual is not None:
+        return None
+    join = cur
+    # compose the project chain: agg-child column i as an expr over the
+    # join's output schema
+    exprs: Optional[List[ir.Expr]] = None
+    for p in chain:
+        exprs = list(p.exprs) if exprs is None \
+            else [_inline_into(e, p.exprs) for e in exprs]
+    if exprs is None:
+        exprs = [ir.input_ref(i, f.type)
+                 for i, f in enumerate(join.fields)]
+    nL = len(join.left.fields)
+
+    def left_only(e: ir.Expr) -> bool:
+        refs = referenced_inputs(e)
+        return all(r < nL for r in refs)
+
+    # classify group keys: left-side exprs join the partial grouping key;
+    # right-side keys must be bare column refs (still available above)
+    left_group: List[Tuple[int, ir.Expr]] = []
+    right_group: List[Tuple[int, int]] = []
+    for pos in range(len(agg.group_indices)):
+        e = exprs[agg.group_indices[pos]]
+        if left_only(e):
+            left_group.append((pos, e))
+        elif isinstance(e, ir.InputRef) and e.index >= nL:
+            right_group.append((pos, e.index - nL))
+        else:
+            return None
+    for a in agg.aggs:
+        if a.arg is not None and not left_only(exprs[a.arg]):
+            return None
+
+    # below-projection over the left side: join keys + left group keys +
+    # aggregate inputs (deduplicated by structural equality)
+    Lf = join.left.fields
+    below: List[ir.Expr] = []
+    below_fields: List[Field] = []
+    index_of: Dict[ir.Expr, int] = {}
+
+    def add(e: ir.Expr, name: str) -> int:
+        if e in index_of:
+            return index_of[e]
+        index_of[e] = len(below)
+        below.append(e)
+        below_fields.append(Field(name, e.type))
+        return len(below) - 1
+
+    jk_below = [add(ir.input_ref(k, Lf[k].type), Lf[k].name)
+                for k in join.left_keys]
+    n_keys = len(agg.group_indices)
+    gk_below = [(pos, add(e, agg.fields[pos].name))
+                for pos, e in left_group]
+    agg_below = [None if a.arg is None
+                 else add(exprs[a.arg], f"$aggin{i}")
+                 for i, a in enumerate(agg.aggs)]
+
+    partial_group: List[int] = list(dict.fromkeys(
+        jk_below + [b for _, b in gk_below]))
+    if len(partial_group) > 4:
+        # the pushed partial sorts by (dead, null, data) per key: TPU
+        # variadic-sort compile time grows superlinearly with operand
+        # count (measured minutes at ~10 operands), so wide grouping
+        # keys stay above the join
+        return None
+    below_proj = ProjectNode(child=join.left, exprs=tuple(below),
+                             fields=tuple(below_fields))
+    partial_aggs = tuple(
+        dataclasses.replace(a, arg=agg_below[i])
+        for i, a in enumerate(agg.aggs))
+    partial = AggregationNode(
+        child=below_proj, group_indices=tuple(partial_group),
+        aggs=partial_aggs, fields=(), step="partial")
+    from .fragmenter import _agg_state_fields
+    partial = dataclasses.replace(partial,
+                                  fields=_agg_state_fields(partial))
+    # the rewritten join: partial states probe the unchanged build side
+    new_left_keys = tuple(partial_group.index(b) for b in jk_below)
+    new_join = dataclasses.replace(
+        join, left=partial, left_keys=new_left_keys,
+        fields=tuple(partial.fields) + tuple(join.right.fields))
+    # above-projection: [final group keys..., state columns...] — the
+    # final step consumes states positionally after the keys
+    np_fields = len(partial.fields)
+    key_ref: Dict[int, ir.Expr] = {}
+    for pos, e in left_group:
+        b = index_of[e]
+        key_ref[pos] = ir.input_ref(partial_group.index(b),
+                                    below_fields[b].type)
+    for pos, rcol in right_group:
+        key_ref[pos] = ir.input_ref(np_fields + rcol,
+                                    join.right.fields[rcol].type)
+    above_exprs: List[ir.Expr] = [key_ref[pos] for pos in range(n_keys)]
+    above_fields: List[Field] = [agg.fields[pos] for pos in range(n_keys)]
+    from ..ops.aggregation import AggSpec
+    st = len(partial_group)
+    state_args: List[int] = []
+    for a in agg.aggs:
+        spec = AggSpec(a.fn, a.arg, a.output_type, a.name)
+        state_args.append(len(above_exprs))
+        for sn, stype in spec.state_types():
+            above_exprs.append(
+                ir.input_ref(st, stype))
+            above_fields.append(Field(sn, stype))
+            st += 1
+    above = ProjectNode(child=new_join, exprs=tuple(above_exprs),
+                        fields=tuple(above_fields))
+    final_aggs = tuple(
+        dataclasses.replace(a, arg=state_args[i])
+        for i, a in enumerate(agg.aggs))
+    return AggregationNode(
+        child=above, group_indices=tuple(range(n_keys)),
+        aggs=final_aggs, fields=agg.fields, step="final")

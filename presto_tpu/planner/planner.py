@@ -62,6 +62,16 @@ class Session:
     last_memory_stats: object = None
 
 
+def bool_property(session: "Session", name: str, default: bool) -> bool:
+    """Session properties arrive as strings from SET SESSION / HTTP
+    headers; parse the usual spellings instead of trusting truthiness.
+    Shared by the executor's and the optimizer's feature gates."""
+    v = session.properties.get(name, default)
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "off", "no", "")
+    return bool(v)
+
+
 def _const_value(e: ir.Expr):
     """Evaluate a constant expression to its python value (VALUES cells,
     which may be arbitrary constant expressions: casts, arithmetic,

@@ -175,6 +175,11 @@ class _Query:
 
     def cancel(self) -> None:
         with self._state_lock:
+            if self.state in ("FINISHED", "FAILED"):
+                # terminal states stay put: clients routinely DELETE the
+                # statement URI on close after draining all pages, and a
+                # completed query must not re-report as canceled
+                return
             self._cancelled.set()
             self.state = "FAILED"
             self.error = {"message": "Query was canceled", "errorCode": 1,
