@@ -191,7 +191,9 @@ class Batch:
         cols = []
         for i, (f, arr) in enumerate(zip(schema.fields, arrays)):
             dt = f.type.storage_dtype
-            padded = np.zeros(cap, dtype=np.dtype(dt))
+            width = getattr(f.type, "storage_width", None)
+            shape = (cap,) if width is None else (cap, width)
+            padded = np.zeros(shape, dtype=np.dtype(dt))
             padded[:n] = np.asarray(arr[:n]).astype(np.dtype(dt))
             if validity is not None and validity[i] is not None:
                 v = np.zeros(cap, dtype=bool)
@@ -514,7 +516,10 @@ def concat_batches(batches: Sequence[Batch], capacity: Optional[int] = None) -> 
         validity = jnp.concatenate([c.validity for c in cols])
         pad = cap - data.shape[0]
         if pad > 0:
-            data = jnp.pad(data, (0, pad))
+            # pad only the row axis: vector-state columns (HLL registers)
+            # carry a trailing width dimension
+            data = jnp.pad(data,
+                           ((0, pad),) + ((0, 0),) * (data.ndim - 1))
             validity = jnp.pad(validity, (0, pad))
         elif pad < 0:
             raise ValueError("concat capacity too small")

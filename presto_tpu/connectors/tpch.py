@@ -581,12 +581,23 @@ class _Metadata(ConnectorMetadata):
         n = float(_rows(t, self.sf))
         cols: Dict[str, ColumnStats] = {}
         if t == "lineitem":
-            cols["l_orderkey"] = ColumnStats(_rows("orders", self.sf), 0.0, 1, _rows("orders", self.sf))
+            n_orders = _rows("orders", self.sf)
+            cols["l_orderkey"] = ColumnStats(n_orders, 0.0, 1, n_orders)
+            cols["l_partkey"] = ColumnStats(
+                _rows("part", self.sf), 0.0, 1, _rows("part", self.sf))
+            cols["l_suppkey"] = ColumnStats(
+                _rows("supplier", self.sf), 0.0, 1,
+                _rows("supplier", self.sf))
+            cols["l_linenumber"] = ColumnStats(7, 0.0, 1, 7)
             cols["l_shipdate"] = ColumnStats(ORDERDATE_SPAN + 151, 0.0, START_DATE, END_ORDERDATE + 151)
             cols["l_discount"] = ColumnStats(11, 0.0, 0.0, 0.10)
+            cols["l_tax"] = ColumnStats(9, 0.0, 0.0, 0.08)
             cols["l_quantity"] = ColumnStats(50, 0.0, 1.0, 50.0)
         if t == "orders":
             cols["o_orderkey"] = ColumnStats(n, 0.0, 1, int(n))
+            cols["o_custkey"] = ColumnStats(
+                _rows("customer", self.sf), 0.0, 1,
+                _rows("customer", self.sf))
             cols["o_orderdate"] = ColumnStats(ORDERDATE_SPAN, 0.0, START_DATE, END_ORDERDATE)
         for pk in self._PRIMARY_KEYS.get(t, ()):
             if pk not in cols:

@@ -131,8 +131,14 @@ def deserialize_arrays(data: bytes):
         off += vbytes
         dt = np.dtype(typ.storage_dtype)
         wire_dt = np.dtype(np.uint8) if dt == np.bool_ else dt
-        arr = np.frombuffer(payload, dtype=wire_dt, count=n, offset=off)
-        off += n * wire_dt.itemsize
+        # fixed-width vector columns (HLL register states) carry
+        # width values per row; the type's display round-trips the width
+        width = getattr(typ, "storage_width", None) or 1
+        arr = np.frombuffer(payload, dtype=wire_dt, count=n * width,
+                            offset=off)
+        off += n * width * wire_dt.itemsize
+        if width > 1:
+            arr = arr.reshape(n, width)
         if dt == np.bool_:
             arr = arr.astype(bool)
         arrays.append(arr)

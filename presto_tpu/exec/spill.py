@@ -357,23 +357,31 @@ class AggSpillBuffer:
             nb = batch_device_bytes(partial)
             if self.ctx.pool.try_reserve(nb, self.ctx):
                 self.device.append(partial)
-                if len(self.device) >= self.merge_every:
-                    self._merge_device()
+                if len(self.device) < self.merge_every:
+                    return
+                # snapshot-and-clear under the lock; the merge itself
+                # (which host-syncs for the compaction size) runs
+                # outside so other operators' reserves aren't blocked
+                # behind device compute. A revoke landing mid-merge
+                # sees an empty device list and just flips `spilled`.
+                snapshot = self.device
+                self.device = []
             else:
                 self.ctx.revoke()
                 self._stage(partial)
-
-    def _merge_device(self) -> None:
-        merged = grouped_aggregate(concat_batches(self.device),
+                return
+        merged = grouped_aggregate(concat_batches(snapshot),
                                    self.key_idx, self.aggs, mode="merge")
-        state = merged.compact(bucket_capacity(max(merged.host_count(), 1)))
-        self.ctx.release_all()
-        self.device = []
-        if self.ctx.pool.try_reserve(batch_device_bytes(state), self.ctx):
-            self.device = [state]
-        else:
-            self._stage(state)
-            self.spilled = True
+        state = merged.compact(
+            bucket_capacity(max(merged.host_count(), 1)))
+        with self.ctx.pool.lock:
+            self.ctx.release_all()
+            if not self.spilled and self.ctx.pool.try_reserve(
+                    batch_device_bytes(state), self.ctx):
+                self.device.append(state)
+            else:
+                self._stage(state)
+                self.spilled = True
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:

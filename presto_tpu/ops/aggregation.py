@@ -32,7 +32,8 @@ _VARIANCE_FNS = ("var_samp", "var_pop", "stddev_samp",
                  "stddev_pop")
 _SUPPORTED = ("sum", "count", "count_star", "min", "max", "avg",
               "var_samp", "var_pop", "stddev_samp", "stddev_pop",
-              "bool_and", "bool_or", "approx_percentile")
+              "bool_and", "bool_or", "approx_percentile",
+              "approx_distinct")
 #: aggregates with no mergeable fixed-size state: the executor drains the
 #: input and evaluates in one 'single'-mode pass (reference computes these
 #: with QuantileDigest sketches — state/DigestAndPercentileState.java; the
@@ -74,6 +75,13 @@ class AggSpec:
         if self.fn in DRAIN_FNS:
             raise NotImplementedError(
                 f"{self.fn} has no mergeable partial state (drain-only)")
+        if self.fn == "approx_distinct":
+            # fixed-size HLL register vector: the bounded mergeable state
+            # the reference ships between partial and final steps
+            # (state/HyperLogLogState.java); param carries the max
+            # standard error
+            from .sketch import hll_m
+            return [(f"{base}$hll", T.HllStateType(hll_m(self.param)))]
         if self.fn in ("count", "count_star"):
             return [(f"{base}$cnt", T.BIGINT)]
         if self.fn == "avg":
@@ -259,6 +267,12 @@ class _SegReducers:
     def max(self, x):
         return jax.ops.segment_max(x, self.gid, num_segments=self.cap)
 
+    def hll(self, valid, hashed, m):
+        """HLL register update: one segment_max over flattened
+        (group, bucket) slots (ops/sketch.py)."""
+        from .sketch import hll_update
+        return hll_update(self.gid, valid, hashed, self.cap, m)
+
     def gather(self, per_group):
         return per_group[self.gid]
 
@@ -318,6 +332,15 @@ def _segment_aggs(
             n_state = len(agg.state_types())
             s_cols = list(range(state_cursor, state_cursor + n_state))
             state_cursor += n_state
+            if agg.fn == "approx_distinct":
+                # HLL merge = per-bucket max of register rows [n, m];
+                # 0 is the register identity so dead rows drop out
+                regs_in = col_data[s_cols[0]]
+                live2 = mask[:, None]
+                merged = red.max(jnp.where(live2, regs_in,
+                                           jnp.zeros_like(regs_in)))
+                results.append((jnp.maximum(merged, 0),))
+                continue
             if agg.fn in ("count", "count_star"):
                 cnt_in = jnp.where(mask, col_data[s_cols[0]], 0)
                 cnt = red.sum(cnt_in)
@@ -374,6 +397,12 @@ def _segment_aggs(
         valid = col_valid[agg.input] & mask
         if agg.mask is not None:
             valid = valid & col_data[agg.mask].astype(bool)
+        if agg.fn == "approx_distinct":
+            from .sketch import hashed_column, hll_m
+            vocab = col_dicts[agg.input] if col_dicts else None
+            hashed = hashed_column(data, vocab)
+            results.append((red.hll(valid, hashed, hll_m(agg.param)),))
+            continue
         cnt = red.sum(valid.astype(jnp.int64))
         if agg.fn == "count":
             results.append((cnt,))
@@ -483,6 +512,10 @@ def _finalize(agg: AggSpec, parts: Tuple[jnp.ndarray, ...]) -> Tuple[jnp.ndarray
     """state -> (output data, output validity)."""
     if agg.fn in ("count", "count_star"):
         return parts[0], jnp.ones_like(parts[0], dtype=bool)
+    if agg.fn == "approx_distinct":
+        from .sketch import hll_estimate
+        regs = parts[0]
+        return hll_estimate(regs), jnp.ones(regs.shape[:-1], dtype=bool)
     if agg.fn in _VARIANCE_FNS:
         return _variance_out(agg, *parts)
     val, cnt = parts
@@ -654,6 +687,11 @@ def grouped_aggregate(
     cap = output_capacity or batch.capacity
     from_states = mode in ("final", "merge")
     n_keys = len(group_indices)
+    if any(a.fn == "approx_distinct" for a in aggs):
+        # HLL states are [rows, m] tiles; the dense broadcast-compare
+        # reducer would materialize [rows, K, m] — route through the
+        # sort path whose segment ops stay 2D
+        allow_dense = False
     dense = (_dense_group_code(batch, group_indices,
                                limit=min(cap, _DENSE_GROUP_LIMIT))
              if allow_dense else None)
@@ -790,6 +828,38 @@ def global_aggregate(
 
     state_cursor = 0
     for agg in aggs:
+        if agg.fn == "approx_distinct":
+            from .sketch import (hashed_column, hll_estimate, hll_m,
+                                 hll_update)
+            m = hll_m(agg.param)
+            if mode in ("final", "merge"):
+                cols = batch.columns[state_cursor:state_cursor + 1]
+                state_cursor += 1
+                regs = jnp.max(jnp.where(mask[:, None], cols[0].data, 0),
+                               axis=0)
+            else:
+                c = batch.columns[agg.input]
+                valid = c.validity & mask
+                if agg.mask is not None:
+                    valid = valid & \
+                        batch.columns[agg.mask].data.astype(bool)
+                hashed = hashed_column(c.data, c.dictionary)
+                regs = hll_update(jnp.zeros(batch.capacity, jnp.int32),
+                                  valid, hashed, 1, m)[0]
+            if mode in ("partial", "merge"):
+                (fname, ftype) = agg.state_types()[0]
+                out_fields.append((fname, ftype))
+                out_cols.append(Column(
+                    ftype,
+                    jnp.zeros((cap, m), dtype=jnp.int32).at[0].set(
+                        regs.astype(jnp.int32)),
+                    out_mask, None))
+            else:
+                out_fields.append((agg.name or agg.fn, agg.output_type))
+                out_cols.append(Column(
+                    agg.output_type, pad(hll_estimate(regs), jnp.int64),
+                    jnp.zeros(cap, dtype=bool).at[0].set(True), None))
+            continue
         if mode in ("final", "merge"):
             n_state = len(agg.state_types())
             cols = batch.columns[state_cursor:state_cursor + n_state]

@@ -1,0 +1,138 @@
+"""HyperLogLog sketch kernels for approx_distinct.
+
+The TPU-native reshape of the reference's HLL aggregation state
+(reference presto-main/.../operator/aggregation/
+ApproximateCountDistinctAggregations.java + state/HyperLogLogState.java,
+backed by airlift's HyperLogLog): per group, m = (1.04/e)^2 registers
+each holding the max leading-zero rank of hashed inputs in that bucket.
+
+Device shape: registers live in a dense i32 tile [groups, m] — updates
+are ONE segment_max over flattened (group, bucket) slots, merges are ONE
+segment_max over rows of state tiles, and estimation is a vectorized
+harmonic mean. No per-row control flow, no sparse representation: the
+engine only routes approx_distinct through this path when the group
+count is statically bounded (dictionary/bool keys or a global
+aggregate), so the dense tile is small; unbounded group-bys keep the
+exact sort-based fallback (which is EXACT — a strictly tighter error
+bound than the reference's sketch on that shape).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: default standard error of the reference's approx_distinct (reference
+#: ApproximateCountDistinctAggregations.java DEFAULT_STANDARD_ERROR)
+DEFAULT_STANDARD_ERROR = 0.023
+MIN_STANDARD_ERROR = 0.0040625
+MAX_STANDARD_ERROR = 0.26
+
+
+def hll_m(error: Optional[float]) -> int:
+    """Register count for a target standard error (1.04/sqrt(m)),
+    rounded up to a power of two like the reference's bucket counts."""
+    e = DEFAULT_STANDARD_ERROR if error is None else float(error)
+    if not (MIN_STANDARD_ERROR <= e <= MAX_STANDARD_ERROR):
+        raise ValueError(
+            f"standard error must be in [{MIN_STANDARD_ERROR}, "
+            f"{MAX_STANDARD_ERROR}]: {e}")
+    m = int(math.ceil((1.04 / e) ** 2))
+    return 1 << max(int(math.ceil(math.log2(m))), 4)
+
+
+def splitmix64(x: jnp.ndarray) -> jnp.ndarray:
+    """Stateless 64-bit mix (the device-friendly stand-in for the
+    reference's Murmur3 element hashing): good avalanche, pure vector
+    ops."""
+    x = x.astype(jnp.uint64)
+    x = (x + jnp.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def hash_dictionary(vocab: Tuple[str, ...]) -> np.ndarray:
+    """Stable 64-bit hashes of a string vocabulary (host-side, gathered
+    by code on device): dictionary codes are per-batch, so hashing the
+    VALUES keeps sketches mergeable across batches and shards."""
+    import zlib
+    out = np.empty(max(len(vocab), 1), dtype=np.uint64)
+    out[:] = 1
+    for i, s in enumerate(vocab):
+        b = s.encode("utf-8")
+        # two independent crcs widen to 64 bits; splitmix on device
+        # finalizes, so only distinctness matters here
+        out[i] = (np.uint64(zlib.crc32(b)) << np.uint64(32)) \
+            | np.uint64(zlib.crc32(b, 0x9E3779B9))
+    return out
+
+
+def bucket_and_rank(hashed: jnp.ndarray, m: int):
+    """(bucket, rank): bucket = top log2(m) bits, rank = leading-zero
+    count of the remaining bits + 1 (the classic HLL decomposition)."""
+    b = int(math.log2(m))
+    h = hashed.astype(jnp.uint64)
+    bucket = (h >> jnp.uint64(64 - b)).astype(jnp.int32)
+    # the sentinel bit guarantees a nonzero word, capping the rank at
+    # 64 - b + 1 like the reference's value-bit budget
+    rest = (h << jnp.uint64(b)) | (jnp.uint64(1) << jnp.uint64(b - 1))
+    rank = (jax.lax.clz(rest).astype(jnp.int32) + 1)
+    return bucket, rank
+
+
+def hll_update(group_slot: jnp.ndarray, valid: jnp.ndarray,
+               hashed: jnp.ndarray, cap: int, m: int) -> jnp.ndarray:
+    """Registers [cap, m] from one pass of hashed values: segment_max
+    over flattened (group, bucket) slots; invalid rows rank 0."""
+    bucket, rank = bucket_and_rank(hashed, m)
+    flat = group_slot.astype(jnp.int64) * m + bucket
+    flat = jnp.where(valid, flat, cap * m)      # dead rows -> trash slot
+    ranks = jnp.where(valid, rank, 0)
+    regs = jax.ops.segment_max(ranks, flat.astype(jnp.int32),
+                               num_segments=cap * m + 1)
+    return jnp.maximum(regs[:cap * m], 0).reshape(cap, m)
+
+
+def hll_merge(states: jnp.ndarray, group_id: jnp.ndarray,
+              cap: int) -> jnp.ndarray:
+    """Merge state rows [n, m] into [cap, m] by per-bucket max."""
+    return jnp.maximum(
+        jax.ops.segment_max(states, group_id, num_segments=cap), 0)
+
+
+def hll_estimate(registers: jnp.ndarray) -> jnp.ndarray:
+    """Bias-corrected cardinality per group from registers [..., m]
+    (the standard HLL estimator with the linear-counting small-range
+    correction the reference applies)."""
+    m = registers.shape[-1]
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    regs = registers.astype(jnp.float64)
+    raw = alpha * m * m / jnp.sum(jnp.power(2.0, -regs), axis=-1)
+    zeros = jnp.sum((registers == 0).astype(jnp.float64), axis=-1)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    small = raw <= 2.5 * m
+    est = jnp.where(small & (zeros > 0), linear, raw)
+    return jnp.round(est).astype(jnp.int64)
+
+
+def hashed_column(data: jnp.ndarray, dictionary) -> jnp.ndarray:
+    """Device hash of a column's storage values: strings hash their
+    dictionary VALUES (host-stable) gathered by code; numerics hash
+    their storage bits."""
+    if dictionary is not None:
+        table = jnp.asarray(hash_dictionary(tuple(dictionary)))
+        codes = jnp.clip(data.astype(jnp.int32), 0, table.shape[0] - 1)
+        return splitmix64(jnp.take(table, codes, axis=0).astype(jnp.int64))
+    if data.dtype == jnp.bool_:
+        return splitmix64(data.astype(jnp.int64))
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        # canonicalize -0.0 so equal SQL values hash equally
+        canon = jnp.where(data == 0, jnp.zeros_like(data), data)
+        bits = jax.lax.bitcast_convert_type(
+            canon.astype(jnp.float64), jnp.int64)
+        return splitmix64(bits)
+    return splitmix64(data.astype(jnp.int64))

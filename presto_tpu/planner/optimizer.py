@@ -51,7 +51,7 @@ def optimize(plan: LogicalPlan, session: Session) -> LogicalPlan:
         node = _implement_joins(node, session)
         if bool_property(session, "push_partial_aggregation_through_join",
                          True):
-            node = _push_partial_agg_through_join(node)
+            node = _push_partial_agg_through_join(node, session)
         return _attach_scan_pushdown(node)
     root = pipeline(plan.root)
     init = [pipeline(p) for p in plan.init_plans]
@@ -755,7 +755,39 @@ def _distribution(build: PlanNode, rows: float, session: Session) -> str:
 _PUSHABLE_AGG_FNS = ("sum", "count", "count_star", "min", "max", "avg")
 
 
-def _push_partial_agg_through_join(node: PlanNode) -> PlanNode:
+def _column_distinct(node: PlanNode, idx: int,
+                     session: Session) -> Optional[float]:
+    """Upper-bound distinct-count estimate for one output column, walked
+    down to a scan's connector statistics (the narrow slice of the
+    reference's stats calculus — cost/StatsCalculator.java — the eager-
+    aggregation gate needs). Filters and joins never grow a column's
+    distinct count, so passing estimates through them stays an upper
+    bound; None = unknown."""
+    if isinstance(node, TableScanNode):
+        conn = session.catalogs.get(node.catalog)
+        stats = conn.metadata.table_stats(node.table)
+        cs = stats.columns.get(node.columns[idx])
+        return float(cs.distinct_count) \
+            if cs is not None and cs.distinct_count is not None else None
+    if isinstance(node, FilterNode):
+        return _column_distinct(node.child, idx, session)
+    if isinstance(node, ProjectNode):
+        e = node.exprs[idx]
+        if isinstance(e, ir.InputRef):
+            return _column_distinct(node.child, e.index, session)
+        return None
+    if isinstance(node, JoinNode):
+        nl = len(node.left.fields)
+        if idx < nl:
+            return _column_distinct(node.left, idx, session)
+        return _column_distinct(node.right, idx - nl, session)
+    if isinstance(node, SemiJoinNode):
+        return _column_distinct(node.source, idx, session)
+    return None
+
+
+def _push_partial_agg_through_join(node: PlanNode,
+                                   session: Session) -> PlanNode:
     """Rewrite Agg(Project*(Join(L, R))) into
     Final(Project(Join(Partial(Project(L)), R))) when every aggregate
     input comes from the probe (left) side — the reference's
@@ -771,14 +803,16 @@ def _push_partial_agg_through_join(node: PlanNode) -> PlanNode:
     the join, so probe gathers and the post-join group-by touch
     group-count rows, not input rows."""
     node = node.with_children(
-        [_push_partial_agg_through_join(c) for c in node.children])
+        [_push_partial_agg_through_join(c, session)
+         for c in node.children])
     if not isinstance(node, AggregationNode) or node.step != "single":
         return node
-    out = _try_eager_agg(node)
+    out = _try_eager_agg(node, session)
     return out if out is not None else node
 
 
-def _try_eager_agg(agg: AggregationNode) -> Optional[PlanNode]:
+def _try_eager_agg(agg: AggregationNode,
+                   session: Session) -> Optional[PlanNode]:
     from .rules import _inline_into
 
     if not agg.group_indices:
@@ -859,6 +893,24 @@ def _try_eager_agg(agg: AggregationNode) -> Optional[PlanNode]:
         # count (measured minutes at ~10 operands), so wide grouping
         # keys stay above the join
         return None
+    # cardinality gate (the reference rule is cost-based): decline when
+    # statistics PROVE the partial cannot shrink its input — the push
+    # would add a full sort-based aggregation pass for nothing. When any
+    # key's distinct count is unknown, push optimistically: the worst
+    # case is one extra aggregation pass over rows the plan was already
+    # aggregating, while the win (q3/q55-shaped plans) is an order of
+    # magnitude.
+    distincts = [_column_distinct(
+        ProjectNode(child=join.left, exprs=tuple(below),
+                    fields=tuple(below_fields)), b, session)
+        for b in partial_group]
+    if all(d is not None for d in distincts):
+        groups_est = 1.0
+        for d in distincts:
+            groups_est *= max(d, 1.0)
+        left_rows = _estimate_rows(join.left, session)
+        if groups_est >= 0.5 * left_rows:
+            return None
     below_proj = ProjectNode(child=join.left, exprs=tuple(below),
                              fields=tuple(below_fields))
     partial_aggs = tuple(

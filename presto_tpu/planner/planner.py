@@ -809,18 +809,33 @@ class _Planner:
         for j, call in enumerate(uniq_aggs):
             fn = _FUNCTION_ALIASES.get(call.name, call.name)
             distinct = call.distinct
-            if fn == "approx_distinct":
-                # exact distinct-count is a valid approximation; the
-                # reference trades exactness for fixed memory via HLL
-                # (operator/aggregation/ApproximateCountDistinct +
-                # state/HyperLogLogState.java)
+            if fn == "approx_distinct" and group_exprs:
+                # grouped approx_distinct: HLL registers are a dense
+                # [groups, m] tile on device, so an unbounded group count
+                # would be unbounded state; without tight group-domain
+                # statistics the engine keeps the EXACT lowering (a
+                # strictly tighter error bound; the reference's sketch
+                # exists to bound per-group memory, which the sort-based
+                # mark-distinct path bounds differently).  The global
+                # form below carries real bounded HLL state through
+                # partial -> exchange -> final.
+                if len(call.args) == 2:
+                    # validate-and-drop the standard-error argument: the
+                    # exact lowering satisfies any error bound
+                    _parse_approx_distinct_error(analyzer, call)
+                    call = dataclasses.replace(call,
+                                               args=(call.args[0],))
+                elif len(call.args) != 1:
+                    raise AnalysisError(
+                        "approx_distinct takes one or two arguments")
                 fn, distinct = "count", True
             # ARBITRARY allows any live value; max picks one branch-free
             if fn in ("any_value", "arbitrary"):
                 fn = "max"
             if fn not in ("count", "sum", "avg", "min", "max", "var_samp",
                           "var_pop", "stddev_samp", "stddev_pop",
-                          "bool_and", "bool_or", "approx_percentile"):
+                          "bool_and", "bool_or", "approx_percentile",
+                          "approx_distinct"):
                 raise AnalysisError(f"aggregate {fn}() not supported yet")
             if call.is_star or not call.args:
                 if fn != "count":
@@ -830,6 +845,24 @@ class _Planner:
                 agg_fields.append(Field(f"_agg{j}", T.BIGINT))
                 continue
             param = None
+            if fn == "approx_distinct":
+                # approx_distinct(x[, e]): bounded-memory HLL sketch with
+                # standard error e (reference
+                # ApproximateCountDistinctAggregations.java); state =
+                # one register vector, mergeable across exchanges
+                if len(call.args) == 2:
+                    param = _parse_approx_distinct_error(analyzer, call)
+                elif len(call.args) != 1:
+                    raise AnalysisError(
+                        "approx_distinct takes one or two arguments")
+                arg = analyzer.analyze(call.args[0])
+                arg_index = len(pre_exprs)
+                pre_exprs.append(arg)
+                pre_fields.append(Field(f"_aggarg{j}", arg.type))
+                aggs.append(PlanAgg(fn, arg_index, T.BIGINT, f"_agg{j}",
+                                    distinct=False, param=param))
+                agg_fields.append(Field(f"_agg{j}", T.BIGINT))
+                continue
             if fn == "approx_percentile":
                 # approx_percentile(x, p): p must be a constant in [0, 1]
                 # (reference ApproximateLongPercentileAggregations)
@@ -1420,6 +1453,22 @@ def _collect_windows(exprs: Sequence[A.Expression]
         if e is not None:
             walk(e)
     return found
+
+
+def _parse_approx_distinct_error(analyzer, call) -> float:
+    """Validate approx_distinct's optional max-standard-error argument
+    (must be a constant within the reference's supported range)."""
+    e_expr = analyzer.analyze(call.args[1])
+    if not isinstance(e_expr, ir.Literal) or e_expr.value is None:
+        raise AnalysisError(
+            "approx_distinct standard error must be a constant")
+    param = float(e_expr.value)
+    from ..ops.sketch import MAX_STANDARD_ERROR, MIN_STANDARD_ERROR
+    if not (MIN_STANDARD_ERROR <= param <= MAX_STANDARD_ERROR):
+        raise AnalysisError(
+            "approx_distinct standard error must be in "
+            f"[{MIN_STANDARD_ERROR}, {MAX_STANDARD_ERROR}]")
+    return param
 
 
 def _derive_name(e: A.Expression, i: int) -> str:

@@ -337,6 +337,32 @@ class MapType(Type):
 
 
 @dataclasses.dataclass(frozen=True)
+class HllStateType(Type):
+    """HyperLogLog register-vector state for approx_distinct partials
+    (reference presto-main/.../operator/aggregation/state/
+    HyperLogLogState.java + airlift HyperLogLog). Column ``data`` is a
+    dense i32 tile [capacity, m] of per-bucket max-rank registers — a
+    fixed-width vector per group, so partial states merge with one
+    vectorized segment_max and ship through exchanges as ordinary
+    fixed-width columns (``storage_width`` tells the wire format the
+    trailing dimension)."""
+
+    m: int = 2048
+    name: ClassVar[str] = "hllstate"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+    @property
+    def storage_width(self) -> int:
+        return self.m
+
+    def display(self) -> str:
+        return f"hllstate({self.m})"
+
+
+@dataclasses.dataclass(frozen=True)
 class RowType(Type):
     """ROW(f1 T1, ...): struct of child columns. Column ``data`` is a
     tuple of (child_data, child_valid) pairs; ``dictionary`` is a tuple
@@ -496,6 +522,8 @@ def parse_type(text: str) -> Type:
             return VarcharType(args[0])
         if base == "char":
             return CharType(args[0])
+        if base == "hllstate":
+            return HllStateType(args[0])
         raise ValueError(f"unknown parametric type {text!r}")
     simple = {
         "boolean": BOOLEAN,

@@ -124,7 +124,8 @@ def test_server_queues_second_query():
             from presto_tpu.exec.local import QueryResult
             self._result = QueryResult(["x"], [], [(1,)])
 
-        def execute(self, sql, properties=None, user=""):
+        def execute(self, sql, properties=None, user="",
+                    cancel_event=None):
             if sql == "slow":
                 self.gate.wait(20)
             return self._result

@@ -268,3 +268,31 @@ def test_push_partial_agg_results_match_unpushed():
     for a, b in zip(pushed, plain):
         assert a[0] == b[0] and a[2] == b[2] and a[3] == b[3]
         assert abs(a[1] - b[1]) <= 1e-9 * max(abs(b[1]), 1.0)
+
+
+def test_push_partial_agg_cardinality_gate():
+    """When statistics prove the pushed partial cannot shrink its input
+    (near-unique grouping keys), the rewrite declines; grouping by the
+    join key itself still pushes (the q3 shape)."""
+    from presto_tpu.exec.runner import LocalRunner
+    from presto_tpu.planner.plan import AggregationNode
+
+    r = LocalRunner(tpch_sf=0.01)
+
+    def steps(plan):
+        out = []
+
+        def walk(n):
+            if isinstance(n, AggregationNode):
+                out.append(n.step)
+            for c in n.children:
+                walk(c)
+        walk(plan.root)
+        return out
+
+    bad = r.plan("select l_orderkey, sum(l_quantity) from lineitem, "
+                 "orders where l_partkey = o_custkey group by 1")
+    assert steps(bad) == ["single"], steps(bad)
+    good = r.plan("select l_orderkey, sum(l_quantity) from lineitem, "
+                  "orders where l_orderkey = o_orderkey group by 1")
+    assert steps(good) == ["final", "partial"], steps(good)

@@ -180,12 +180,14 @@ def test_distinct_aggregates(runner, oracle):
 
 
 def test_approx_distinct(runner, oracle):
-    """approx_distinct answers exactly (a valid approximation)."""
+    """Global approx_distinct runs the bounded HLL sketch: within a few
+    standard errors (2.3% default) of the exact count, deterministically
+    (stateless hashing)."""
     got = runner.execute(
         "select approx_distinct(o_custkey) from orders").rows
     want = oracle.execute(
         "select count(distinct o_custkey) from orders").fetchall()
-    assert int(got[0][0]) == want[0][0]
+    assert abs(int(got[0][0]) - want[0][0]) <= max(0.1 * want[0][0], 2)
 
 
 def test_variance_large_mean(runner, oracle):
