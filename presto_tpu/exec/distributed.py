@@ -266,6 +266,12 @@ class DistributedExecutor(_Executor):
             yield self._global_agg(node, aggs)
             return
         key_idx = list(range(len(group)))
+        # fragment steps (the optimizer's eager-aggregation rewrite
+        # pre-splits some aggregations): PARTIAL consumes raw rows and
+        # yields shard-local state, FINAL consumes state rows, SINGLE
+        # does both — same kernels, different sides of the state
+        # boundary (mirrors exec/local.py _AggregationNode)
+        step = node.step
 
         partial_fn = self._smap(
             lambda b: grouped_aggregate(b, group, aggs, mode="partial"), 1)
@@ -273,7 +279,7 @@ class DistributedExecutor(_Executor):
 
         state: Optional[Batch] = None
         for chunk in self.run(node.child):
-            partial = partial_fn(chunk)
+            partial = (chunk if step == "final" else partial_fn(chunk))
             if state is None:
                 state = partial
             else:
@@ -292,6 +298,11 @@ class DistributedExecutor(_Executor):
                 state = merged
         if state is None:
             return
+        if step == "partial":
+            # states stay shard-local: the downstream FINAL node owns
+            # the hash exchange that co-locates groups
+            yield state
+            return
         state = self._repartitioner(key_idx)(state)
         final_fn = self._smap(
             lambda b: grouped_aggregate(b, key_idx, aggs, mode="final"), 1)
@@ -299,6 +310,7 @@ class DistributedExecutor(_Executor):
 
     def _global_agg(self, node: AggregationNode,
                     aggs: List[AggSpec]) -> Batch:
+        step = node.step
         partial_fn = self._smap(
             lambda b: global_aggregate(b, aggs, mode="partial"), 1)
         merge_fn = self._smap(
@@ -306,13 +318,15 @@ class DistributedExecutor(_Executor):
                 concat_batches([a, b]), aggs, mode="merge"), 2)
         state: Optional[Batch] = None
         for chunk in self.run(node.child):
-            partial = partial_fn(chunk)
+            partial = (chunk if step == "final" else partial_fn(chunk))
             state = partial if state is None else merge_fn(state, partial)
         if state is None:
             empty = Batch.from_arrays(
                 _plan_schema(node.child),
                 [[] for _ in node.child.fields], num_rows=0)
             state = partial_fn(self._pad_shardable(empty))
+        if step == "partial":
+            return state          # shard-local states; FINAL gathers
         # gather every shard's state and finalize replicated
         final_fn = self._smap(
             lambda b: global_aggregate(
@@ -334,9 +348,8 @@ class DistributedExecutor(_Executor):
         # residual row error here degrades to dropped-row semantics
         residual_fn = (compile_filter(residual, _plan_schema(node))
                        if residual is not None else None)
-        if residual_fn is not None and node.join_type in ("left", "full"):
-            raise NotImplementedError(
-                f"residual predicate on {node.join_type.upper()} JOIN")
+        residual_outer = (residual_fn is not None
+                          and node.join_type in ("left", "full"))
         payload = list(range(len(node.right.fields)))
         payload_names = [f"$b{i}" for i in payload]
         out_schema = _plan_schema(node)
@@ -361,6 +374,8 @@ class DistributedExecutor(_Executor):
         # partitioned distribution, so each build row lives on one shard)
         jt = "left" if node.join_type == "full" else node.join_type
 
+        npro = len(node.left.fields)
+
         def local_probe(probe_l: Batch, build_l: Batch,
                         maxk: int) -> Batch:
             if node.build_unique:
@@ -372,6 +387,64 @@ class DistributedExecutor(_Executor):
                                   max_matches=maxk)
             out = Batch(out_schema, out.columns, out.row_mask)
             return residual_fn(out) if residual_fn else out
+
+        def local_probe_outer(probe_l: Batch, build_l: Batch,
+                              maxk: int):
+            """LEFT/FULL with a residual, shard-local (same contract as
+            the local executor's _probe_outer_residual: residual gates
+            matches, probe rows never drop; returns (batch,
+            surviving-build-match mask) — the mask feeds the FULL
+            unmatched-build tail)."""
+            from ..ops.join import (expand_match_origins, semi_join_mask,
+                                    unique_match_build_mask)
+            if node.build_unique:
+                out = lookup_join(probe_l, build_l, lkeys, rkeys,
+                                  payload, payload_names, "left")
+                match = semi_join_mask(probe_l, build_l, lkeys, rkeys)
+                gated = residual_fn(Batch(out_schema, out.columns,
+                                          probe_l.row_mask & match))
+                survived = gated.row_mask
+                cols = list(out.columns[:npro])
+                for c in out.columns[npro:]:
+                    cols.append(Column(c.type, c.data,
+                                       c.validity & survived,
+                                       c.dictionary))
+                bmask = (unique_match_build_mask(
+                    probe_l, build_l, lkeys, rkeys, survived)
+                    if track_full
+                    else jnp.zeros(build_l.capacity, dtype=bool))
+                return Batch(out_schema, cols, probe_l.row_mask), bmask
+            k = max(1, maxk)
+            e = expand_join(probe_l, build_l, lkeys, rkeys, payload,
+                            payload_names, "inner", max_matches=k)
+            gated = residual_fn(Batch(out_schema, e.columns,
+                                      e.row_mask))
+            survived = gated.row_mask
+            C = probe_l.capacity
+            has = jnp.any(survived.reshape(k, C), axis=0)
+            # reinstate unmatched probe rows in their slot-0 lanes with
+            # null payload (lane = slot*C + i, so slot 0 is the first C)
+            reinstate = jnp.zeros(k * C, dtype=bool).at[:C].set(
+                probe_l.row_mask & ~has)
+            cols = []
+            for i, c in enumerate(e.columns):
+                if i < npro:
+                    cols.append(c)
+                else:
+                    cols.append(Column(c.type, c.data,
+                                       c.validity & survived,
+                                       c.dictionary))
+            if track_full:
+                orig, _ = expand_match_origins(probe_l, build_l, lkeys,
+                                               rkeys, k)
+                n = build_l.capacity
+                bmask = jnp.zeros(n, dtype=bool).at[
+                    jnp.where(survived, orig, n)].max(survived,
+                                                      mode="drop")
+            else:
+                bmask = jnp.zeros(build_l.capacity, dtype=bool)
+            return Batch(out_schema, cols,
+                         survived | reinstate), bmask
 
         count_fn = None
         if not node.build_unique:
@@ -397,9 +470,21 @@ class DistributedExecutor(_Executor):
                         1), minimum=1)
             fn = join_fns.get(maxk)
             if fn is None:
-                fn = join_fns[maxk] = self._smap(
-                    lambda p, b, _k=maxk: local_probe(p, b, _k), 2,
-                    replicated_in=(1,) if replicated else ())
+                if residual_outer:
+                    fn = join_fns[maxk] = self._smap(
+                        lambda p, b, _k=maxk: local_probe_outer(p, b, _k),
+                        2, replicated_in=(1,) if replicated else ())
+                else:
+                    fn = join_fns[maxk] = self._smap(
+                        lambda p, b, _k=maxk: local_probe(p, b, _k), 2,
+                        replicated_in=(1,) if replicated else ())
+            if residual_outer:
+                out, m = fn(probe, build_side)
+                if track_full:
+                    build_matched = (m if build_matched is None
+                                     else build_matched | m)
+                yield out
+                continue
             if track_full:
                 m = match_fn(probe, build_side)
                 build_matched = (m if build_matched is None

@@ -746,14 +746,16 @@ class _Executor:
         residual = (self._resolve(node.residual)
                     if node.residual is not None else None)
         residual_fn = None
+        residual_outer = None
         if residual is not None:
             if node.join_type in ("left", "full"):
-                # residual on an outer join only filters matched rows'
-                # payload, not probe rows — approximate by filtering
-                # (correct for inner; outer-join residuals are rare)
-                raise NotImplementedError(
-                    f"residual predicate on {node.join_type.upper()} JOIN")
-            residual_fn = self.checked_filter(residual, _plan_schema(node))
+                # ON-clause filter of an outer join: gates matches, never
+                # drops probe rows (_probe_outer_residual)
+                residual_outer = self.checked_filter(
+                    residual, _plan_schema(node))
+            else:
+                residual_fn = self.checked_filter(residual,
+                                                  _plan_schema(node))
 
         from .local_exchange import exchange_source
         from .spill import HostPartitionStore, SpillableBuildBuffer
@@ -789,7 +791,7 @@ class _Executor:
             if isinstance(build, HostPartitionStore):
                 yield from self._partitioned_join(
                     node, build, payload, payload_names, residual_fn,
-                    probe_stream())
+                    probe_stream(), residual_outer=residual_outer)
                 return
             dyn = None
             summary = None
@@ -808,6 +810,8 @@ class _Executor:
             compact = self._compactor()
             track_full = node.join_type == "full" and build is not None
             build_matched = None
+            full_acc = ({"m": None} if track_full
+                        and residual_outer is not None else None)
             if build is not None:
                 # compact a sparse build before sorting it: probe-side
                 # binary searches walk a table sized by CAPACITY, so a
@@ -828,19 +832,25 @@ class _Executor:
                 else:
                     if dyn:
                         probe = _apply_dynamic_bounds(probe, dyn)
-                    for out in self._probe_batches(node, probe, build,
-                                                   payload, payload_names,
-                                                   prep):
-                        if residual_fn is not None:
-                            out = residual_fn(out)
-                        yield compact(out)
-                    if track_full:
-                        m = build_match_mask_jit(probe, build,
-                                                 list(node.left_keys),
-                                                 list(node.right_keys),
-                                                 prep)
-                        build_matched = (m if build_matched is None
-                                         else build_matched | m)
+                    if residual_outer is not None:
+                        for out in self._probe_outer_residual(
+                                node, probe, build, payload,
+                                payload_names, prep, residual_outer,
+                                full_acc):
+                            yield compact(out)
+                    else:
+                        for out in self._probe_batches(
+                                node, probe, build, payload,
+                                payload_names, prep):
+                            if residual_fn is not None:
+                                out = residual_fn(out)
+                            yield compact(out)
+                        if track_full:
+                            m = build_match_mask_jit(
+                                probe, build, list(node.left_keys),
+                                list(node.right_keys), prep)
+                            build_matched = (m if build_matched is None
+                                             else build_matched | m)
                     continue
                 if residual_fn is not None:
                     out = residual_fn(out)
@@ -849,6 +859,8 @@ class _Executor:
                 # FULL OUTER tail: build rows no probe row ever matched,
                 # null-extended on the probe side (reference
                 # LookupOuterOperator over the visited-positions bitmap)
+                if full_acc is not None:
+                    build_matched = full_acc["m"]
                 yield compact(self._null_extend_build(
                     build, node, build_matched))
         finally:
@@ -905,8 +917,8 @@ class _Executor:
 
     def _partitioned_join(self, node: JoinNode, store, payload,
                           payload_names, residual_fn,
-                          probe_batches: Optional[Iterator[Batch]] = None
-                          ) -> Iterator[Batch]:
+                          probe_batches: Optional[Iterator[Batch]] = None,
+                          residual_outer=None) -> Iterator[Batch]:
         """Spilled-build probe: stage the probe side host-partitioned by
         the same key hash, then join partition-serially so only one build
         partition plus one probe chunk is device-resident at a time
@@ -942,6 +954,23 @@ class _Executor:
                     if part_prep is None:
                         part_prep = self._prepare_join_build(
                             bpart, node.right_keys)
+                    if residual_outer is not None:
+                        # each probe row hashes to exactly one partition,
+                        # so per-partition outer semantics compose to the
+                        # global outer join
+                        part_acc = ({"m": None}
+                                    if node.join_type == "full" else None)
+                        for out in self._probe_outer_residual(
+                                node, probe_p, bpart, payload,
+                                payload_names, part_prep, residual_outer,
+                                part_acc):
+                            yield out
+                        if part_acc is not None \
+                                and part_acc["m"] is not None:
+                            part_matched = (
+                                part_acc["m"] if part_matched is None
+                                else part_matched | part_acc["m"])
+                        continue
                     for out in self._probe_batches(node, probe_p, bpart,
                                                    payload, payload_names,
                                                    part_prep):
@@ -1052,6 +1081,88 @@ class _Executor:
                 probe, sub, lkeys, rkeys, payload, payload_names,
                 jt if c == 0 else "inner", limit, None)
             yield Batch(schema, out.columns, out.row_mask)
+
+    def _probe_outer_residual(self, node: JoinNode, probe: Batch,
+                              build: Batch, payload, payload_names,
+                              prepared, residual_fn,
+                              full_acc) -> Iterator[Batch]:
+        """LEFT/FULL OUTER probe with a residual (join-filter) predicate:
+        a probe row pairs with the build rows whose keys match AND whose
+        residual passes; a probe row with no surviving match is
+        reinstated null-extended (reference LookupJoinOperator +
+        sql/gen/JoinFilterFunctionCompiler.java semantics: the ON filter
+        gates matches, never drops probe rows). ``full_acc`` (FULL only)
+        accumulates the build rows with at least one SURVIVING match for
+        the unmatched-build tail.
+
+        The residual runs only over matched lanes (row_mask = match), so
+        its row-error channel fires exactly for rows the filter really
+        evaluates — identical semantics on every executor."""
+        from ..ops.jitcache import (expand_match_origins_jit,
+                                    unique_match_build_mask_jit)
+        schema = _plan_schema(node)
+        lkeys, rkeys = list(node.left_keys), list(node.right_keys)
+        npro = len(node.left.fields)
+
+        def mark_full(mask):
+            if full_acc is not None:
+                full_acc["m"] = mask if full_acc["m"] is None \
+                    else full_acc["m"] | mask
+
+        if node.build_unique:
+            out = lookup_join_jit(probe, build, lkeys, rkeys, payload,
+                                  payload_names, "left", prepared)
+            match = semi_join_mask_jit(probe, build, lkeys, rkeys,
+                                       False, False, prepared)
+            gated = residual_fn(Batch(schema, out.columns,
+                                      probe.row_mask & match))
+            survived = gated.row_mask
+            cols = list(out.columns[:npro])
+            for c in out.columns[npro:]:
+                cols.append(Column(c.type, c.data,
+                                   c.validity & survived, c.dictionary))
+            if full_acc is not None:
+                mark_full(unique_match_build_mask_jit(
+                    probe, build, lkeys, rkeys, survived, prepared))
+            yield Batch(schema, cols, probe.row_mask)
+            return
+
+        maxk = int(match_count_max_jit(probe, build, lkeys, rkeys,
+                                       prepared))
+        limit = self.SKEW_MATCH_LIMIT
+        if maxk <= limit:
+            subs = [(build, bucket_capacity(max(maxk, 1), minimum=1),
+                     prepared)]
+        else:
+            ranks = build_key_ranks_jit(build, rkeys, prepared)
+            subs = [(Batch(build.schema, build.columns,
+                           build.row_mask & (ranks >= c)
+                           & (ranks < c + limit)), limit, None)
+                    for c in range(0, maxk, limit)]
+        has_survivor = None
+        for sub, k, prep_c in subs:
+            e = expand_join_jit(probe, sub, lkeys, rkeys, payload,
+                                payload_names, "inner", k, prep_c)
+            gated = residual_fn(Batch(schema, e.columns, e.row_mask))
+            survived = gated.row_mask
+            hs = jnp.any(survived.reshape(k, probe.capacity), axis=0)
+            has_survivor = hs if has_survivor is None \
+                else has_survivor | hs
+            if full_acc is not None:
+                orig, _ = expand_match_origins_jit(
+                    probe, sub, lkeys, rkeys, k, prep_c)
+                n = sub.capacity
+                mark_full(jnp.zeros(n, dtype=bool).at[
+                    jnp.where(survived, orig, n)].max(
+                        survived, mode="drop"))
+            yield Batch(schema, e.columns, survived)
+        # reinstate probe rows with no surviving match, null-extended
+        reinstated = self._null_extend(probe, node)
+        yield Batch(schema, reinstated.columns,
+                    probe.row_mask & ~(has_survivor
+                                       if has_survivor is not None
+                                       else jnp.zeros_like(
+                                           probe.row_mask)))
 
     def _null_extend_build(self, build: Batch, node: JoinNode,
                            matched) -> Batch:

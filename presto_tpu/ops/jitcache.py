@@ -191,3 +191,30 @@ def build_summary_jit(build, key_cols, int_flags):
     flush of queued async work, and the previous code paid three (live
     count, direct-table bounds, dynamic-filter bounds)."""
     return _build_summary(tuple(key_cols), tuple(int_flags))(build)
+
+
+from .join import expand_match_origins, unique_match_build_mask  # noqa: E402
+
+
+@functools.lru_cache(maxsize=None)
+def _unique_match_build(pkeys, bkeys):
+    return jax.jit(lambda p, b, s, prep: unique_match_build_mask(
+        p, b, pkeys, bkeys, s, prepared=prep))
+
+
+def unique_match_build_mask_jit(probe, build, probe_keys, build_keys,
+                                survived, prepared):
+    return _unique_match_build(tuple(probe_keys), tuple(build_keys))(
+        probe, build, survived, prepared)
+
+
+@functools.lru_cache(maxsize=None)
+def _expand_origins(pkeys, bkeys, k):
+    return jax.jit(lambda p, b, prep: expand_match_origins(
+        p, b, pkeys, bkeys, k, prepared=prep))
+
+
+def expand_match_origins_jit(probe, build, probe_keys, build_keys,
+                             max_matches, prepared):
+    return _expand_origins(tuple(probe_keys), tuple(build_keys),
+                           max_matches)(probe, build, prepared)

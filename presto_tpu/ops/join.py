@@ -406,3 +406,50 @@ def semi_join_mask(
     build_empty = ~jnp.any(build.row_mask)
     anti = probe.row_mask & pvalid & ~hit & ~build_has_null
     return jnp.where(build_empty, probe.row_mask, anti)
+
+
+def unique_match_build_mask(
+    probe: Batch, build: Batch,
+    probe_keys: Sequence[int], build_keys: Sequence[int],
+    survived: jnp.ndarray,
+    prepared=None,
+) -> jnp.ndarray:
+    """bool[build.capacity] in ORIGINAL build order: build rows whose
+    unique-key match in this probe batch SURVIVED a residual predicate —
+    the FULL OUTER visited-positions bitmap with a join filter applied
+    (reference LookupJoinOperator's OuterPositionTracker +
+    JoinFilterFunctionCompiler: a filtered-out match must not mark the
+    build row as matched)."""
+    prepared = prepared or build_sorted(build, build_keys)
+    s_ops, slive, perm = _split_prepared(prepared)
+    q_ops, pvalid = _key_arrays(probe, probe_keys)
+    pos, hit = _point_lookup(q_ops, prepared)
+    ok = survived & hit & probe.row_mask & pvalid
+    orig = jnp.take(perm, pos, axis=0)
+    n = s_ops[0].shape[0]
+    return jnp.zeros(n, dtype=bool).at[
+        jnp.where(ok, orig, n)].max(ok, mode="drop")
+
+
+def expand_match_origins(
+    probe: Batch, build: Batch,
+    probe_keys: Sequence[int], build_keys: Sequence[int],
+    max_matches: int,
+    prepared=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(orig_build_row, matched) per expand_join output lane, flattened
+    [k * probe.capacity] in the same lane order as expand_join — lets a
+    residual-filtered FULL OUTER join scatter surviving lanes back onto
+    original build rows for the unmatched-tail bitmap."""
+    k = max(1, max_matches)
+    prepared = prepared or build_sorted(build, build_keys)
+    s_ops, slive, perm = _split_prepared(prepared)
+    q_ops, pvalid = _key_arrays(probe, probe_keys)
+    live = probe.row_mask & pvalid
+    lo, hi = _range_lookup(q_ops, prepared)
+    cnt = jnp.where(live, hi - lo, 0)
+    slot = jnp.arange(k)[:, None]
+    pos = jnp.minimum(lo[None, :] + slot, s_ops[0].shape[0] - 1)
+    matched = (slot < cnt[None, :]) & jnp.take(slive, pos, axis=0)
+    orig = jnp.take(perm, pos, axis=0)
+    return orig.reshape(-1), matched.reshape(-1)

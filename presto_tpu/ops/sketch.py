@@ -97,13 +97,6 @@ def hll_update(group_slot: jnp.ndarray, valid: jnp.ndarray,
     return jnp.maximum(regs[:cap * m], 0).reshape(cap, m)
 
 
-def hll_merge(states: jnp.ndarray, group_id: jnp.ndarray,
-              cap: int) -> jnp.ndarray:
-    """Merge state rows [n, m] into [cap, m] by per-bucket max."""
-    return jnp.maximum(
-        jax.ops.segment_max(states, group_id, num_segments=cap), 0)
-
-
 def hll_estimate(registers: jnp.ndarray) -> jnp.ndarray:
     """Bias-corrected cardinality per group from registers [..., m]
     (the standard HLL estimator with the linear-counting small-range

@@ -770,7 +770,13 @@ def _column_distinct(node: PlanNode, idx: int,
         return float(cs.distinct_count) \
             if cs is not None and cs.distinct_count is not None else None
     if isinstance(node, FilterNode):
-        return _column_distinct(node.child, idx, session)
+        # discount by the same selectivity factor _estimate_rows applies
+        # to the filtered ROWS: comparing an undiscounted distinct count
+        # against discounted rows would systematically veto pushes on
+        # filtered probe sides (dropping rows drops distinct values too,
+        # roughly proportionally for non-key predicates)
+        d = _column_distinct(node.child, idx, session)
+        return 0.25 * d if d is not None else None
     if isinstance(node, ProjectNode):
         e = node.exprs[idx]
         if isinstance(e, ir.InputRef):

@@ -13,10 +13,10 @@ from tpch_queries import Q as TPCH_QUERIES
 
 SF = 0.01
 
-DIST_QUERIES = [t for t in TPCH_QUERIES
-                if t[0] in ("q1", "q3", "q4", "q5", "q6", "q10", "q12",
-                            "q13", "q14", "q16", "q17", "q18", "q19",
-                            "q20", "q21", "q22", "q2")]
+#: every TPC-H query the suite carries runs on the mesh — parity with
+#: the local runner is the contract (any exclusion is a bug, not a
+#: configuration)
+DIST_QUERIES = list(TPCH_QUERIES)
 
 
 @pytest.fixture(scope="module")
