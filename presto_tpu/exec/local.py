@@ -620,7 +620,8 @@ class _Executor:
         if b is None:
             return
         specs = [WindowSpec(f.fn, f.args, f.output_type, f.name, f.offset,
-                            f.ignore_order, f.frame) for f in node.functions]
+                            f.ignore_order, f.frame, f.frame_start,
+                            f.frame_end) for f in node.functions]
         keys = [SortKey(k.index, k.ascending, k.nulls_first)
                 for k in node.order_keys]
         out = evaluate_window(b, list(node.partition_indices), keys, specs)

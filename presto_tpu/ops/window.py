@@ -15,8 +15,11 @@ per-row values come from branch-free cumulative/segment ops:
 Rows are returned in (partition, order) order — a valid SQL result order;
 the planner's own ORDER BY, if any, sorts afterwards.
 
-Default frame only (RANGE UNBOUNDED PRECEDING..CURRENT ROW) — explicit
-frames are a follow-up, mirroring FrameInfo.java.
+Explicit frames (ROWS/RANGE BETWEEN <bound> AND <bound>, reference
+operator/window/FrameInfo.java) compute per-row [fs, fe] position spans:
+ROWS bounds are position offsets, RANGE bounds binary-search the
+partition-sorted order key, aggregates answer from cumsum differences,
+and MIN/MAX answer arbitrary spans from sparse range-query tables.
 """
 from __future__ import annotations
 
@@ -47,12 +50,127 @@ class WindowSpec:
     name: str
     offset: int = 1                # lag/lead offset; ntile buckets
     ignore_order: bool = False     # aggregate without ORDER BY: whole part.
-    frame: str = "range"           # "range": frame ends at last peer row;
-                                   # "rows": frame ends at the current row
+    frame: str = "range"           # frame unit: RANGE | ROWS
+    #: frame bounds (kind, offset): unbounded_preceding | preceding |
+    #: current_row | following | unbounded_following (reference
+    #: operator/window/FrameInfo.java)
+    frame_start: Tuple[str, int] = ("unbounded_preceding", 0)
+    frame_end: Tuple[str, int] = ("current_row", 0)
+
+    @property
+    def default_frame(self) -> bool:
+        return (self.frame_start == ("unbounded_preceding", 0)
+                and self.frame_end == ("current_row", 0))
 
 
 def _cummax_int(x: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.associative_scan(jnp.maximum, x)
+
+
+def _bounded_searchsorted(vals: jnp.ndarray, targets: jnp.ndarray,
+                          lo0: jnp.ndarray, hi0: jnp.ndarray,
+                          side: str, ascending: bool) -> jnp.ndarray:
+    """Per-lane binary search with per-lane [lo, hi) bounds: first
+    position p in [lo0_i, hi0_i) whose value passes the boundary test
+    against targets_i (vals sorted within each lane's own bound range —
+    the partition). O(log cap) gathers, branch-free."""
+    cap = vals.shape[0]
+    lo, hi = lo0.astype(jnp.int64), hi0.astype(jnp.int64)
+
+    def body(_, lh):
+        lo, hi = lh
+        mid = (lo + hi) >> 1
+        v = jnp.take(vals, jnp.clip(mid, 0, cap - 1), axis=0)
+        if ascending:
+            go = (v < targets) if side == "left" else (v <= targets)
+        else:
+            go = (v > targets) if side == "left" else (v >= targets)
+        go = go & (lo < hi)
+        return (jnp.where(go, mid + 1, lo), jnp.where(go, hi, mid))
+
+    lo, hi = jax.lax.fori_loop(0, max(cap.bit_length(), 1), body,
+                               (lo, hi))
+    return lo
+
+
+def _rmq_tables(x: jnp.ndarray, op, sentinel) -> jnp.ndarray:
+    """Sparse-table range-min/max: [levels, cap] where level k holds the
+    reduction of [i, i + 2^k) — O(cap log cap) build, O(1) (two gathers)
+    per query. The device answer to arbitrary-frame MIN/MAX windows
+    (reference WindowPartition re-aggregates per row; here every row's
+    frame is answered from the shared table)."""
+    cap = x.shape[0]
+    levels = max(cap.bit_length(), 1)
+    tabs = [x]
+    for k in range(1, levels):
+        shift = 1 << (k - 1)
+        prev = tabs[-1]
+        if shift < cap:
+            shifted = jnp.concatenate(
+                [prev[shift:], jnp.full((shift,), sentinel, prev.dtype)])
+        else:
+            shifted = jnp.full((cap,), sentinel, prev.dtype)
+        tabs.append(op(prev, shifted))
+    return jnp.stack(tabs)
+
+
+def _rmq_query(tabs: jnp.ndarray, op, sentinel, fs: jnp.ndarray,
+               fe: jnp.ndarray) -> jnp.ndarray:
+    """Reduce [fs, fe] per lane from sparse tables; empty -> sentinel."""
+    levels, cap = tabs.shape
+    length = jnp.maximum(fe - fs + 1, 1)
+    k = (jnp.int64(63) - jax.lax.clz(length.astype(jnp.uint64))
+         .astype(jnp.int64))
+    k = jnp.clip(k, 0, levels - 1)
+    flat = tabs.reshape(-1)
+    a = jnp.take(flat, k * cap + jnp.clip(fs, 0, cap - 1), axis=0)
+    b = jnp.take(flat, k * cap
+                 + jnp.clip(fe - (jnp.int64(1) << k) + 1, 0, cap - 1),
+                 axis=0)
+    return jnp.where(fe >= fs, op(a, b), sentinel)
+
+
+def _frame_positions(spec: "WindowSpec", idx, pstart, pend, ostart, oend,
+                     order_vals):
+    """(fs, fe) inclusive frame row-positions per lane for an explicit
+    frame (reference operator/window/FrameInfo.java semantics): ROWS
+    bounds offset by physical positions, RANGE bounds by order-key value
+    (computed with bounded binary searches over the partition-sorted
+    key). fs > fe encodes an empty frame."""
+
+    def one(kind_off, is_start):
+        kind, off = kind_off
+        if kind == "unbounded_preceding":
+            return pstart
+        if kind == "unbounded_following":
+            return pend
+        if spec.frame == "rows":
+            if kind == "current_row":
+                return idx
+            return idx - off if kind == "preceding" else idx + off
+        # RANGE unit
+        if kind == "current_row":
+            return ostart if is_start else oend
+        vals, valid, asc, vstart, vend = order_vals
+        assert vals is not None, \
+            "offset RANGE frame requires one ORDER BY key"
+        delta = jnp.asarray(off, vals.dtype)
+        if kind == "preceding":
+            target = vals - delta if asc else vals + delta
+        else:
+            target = vals + delta if asc else vals - delta
+        side = "left" if is_start else "right"
+        # search only the partition's non-NULL run: NULL rows cluster at
+        # one end of the partition and their payloads are not ordered
+        p = _bounded_searchsorted(vals, target, vstart, vend + 1,
+                                  side, asc)
+        p = p if is_start else p - 1
+        # SQL: a NULL order key's offset frame is its peer run
+        return jnp.where(valid, p, ostart if is_start else oend)
+
+    fs = jnp.maximum(one(spec.frame_start, True), pstart)
+    fe = jnp.minimum(one(spec.frame_end, False), pend)
+    return fs, fe
 
 
 def _reverse_cummin_int(x: jnp.ndarray) -> jnp.ndarray:
@@ -124,6 +242,22 @@ def evaluate_window(
     dense = jnp.cumsum(oboundary.astype(jnp.int64))               # global
     dense_at_pstart = jnp.take(dense, jnp.maximum(pstart, 0))
 
+    # first-order-key context for offset RANGE frames: raw sorted values,
+    # their validity, direction, and each partition's non-NULL run
+    order_ctx = (None, None, True, pstart, pend)
+    if order_by:
+        k0 = order_by[0]
+        ovals = jnp.take(batch.columns[k0.column].data, perm, axis=0)
+        ovalid = jnp.take(batch.columns[k0.column].validity, perm,
+                          axis=0) & mask
+        vfirst = jnp.take(_segment_scan(
+            jnp.where(ovalid, idx, jnp.iinfo(jnp.int64).max), pstart,
+            jnp.minimum), jnp.clip(pend, 0, cap - 1), axis=0)
+        vlast = jnp.take(_segment_scan(
+            jnp.where(ovalid, idx, jnp.int64(-1)), pstart, jnp.maximum),
+            jnp.clip(pend, 0, cap - 1), axis=0)
+        order_ctx = (ovals, ovalid, bool(k0.ascending), vfirst, vlast)
+
     new_cols: List[Column] = []
     fields: List[Tuple[str, Type]] = []
     for i, c in enumerate(batch.columns):
@@ -134,7 +268,7 @@ def evaluate_window(
     for spec in specs:
         data, valid = _one_window(
             spec, s_cols, batch, mask, idx, pstart, pend, psize,
-            row_in_part, ostart, oend, dense, dense_at_pstart)
+            row_in_part, ostart, oend, dense, dense_at_pstart, order_ctx)
         fields.append((spec.name, spec.output_type))
         # String-valued outputs (lag/lead/first/last/nth_value, min/max over
         # varchar) are dictionary codes drawn from the argument column's
@@ -150,9 +284,18 @@ def evaluate_window(
 
 
 def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
-                row_in_part, ostart, oend, dense, dense_at_pstart):
+                row_in_part, ostart, oend, dense, dense_at_pstart,
+                order_ctx):
     fn = spec.fn
     cap = mask.shape[0]
+    # explicit frame positions (ranking functions and lag/lead ignore
+    # frames per the SQL standard)
+    explicit = (not spec.default_frame
+                and fn not in RANKING and fn not in ("lag", "lead"))
+    if explicit:
+        fs, fe = _frame_positions(spec, idx, pstart, pend, ostart, oend,
+                                  order_ctx)
+        frame_nonempty = fe >= fs
     if fn == "row_number":
         return row_in_part + 1, jnp.ones(cap, dtype=bool)
     if fn == "rank":
@@ -189,6 +332,10 @@ def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
                 jnp.take(valid, src, axis=0) & in_part)
     if fn == "first_value":
         data, valid = col(spec.args[0])
+        if explicit:
+            src = jnp.clip(fs, 0, cap - 1)
+            return (jnp.take(data, src, axis=0),
+                    jnp.take(valid, src, axis=0) & frame_nonempty)
         src = jnp.maximum(pstart, 0)
         return jnp.take(data, src, axis=0), jnp.take(valid, src, axis=0)
     # frame end: RANGE frames end at the current row's last peer, ROWS
@@ -197,10 +344,20 @@ def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
 
     if fn == "last_value":
         data, valid = col(spec.args[0])
+        if explicit:
+            src = jnp.clip(fe, 0, cap - 1)
+            return (jnp.take(data, src, axis=0),
+                    jnp.take(valid, src, axis=0) & frame_nonempty)
         src = jnp.clip(frame_end, 0, cap - 1)
         return jnp.take(data, src, axis=0), jnp.take(valid, src, axis=0)
     if fn == "nth_value":
         data, valid = col(spec.args[0])
+        if explicit:
+            src = fs + spec.offset - 1
+            ok = frame_nonempty & (src <= fe)
+            src = jnp.clip(src, 0, cap - 1)
+            return (jnp.take(data, src, axis=0),
+                    jnp.take(valid, src, axis=0) & ok)
         src = pstart + spec.offset - 1
         ok = src <= jnp.minimum(frame_end, pend)
         src = jnp.clip(src, 0, cap - 1)
@@ -240,22 +397,39 @@ def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
         sent = big if fn == "min" else small
         op = jnp.minimum if fn == "min" else jnp.maximum
         xm = jnp.where(valid_in, xdata, sent)
-        run = _segment_scan(xm, pstart, op)
-        upto = _agg_frame_end(spec, frame_end, pend)
-        val = jnp.take(run, jnp.clip(upto, 0, cap - 1), axis=0)
+        if explicit:
+            # arbitrary [fs, fe] frames: sparse-table range queries
+            tabs = _rmq_tables(xm, op, sent)
+            val = _rmq_query(tabs, op, sent, fs, fe)
+            cnt = _frame_count(valid_in, fs, fe)
+        else:
+            run = _segment_scan(xm, pstart, op)
+            upto = _agg_frame_end(spec, frame_end, pend)
+            val = jnp.take(run, jnp.clip(upto, 0, cap - 1), axis=0)
+            cnt = _running_count(valid_in, pstart, upto)
         if is_str:
             # map winning rank back to a dictionary code
             inv = unrank_table(vocab)
             val = jnp.take(inv, jnp.clip(val, 0, inv.shape[0] - 1), axis=0)
-        cnt = _running_count(valid_in, pstart, upto)
         return val, cnt > 0
     # sum / count / avg
     csum = jnp.cumsum(x)
-    base = jnp.where(pstart > 0,
-                     jnp.take(csum, jnp.maximum(pstart - 1, 0), axis=0), zero)
-    upto = _agg_frame_end(spec, frame_end, pend)
-    val = jnp.take(csum, jnp.clip(upto, 0, cap - 1), axis=0) - base
-    cnt = _running_count(valid_in, pstart, upto)
+    if explicit:
+        base = jnp.where(fs > 0,
+                         jnp.take(csum, jnp.clip(fs - 1, 0, cap - 1),
+                                  axis=0), zero)
+        val = jnp.where(
+            fe >= fs,
+            jnp.take(csum, jnp.clip(fe, 0, cap - 1), axis=0) - base,
+            zero)
+        cnt = _frame_count(valid_in, fs, fe)
+    else:
+        base = jnp.where(pstart > 0,
+                         jnp.take(csum, jnp.maximum(pstart - 1, 0),
+                                  axis=0), zero)
+        upto = _agg_frame_end(spec, frame_end, pend)
+        val = jnp.take(csum, jnp.clip(upto, 0, cap - 1), axis=0) - base
+        cnt = _running_count(valid_in, pstart, upto)
     if fn in ("count", "count_star"):
         return val, jnp.ones(cap, dtype=bool)
     if fn == "avg":
@@ -278,6 +452,18 @@ def _running_count(valid_in, pstart, upto):
     base = jnp.where(pstart > 0,
                      jnp.take(csum, jnp.maximum(pstart - 1, 0), axis=0), 0)
     return jnp.take(csum, jnp.clip(upto, 0, cap - 1), axis=0) - base
+
+
+def _frame_count(valid_in, fs, fe):
+    """Valid-row count over explicit [fs, fe] frames (0 when empty)."""
+    cap = valid_in.shape[0]
+    csum = jnp.cumsum(valid_in.astype(jnp.int64))
+    base = jnp.where(fs > 0,
+                     jnp.take(csum, jnp.clip(fs - 1, 0, cap - 1), axis=0),
+                     0)
+    return jnp.where(
+        fe >= fs,
+        jnp.take(csum, jnp.clip(fe, 0, cap - 1), axis=0) - base, 0)
 
 
 def _segment_scan(x, pstart, op):

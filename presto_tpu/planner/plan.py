@@ -201,7 +201,10 @@ class WindowFnSpec:
     name: str
     offset: int = 1                # lag/lead/ntile/nth_value parameter
     ignore_order: bool = False
-    frame: str = "range"           # RANGE (peer-inclusive) | ROWS frame
+    frame: str = "range"           # frame unit: RANGE | ROWS
+    # frame bounds (kind, offset), reference operator/window/FrameInfo.java
+    frame_start: Tuple[str, int] = ("unbounded_preceding", 0)
+    frame_end: Tuple[str, int] = ("current_row", 0)
 
 
 @_one_child

@@ -1094,8 +1094,31 @@ class _Planner:
             for j, w in enumerate(wins):
                 spec = self._window_fn_spec(w, col_of, f"_win{j}",
                                             bool(order_by))
-                if w.frame != "range":
-                    spec = dataclasses.replace(spec, frame=w.frame)
+                if (w.frame != "range"
+                        or w.frame_start != ("unbounded_preceding", 0)
+                        or w.frame_end != ("current_row", 0)):
+                    if (w.frame == "range"
+                            and (w.frame_start[0] in ("preceding",
+                                                      "following")
+                                 or w.frame_end[0] in ("preceding",
+                                                       "following"))):
+                        if len(order_by) != 1:
+                            raise AnalysisError(
+                                "RANGE frames with offsets require "
+                                "exactly one ORDER BY key")
+                        key_t = col_of(order_by[0].key)[1]
+                        if not isinstance(key_t, (
+                                T.BigintType, T.IntegerType,
+                                T.SmallintType, T.TinyintType,
+                                T.DoubleType, T.RealType, T.DateType,
+                                T.DecimalType)):
+                            raise AnalysisError(
+                                "RANGE frames with offsets require a "
+                                "numeric or date ORDER BY key, got "
+                                f"{key_t.display()}")
+                    spec = dataclasses.replace(
+                        spec, frame=w.frame, frame_start=w.frame_start,
+                        frame_end=w.frame_end)
                 fn_specs.append(spec)
                 out_fields.append(Field(spec.name, spec.output_type))
             if extra_exprs:

@@ -202,12 +202,16 @@ class Lambda(Expression):
 
 @dataclasses.dataclass(frozen=True)
 class WindowFunction(Expression):
-    """fn(...) OVER (PARTITION BY ... ORDER BY ...) (reference
-    sql/tree/FunctionCall window + Window.java)."""
+    """fn(...) OVER (PARTITION BY ... ORDER BY ... [frame]) (reference
+    sql/tree/FunctionCall window + Window.java + WindowFrame.java).
+    Frame bounds are (kind, offset) with kind in unbounded_preceding |
+    preceding | current_row | following | unbounded_following."""
     call: "FunctionCall"
     partition_by: Tuple[Expression, ...] = ()
     order_by: Tuple["SortItem", ...] = ()
-    frame: str = "range"           # RANGE (peer-inclusive) | ROWS frame kind
+    frame: str = "range"           # frame unit: RANGE | ROWS
+    frame_start: Tuple[str, int] = ("unbounded_preceding", 0)
+    frame_end: Tuple[str, int] = ("current_row", 0)
 
 
 @dataclasses.dataclass(frozen=True)

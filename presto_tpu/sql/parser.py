@@ -973,25 +973,61 @@ class _Parser:
             while self.accept_op(","):
                 partition.append(self.expression())
         order_by = self._order_by()
-        # UNBOUNDED PRECEDING .. CURRENT ROW frames only (the default frame
-        # shape); RANGE ends at the last peer row, ROWS at the current row
-        # (reference operator/window/FrameInfo.java distinguishes these).
+        # full frame grammar (reference operator/window/FrameInfo.java):
+        # ROWS|RANGE [BETWEEN] <bound> [AND <bound>], bounds = UNBOUNDED
+        # PRECEDING | <n> PRECEDING | CURRENT ROW | <n> FOLLOWING |
+        # UNBOUNDED FOLLOWING. Default: RANGE UNBOUNDED..CURRENT ROW.
         frame = "range"
+        fstart = ("unbounded_preceding", 0)
+        fend = ("current_row", 0)
         if self.at_kw("rows", "range"):
             frame = "rows" if self.at_kw("rows") else "range"
             self.next()
             if self.accept_kw("between"):
-                self.expect_kw("unbounded")
-                self.expect_kw("preceding")
+                fstart = self._frame_bound()
                 self.expect_kw("and")
-                self.expect_kw("current")
-                self.expect_kw("row")
+                fend = self._frame_bound()
             else:
-                # frame-start-only spelling: "ROWS UNBOUNDED PRECEDING"
-                self.expect_kw("unbounded")
-                self.expect_kw("preceding")
+                # frame-start-only spelling: end defaults to CURRENT ROW
+                fstart = self._frame_bound()
+            t = self.peek()
+            if fstart[0] == "unbounded_following":
+                raise SqlSyntaxError(
+                    "frame start cannot be UNBOUNDED FOLLOWING",
+                    t.line, t.col)
+            if fend[0] == "unbounded_preceding":
+                raise SqlSyntaxError(
+                    "frame end cannot be UNBOUNDED PRECEDING",
+                    t.line, t.col)
+            order_rank = {"unbounded_preceding": 0, "preceding": 1,
+                          "current_row": 2, "following": 3,
+                          "unbounded_following": 4}
+            if order_rank[fstart[0]] > order_rank[fend[0]]:
+                raise SqlSyntaxError("frame start cannot follow frame end",
+                                     t.line, t.col)
         self.expect_op(")")
-        return A.WindowFunction(call, tuple(partition), order_by, frame)
+        return A.WindowFunction(call, tuple(partition), order_by, frame,
+                                fstart, fend)
+
+    def _frame_bound(self) -> tuple:
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return ("unbounded_preceding", 0)
+            self.expect_kw("following")
+            return ("unbounded_following", 0)
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ("current_row", 0)
+        tok = self.peek()
+        if tok.kind != "INTEGER":
+            raise SqlSyntaxError("frame offset must be an integer literal",
+                                 tok.line, tok.col)
+        n = int(tok.text)
+        self.next()
+        if self.accept_kw("preceding"):
+            return ("preceding", n)
+        self.expect_kw("following")
+        return ("following", n)
 
     def _try_lambda_params(self) -> Optional[Tuple[str, ...]]:
         """Consume '(a, b, ...) ->' if present; None (no consumption)
