@@ -165,6 +165,10 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
     from .exec.runner import LocalRunner
     from .server.protocol import PrestoTpuServer
     cfg = load_node_config(etc_dir)
+    # plugins install connector factories / functions BEFORE catalogs
+    # mount (reference PrestoServer.run: loadPlugins then catalog store)
+    from .plugin import load_plugins_from_config
+    load_plugins_from_config(cfg.props)
     catalogs = load_catalogs(etc_dir)
     runner = LocalRunner(catalogs=catalogs, catalog=cfg.catalog,
                          schema=cfg.schema)

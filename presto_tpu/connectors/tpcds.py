@@ -53,6 +53,22 @@ STATES = ("TN", "TN", "TN", "TN", "TN", "TN", "AL", "GA", "KY", "NC",
           "OH", "TX", "VA", "MO", "SC")   # TN-heavy like dsdgen defaults
 CATEGORIES = ("Books", "Children", "Electronics", "Home", "Jewelry",
               "Men", "Music", "Shoes", "Sports", "Women")
+CITIES = ("Midway", "Fairview", "Oak Grove", "Five Points", "Centerville",
+          "Liberty", "Pleasant Hill", "Riverside", "Salem", "Union",
+          "Greenville", "Bethel", "Springfield", "Clinton", "Marion")
+COUNTIES = ("Williamson County", "Walker County", "Ziebach County",
+            "Franklin Parish", "Luce County", "Richland County",
+            "Bronx County", "Orange County", "Maverick County",
+            "Mobile County")
+BUY_POTENTIAL = ("0-500", "501-1000", "1001-5000", "5001-10000",
+                 ">10000", "Unknown")
+FIRST_NAMES = ("James", "Mary", "John", "Patricia", "Robert", "Jennifer",
+               "Michael", "Linda", "William", "Elizabeth", "David",
+               "Barbara", "Richard", "Susan", "Joseph", "Jessica")
+LAST_NAMES = ("Smith", "Johnson", "Williams", "Brown", "Jones", "Garcia",
+              "Miller", "Davis", "Rodriguez", "Martinez", "Hernandez",
+              "Lopez", "Gonzalez", "Wilson", "Anderson", "Thomas")
+MEAL_TIMES = ("breakfast", "lunch", "dinner", "")
 
 
 def _rows(table: str, sf: float) -> int:
@@ -66,15 +82,28 @@ def _rows(table: str, sf: float) -> int:
         return max(1, int(12 * max(sf, 1) ** 0.5))
     if table == "customer_demographics":
         return 1_920_800     # fixed cross-product (spec)
+    if table == "customer":
+        return max(1, int(100_000 * max(sf, 1) ** 0.5))
+    if table == "customer_address":
+        return max(1, int(50_000 * max(sf, 1) ** 0.5))
+    if table == "household_demographics":
+        return 7_200         # fixed cross-product (spec)
+    if table == "promotion":
+        return max(1, int(300 * max(sf, 1) ** 0.5))
+    if table == "time_dim":
+        return 86_400        # one row per second of day (spec)
     raise KeyError(table)
 
 
 V = T.VARCHAR
 _SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
     "store_sales": [
-        ("ss_sold_date_sk", T.BIGINT), ("ss_item_sk", T.BIGINT),
+        ("ss_sold_date_sk", T.BIGINT), ("ss_sold_time_sk", T.BIGINT),
+        ("ss_item_sk", T.BIGINT),
         ("ss_customer_sk", T.BIGINT), ("ss_cdemo_sk", T.BIGINT),
-        ("ss_store_sk", T.BIGINT), ("ss_ticket_number", T.BIGINT),
+        ("ss_hdemo_sk", T.BIGINT), ("ss_addr_sk", T.BIGINT),
+        ("ss_store_sk", T.BIGINT), ("ss_promo_sk", T.BIGINT),
+        ("ss_ticket_number", T.BIGINT),
         ("ss_quantity", T.INTEGER), ("ss_wholesale_cost", T.DOUBLE),
         ("ss_list_price", T.DOUBLE), ("ss_sales_price", T.DOUBLE),
         ("ss_ext_sales_price", T.DOUBLE), ("ss_coupon_amt", T.DOUBLE),
@@ -95,8 +124,10 @@ _SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
     ],
     "store": [
         ("s_store_sk", T.BIGINT), ("s_store_id", T.varchar(16)),
-        ("s_store_name", T.varchar(50)), ("s_state", T.varchar(2)),
-        ("s_number_employees", T.INTEGER),
+        ("s_store_name", T.varchar(50)), ("s_city", T.varchar(60)),
+        ("s_county", T.varchar(30)), ("s_state", T.varchar(2)),
+        ("s_zip", T.varchar(10)), ("s_number_employees", T.INTEGER),
+        ("s_gmt_offset", T.DOUBLE),
     ],
     "customer_demographics": [
         ("cd_demo_sk", T.BIGINT), ("cd_gender", T.varchar(1)),
@@ -107,6 +138,38 @@ _SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
         ("cd_dep_count", T.INTEGER),
         ("cd_dep_employed_count", T.INTEGER),
         ("cd_dep_college_count", T.INTEGER),
+    ],
+    "customer": [
+        ("c_customer_sk", T.BIGINT), ("c_customer_id", T.varchar(16)),
+        ("c_current_cdemo_sk", T.BIGINT),
+        ("c_current_hdemo_sk", T.BIGINT),
+        ("c_current_addr_sk", T.BIGINT),
+        ("c_first_name", T.varchar(20)), ("c_last_name", T.varchar(30)),
+        ("c_preferred_cust_flag", T.varchar(1)),
+        ("c_birth_year", T.INTEGER),
+    ],
+    "customer_address": [
+        ("ca_address_sk", T.BIGINT), ("ca_address_id", T.varchar(16)),
+        ("ca_city", T.varchar(60)), ("ca_county", T.varchar(30)),
+        ("ca_state", T.varchar(2)), ("ca_zip", T.varchar(10)),
+        ("ca_country", T.varchar(20)), ("ca_gmt_offset", T.DOUBLE),
+    ],
+    "household_demographics": [
+        ("hd_demo_sk", T.BIGINT), ("hd_income_band_sk", T.BIGINT),
+        ("hd_buy_potential", T.varchar(15)), ("hd_dep_count", T.INTEGER),
+        ("hd_vehicle_count", T.INTEGER),
+    ],
+    "promotion": [
+        ("p_promo_sk", T.BIGINT), ("p_promo_id", T.varchar(16)),
+        ("p_channel_dmail", T.varchar(1)),
+        ("p_channel_email", T.varchar(1)),
+        ("p_channel_event", T.varchar(1)),
+        ("p_channel_tv", T.varchar(1)),
+    ],
+    "time_dim": [
+        ("t_time_sk", T.BIGINT), ("t_time", T.INTEGER),
+        ("t_hour", T.INTEGER), ("t_minute", T.INTEGER),
+        ("t_second", T.INTEGER), ("t_meal_time", T.varchar(20)),
     ],
 }
 
@@ -125,7 +188,10 @@ class _Gen:
         self.n_item = _rows("item", sf)
         self.n_store = _rows("store", sf)
         self.n_demo = _rows("customer_demographics", sf)
-        self.n_cust = max(1, int(100_000 * max(sf, 1) ** 0.5))
+        self.n_cust = _rows("customer", sf)
+        self.n_addr = _rows("customer_address", sf)
+        self.n_hdemo = _rows("household_demographics", sf)
+        self.n_promo = _rows("promotion", sf)
 
     # ---- store_sales (fact; key = row id) ----
     def store_sales(self, key: np.ndarray, cols: Sequence[str]):
@@ -156,6 +222,20 @@ class _Gen:
             elif c == "ss_store_sk":
                 out[c] = (1 + (_h(key, 209)
                                % _U64(self.n_store)).astype(np.int64), None)
+            elif c == "ss_sold_time_sk":
+                out[c] = ((_h(key, 210)
+                           % _U64(86_400)).astype(np.int64), None)
+            elif c == "ss_hdemo_sk":
+                out[c] = (1 + (_h(key, 211)
+                               % _U64(self.n_hdemo)).astype(np.int64),
+                          None)
+            elif c == "ss_addr_sk":
+                out[c] = (1 + (_h(key, 212)
+                               % _U64(self.n_addr)).astype(np.int64), None)
+            elif c == "ss_promo_sk":
+                out[c] = (1 + (_h(key, 213)
+                               % _U64(self.n_promo)).astype(np.int64),
+                          None)
             elif c == "ss_ticket_number":
                 out[c] = (1 + (key.astype(np.int64) - 1) // 8, None)
             elif c == "ss_quantity":
@@ -262,6 +342,19 @@ class _Gen:
             elif c == "s_number_employees":
                 out[c] = (_randint(key, 233, 200, 300).astype(np.int32),
                           None)
+            elif c == "s_city":
+                out[c] = ((_h(key, 234)
+                           % _U64(len(CITIES))).astype(np.int32), CITIES)
+            elif c == "s_county":
+                out[c] = ((_h(key, 235)
+                           % _U64(len(COUNTIES))).astype(np.int32),
+                          COUNTIES)
+            elif c == "s_zip":
+                zips = 10000 + (_h(key, 236) % _U64(90000)).astype(np.int64)
+                out[c] = ([str(z) for z in zips], "text")
+            elif c == "s_gmt_offset":
+                out[c] = (np.where(_h(key, 237) % _U64(2) == 0,
+                                   -5.0, -6.0), None)
             else:
                 raise KeyError(c)
         return out
@@ -303,6 +396,148 @@ class _Gen:
                 out[c] = (dep_emp.astype(np.int32), None)
             elif c == "cd_dep_college_count":
                 out[c] = (dep_col.astype(np.int32), None)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- customer ----
+    def customer(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        for c in cols:
+            if c == "c_customer_sk":
+                out[c] = (key.astype(np.int64), None)
+            elif c == "c_customer_id":
+                out[c] = ([f"AAAAAAAA{i:08d}" for i in key], "text")
+            elif c == "c_current_cdemo_sk":
+                out[c] = (1 + (_h(key, 241)
+                               % _U64(self.n_demo)).astype(np.int64), None)
+            elif c == "c_current_hdemo_sk":
+                out[c] = (1 + (_h(key, 242)
+                               % _U64(self.n_hdemo)).astype(np.int64),
+                          None)
+            elif c == "c_current_addr_sk":
+                out[c] = (1 + (_h(key, 243)
+                               % _U64(self.n_addr)).astype(np.int64), None)
+            elif c == "c_first_name":
+                out[c] = ((_h(key, 244)
+                           % _U64(len(FIRST_NAMES))).astype(np.int32),
+                          FIRST_NAMES)
+            elif c == "c_last_name":
+                out[c] = ((_h(key, 245)
+                           % _U64(len(LAST_NAMES))).astype(np.int32),
+                          LAST_NAMES)
+            elif c == "c_preferred_cust_flag":
+                out[c] = ((_h(key, 246) % _U64(2)).astype(np.int32),
+                          ("N", "Y"))
+            elif c == "c_birth_year":
+                out[c] = (_randint(key, 247, 1924, 1992).astype(np.int32),
+                          None)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- customer_address ----
+    def customer_address(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        for c in cols:
+            if c == "ca_address_sk":
+                out[c] = (key.astype(np.int64), None)
+            elif c == "ca_address_id":
+                out[c] = ([f"AAAAAAAA{i:08d}" for i in key], "text")
+            elif c == "ca_city":
+                out[c] = ((_h(key, 251)
+                           % _U64(len(CITIES))).astype(np.int32), CITIES)
+            elif c == "ca_county":
+                out[c] = ((_h(key, 252)
+                           % _U64(len(COUNTIES))).astype(np.int32),
+                          COUNTIES)
+            elif c == "ca_state":
+                uniq = tuple(dict.fromkeys(STATES))
+                out[c] = ((_h(key, 253)
+                           % _U64(len(uniq))).astype(np.int32), uniq)
+            elif c == "ca_zip":
+                zips = 10000 + (_h(key, 254) % _U64(90000)).astype(np.int64)
+                out[c] = ([str(z) for z in zips], "text")
+            elif c == "ca_country":
+                out[c] = (np.zeros(len(key), dtype=np.int32),
+                          ("United States",))
+            elif c == "ca_gmt_offset":
+                out[c] = (np.where(_h(key, 255) % _U64(2) == 0,
+                                   -5.0, -6.0), None)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- household_demographics (cross-product, spec encoding) ----
+    def household_demographics(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        i = key.astype(np.int64) - 1
+        inc = i % 20
+        i2 = i // 20
+        bp = i2 % len(BUY_POTENTIAL)
+        i3 = i2 // len(BUY_POTENTIAL)
+        dep = i3 % 10
+        veh = (i3 // 10) % 6
+        for c in cols:
+            if c == "hd_demo_sk":
+                out[c] = (key.astype(np.int64), None)
+            elif c == "hd_income_band_sk":
+                out[c] = ((inc + 1).astype(np.int64), None)
+            elif c == "hd_buy_potential":
+                out[c] = (bp.astype(np.int32), BUY_POTENTIAL)
+            elif c == "hd_dep_count":
+                out[c] = (dep.astype(np.int32), None)
+            elif c == "hd_vehicle_count":
+                out[c] = ((veh - 1).astype(np.int32), None)  # -1..4 (spec)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- promotion ----
+    def promotion(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        yn = ("N", "Y")
+        for c in cols:
+            if c == "p_promo_sk":
+                out[c] = (key.astype(np.int64), None)
+            elif c == "p_promo_id":
+                out[c] = ([f"AAAAAAAA{i:08d}" for i in key], "text")
+            elif c == "p_channel_dmail":
+                out[c] = ((_h(key, 261) % _U64(2)).astype(np.int32), yn)
+            elif c == "p_channel_email":
+                out[c] = ((_h(key, 262) % _U64(10) == 0)
+                          .astype(np.int32), yn)
+            elif c == "p_channel_event":
+                out[c] = ((_h(key, 263) % _U64(10) == 0)
+                          .astype(np.int32), yn)
+            elif c == "p_channel_tv":
+                out[c] = ((_h(key, 264) % _U64(2)).astype(np.int32), yn)
+            else:
+                raise KeyError(c)
+        return out
+
+    # ---- time_dim (key = 1..86400; second of day = key - 1) ----
+    def time_dim(self, key: np.ndarray, cols: Sequence[str]):
+        out = {}
+        sec = key.astype(np.int64) - 1
+        hour = sec // 3600
+        for c in cols:
+            if c == "t_time_sk":
+                out[c] = (sec, None)          # spec: sk == second of day
+            elif c == "t_time":
+                out[c] = (sec.astype(np.int32), None)
+            elif c == "t_hour":
+                out[c] = (hour.astype(np.int32), None)
+            elif c == "t_minute":
+                out[c] = (((sec // 60) % 60).astype(np.int32), None)
+            elif c == "t_second":
+                out[c] = ((sec % 60).astype(np.int32), None)
+            elif c == "t_meal_time":
+                mt = np.full(len(key), 3, dtype=np.int32)
+                mt = np.where((hour >= 6) & (hour <= 9), 0, mt)
+                mt = np.where((hour >= 11) & (hour <= 13), 1, mt)
+                mt = np.where((hour >= 17) & (hour <= 20), 2, mt)
+                out[c] = (mt, MEAL_TIMES)
             else:
                 raise KeyError(c)
         return out
@@ -355,6 +590,11 @@ class _Metadata(ConnectorMetadata):
         "item": ("i_item_sk",),
         "store": ("s_store_sk",),
         "customer_demographics": ("cd_demo_sk",),
+        "customer": ("c_customer_sk",),
+        "customer_address": ("ca_address_sk",),
+        "household_demographics": ("hd_demo_sk",),
+        "promotion": ("p_promo_sk",),
+        "time_dim": ("t_time_sk",),
     }
 
     def table_stats(self, table: TableHandle) -> TableStats:

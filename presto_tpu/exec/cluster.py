@@ -120,7 +120,11 @@ class ClusterMemoryManager:
         totals: Dict[str, int] = {}
         for url in self.runner.detector.active():
             try:
-                info = self.runner._request(f"{url}/v1/info")
+                # single attempt, short timeout: the next 0.5s poll is
+                # the retry, and enforcement must not stall on a worker
+                # the failure detector hasn't evicted yet
+                info = self.runner._request(f"{url}/v1/info",
+                                            retries=0, timeout=5)
             except Exception:
                 continue
             for qid, b in info.get("queryMemory", {}).items():
@@ -136,7 +140,8 @@ class ClusterMemoryManager:
         for url in list(self.runner.worker_urls):
             try:
                 self.runner._request(f"{url}/v1/query/{victim}",
-                                     method="DELETE")
+                                     method="DELETE", retries=0,
+                                     timeout=5)
             except Exception:
                 continue
 
@@ -188,14 +193,51 @@ class ClusterRunner:
         return self._current_urls()
 
     # -- HTTP helpers --------------------------------------------------------
+    #: transient-failure budget for one remote-task call (reference
+    #: server/remotetask/RequestErrorTracker.java wraps every remote-task
+    #: request in retry-with-backoff; one socket blip must not fail a
+    #: query with healthy workers)
+    REQUEST_RETRIES = 4
+    REQUEST_BACKOFF_S = 0.1
+
     def _request(self, url: str, method: str = "GET",
-                 body: Optional[dict] = None) -> dict:
+                 body: Optional[dict] = None,
+                 retries: Optional[int] = None,
+                 timeout: float = 60) -> dict:
+        """Remote-task HTTP with retry/backoff. Retrying is safe because
+        every mutating endpoint is idempotent (task PUT is an upsert on
+        the worker, DELETE/abort tolerate repeats). Latency-sensitive
+        callers (the memory manager's poll/kill loop) pass retries=0 —
+        their next poll IS the retry."""
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            return json.loads(resp.read() or b"{}")
+        budget = self.REQUEST_RETRIES if retries is None else retries
+        last: Optional[Exception] = None
+        for attempt in range(budget + 1):
+            if attempt:
+                time.sleep(self.REQUEST_BACKOFF_S * (2 ** (attempt - 1)))
+            req = urllib.request.Request(url, data=data, method=method)
+            if data is not None:
+                req.add_header("Content-Type", "application/json")
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                if e.code >= 500 and attempt < budget:
+                    last = e
+                    continue
+                raise
+            except (urllib.error.URLError, ConnectionError,
+                    TimeoutError) as e:
+                # transport-level failure: retry with backoff; the
+                # heartbeat failure detector owns the
+                # permanently-dead-worker verdict
+                last = e
+                if attempt >= budget:
+                    break
+                continue
+        raise QueryFailedError(
+            f"remote task request failed after "
+            f"{budget + 1} attempts: {url}: {last}")
 
     # -- public API ----------------------------------------------------------
     def execute(self, sql: str) -> QueryResult:

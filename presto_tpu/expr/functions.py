@@ -283,17 +283,23 @@ def _string_compare(a: Val, b: Val, op: str) -> Val:
                 return Val(d if op == "eq" else ~d, valid, T.BOOLEAN)
             rank = vocab_table(
                 a.dictionary,
-                lambda s, order=sorted(a.dictionary): order.index(s),
+                lambda s, order=sorted(a.dictionary): (
+                    order.index(s) if s in order else -1),
                 np.int32,
             )
             ra, rb = _code_gather(rank, a.data), _code_gather(rank, b.data)
             d = {"lt": ra < rb, "le": ra <= rb, "gt": ra > rb, "ge": ra >= rb}[op]
             return Val(d, valid, T.BOOLEAN)
         # different vocabularies: build a shared ordering at trace time
+        # (the -1 sentinel slot probes with "", which need not be a
+        # member — rank -1 compares like nothing real but the slot is
+        # masked by validity anyway)
         merged = sorted(set(a.dictionary) | set(b.dictionary))
         order = {s: i for i, s in enumerate(merged)}
-        ta = vocab_table(a.dictionary, lambda s: order[s], np.int64)
-        tb = vocab_table(b.dictionary, lambda s: order[s], np.int64)
+        ta = vocab_table(a.dictionary, lambda s: order.get(s, -1),
+                         np.int64)
+        tb = vocab_table(b.dictionary, lambda s: order.get(s, -1),
+                         np.int64)
         ra, rb = _code_gather(ta, a.data), _code_gather(tb, b.data)
         d = {"eq": ra == rb, "ne": ra != rb, "lt": ra < rb,
              "le": ra <= rb, "gt": ra > rb, "ge": ra >= rb}[op]
@@ -305,6 +311,10 @@ def _string_compare(a: Val, b: Val, op: str) -> Val:
 
 FunctionImpl = Callable[[List[Val], Type], Val]
 _REGISTRY: Dict[str, FunctionImpl] = {}
+#: plugin-provided return-type inference, name -> (arg_types) -> Type
+#: (the Plugin.getFunctions surface; reference spi/Plugin.java:33-78 +
+#: metadata/FunctionRegistry registration)
+_EXTERNAL_SIGNATURES: Dict[str, Callable[[List[Type]], Type]] = {}
 
 
 def register(name: str):
@@ -312,6 +322,16 @@ def register(name: str):
         _REGISTRY[name] = fn
         return fn
     return deco
+
+
+def register_external(name: str, impl: FunctionImpl,
+                      infer: Callable[[List[Type]], Type]) -> None:
+    """Register a plugin scalar function: device kernel + return-type
+    inference. The kernel receives (args: List[Val], out_type) and must
+    be jax-traceable like every builtin."""
+    key = name.lower()
+    _REGISTRY[key] = impl
+    _EXTERNAL_SIGNATURES[key] = infer
 
 
 def lookup(name: str) -> FunctionImpl:
@@ -1301,4 +1321,6 @@ def infer_call_type(name: str, arg_types: List[Type]) -> Type:
         return T.VARCHAR
     if name == "length":
         return T.BIGINT
+    if name in _EXTERNAL_SIGNATURES:
+        return _EXTERNAL_SIGNATURES[name](list(arg_types))
     raise KeyError(f"unknown function {name!r}")

@@ -93,14 +93,20 @@ def _bounded_searchsorted(vals: jnp.ndarray, targets: jnp.ndarray,
     return lo
 
 
-def _rmq_tables(x: jnp.ndarray, op, sentinel) -> jnp.ndarray:
+def _rmq_tables(x: jnp.ndarray, op, sentinel,
+                max_width: Optional[int] = None) -> jnp.ndarray:
     """Sparse-table range-min/max: [levels, cap] where level k holds the
     reduction of [i, i + 2^k) — O(cap log cap) build, O(1) (two gathers)
     per query. The device answer to arbitrary-frame MIN/MAX windows
     (reference WindowPartition re-aggregates per row; here every row's
-    frame is answered from the shared table)."""
+    frame is answered from the shared table). ``max_width`` (a static
+    bound on any queried frame length, e.g. from constant ROWS offsets)
+    caps the level count — an unbounded table at 2^26 rows would cost
+    ~levels x cap x 8B of HBM for levels no query ever touches."""
     cap = x.shape[0]
     levels = max(cap.bit_length(), 1)
+    if max_width is not None:
+        levels = min(levels, max(int(max_width).bit_length(), 1))
     tabs = [x]
     for k in range(1, levels):
         shift = 1 << (k - 1)
@@ -151,10 +157,13 @@ def _frame_positions(spec: "WindowSpec", idx, pstart, pend, ostart, oend,
         # RANGE unit
         if kind == "current_row":
             return ostart if is_start else oend
-        vals, valid, asc, vstart, vend = order_vals
+        vals, valid, asc, vstart, vend, key_scale = order_vals
         assert vals is not None, \
             "offset RANGE frame requires one ORDER BY key"
-        delta = jnp.asarray(off, vals.dtype)
+        # DECIMAL order keys store scaled integers: the literal offset
+        # scales by 10^scale so `price RANGE 10 PRECEDING` means 10.00,
+        # not 0.10 (reference FrameInfo applies offsets in VALUE space)
+        delta = jnp.asarray(off * key_scale, vals.dtype)
         if kind == "preceding":
             target = vals - delta if asc else vals + delta
         else:
@@ -243,10 +252,14 @@ def evaluate_window(
     dense_at_pstart = jnp.take(dense, jnp.maximum(pstart, 0))
 
     # first-order-key context for offset RANGE frames: raw sorted values,
-    # their validity, direction, and each partition's non-NULL run
-    order_ctx = (None, None, True, pstart, pend)
+    # their validity, direction, each partition's non-NULL run, and the
+    # key's decimal scale factor (offsets are given in VALUE space)
+    order_ctx = (None, None, True, pstart, pend, 1)
     if order_by:
         k0 = order_by[0]
+        k0_t = batch.columns[k0.column].type
+        key_scale = (10 ** k0_t.scale
+                     if isinstance(k0_t, T.DecimalType) else 1)
         ovals = jnp.take(batch.columns[k0.column].data, perm, axis=0)
         ovalid = jnp.take(batch.columns[k0.column].validity, perm,
                           axis=0) & mask
@@ -256,7 +269,8 @@ def evaluate_window(
         vlast = jnp.take(_segment_scan(
             jnp.where(ovalid, idx, jnp.int64(-1)), pstart, jnp.maximum),
             jnp.clip(pend, 0, cap - 1), axis=0)
-        order_ctx = (ovals, ovalid, bool(k0.ascending), vfirst, vlast)
+        order_ctx = (ovals, ovalid, bool(k0.ascending), vfirst, vlast,
+                     key_scale)
 
     new_cols: List[Column] = []
     fields: List[Tuple[str, Type]] = []
@@ -398,8 +412,14 @@ def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
         op = jnp.minimum if fn == "min" else jnp.maximum
         xm = jnp.where(valid_in, xdata, sent)
         if explicit:
-            # arbitrary [fs, fe] frames: sparse-table range queries
-            tabs = _rmq_tables(xm, op, sent)
+            # arbitrary [fs, fe] frames: sparse-table range queries;
+            # constant ROWS offsets statically bound the frame width
+            max_width = None
+            if spec.frame == "rows" and \
+                    spec.frame_start[0] != "unbounded_preceding" and \
+                    spec.frame_end[0] != "unbounded_following":
+                max_width = spec.frame_start[1] + spec.frame_end[1] + 1
+            tabs = _rmq_tables(xm, op, sent, max_width)
             val = _rmq_query(tabs, op, sent, fs, fe)
             cnt = _frame_count(valid_in, fs, fe)
         else:

@@ -265,6 +265,8 @@ def _estimate_rows(node: PlanNode, session: Session) -> float:
         return 0.25 * _estimate_rows(node.child, session)
     if isinstance(node, (ProjectNode, SortNode)):
         return _estimate_rows(node.child, session)
+    if isinstance(node, AggregationNode) and not node.group_indices:
+        return 1.0        # global aggregate: exactly one row
     if isinstance(node, (AggregationNode, DistinctNode)):
         return max(1.0, 0.1 * _estimate_rows(node.child, session))
     if isinstance(node, (TopNNode, LimitNode)):

@@ -471,6 +471,13 @@ class WorkerServer:
         self.httpd.shutdown()
 
     def create_task(self, task_id: str, doc: dict) -> Task:
+        # idempotent: the coordinator's transport retries task PUTs, so
+        # a re-delivered create must return the existing task instead of
+        # spawning a duplicate executor over the same splits (reference
+        # SqlTaskManager.updateTask is an upsert keyed by TaskId)
+        existing = self.tasks.get(task_id)
+        if existing is not None:
+            return existing
         task = Task(task_id, doc, self.catalogs)
         self.tasks[task_id] = task
         task.start()

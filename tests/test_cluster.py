@@ -259,3 +259,53 @@ def test_plan_codec_roundtrip():
         plan = lr.plan(sql)
         assert decode(json.loads(json.dumps(encode(plan.root)))) \
             == plan.root
+
+
+def test_request_retries_transient_failures(monkeypatch):
+    """One transient socket error must not fail the query: _request
+    retries with backoff (reference server/remotetask/
+    RequestErrorTracker.java)."""
+    import urllib.error
+
+    from presto_tpu.exec.cluster import ClusterRunner
+
+    calls = {"n": 0}
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return b'{"ok": true}'
+
+    def flaky_open(req, timeout=None):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise urllib.error.URLError("connection refused")
+        return _Resp()
+
+    runner = ClusterRunner.__new__(ClusterRunner)  # no workers needed
+    monkeypatch.setattr("urllib.request.urlopen", flaky_open)
+    monkeypatch.setattr(ClusterRunner, "REQUEST_BACKOFF_S", 0.001)
+    out = runner._request("http://127.0.0.1:1/v1/task/x")
+    assert out == {"ok": True} and calls["n"] == 3
+
+
+def test_request_gives_up_after_budget(monkeypatch):
+    import urllib.error
+
+    import pytest as _pytest
+
+    from presto_tpu.exec.cluster import ClusterRunner, QueryFailedError
+
+    def always_down(req, timeout=None):
+        raise urllib.error.URLError("connection refused")
+
+    runner = ClusterRunner.__new__(ClusterRunner)
+    monkeypatch.setattr("urllib.request.urlopen", always_down)
+    monkeypatch.setattr(ClusterRunner, "REQUEST_BACKOFF_S", 0.001)
+    with _pytest.raises(QueryFailedError, match="after 5 attempts"):
+        runner._request("http://127.0.0.1:1/v1/task/x")

@@ -43,6 +43,14 @@ def oracle(runner):
                 rows = [tuple(_sql_val(v) for v in r) for r in b.to_pylist()]
                 conn.executemany(
                     f"insert into {t} values ({placeholders})", rows)
+    # join-key indexes: SQLite's nested-loop planner needs them for the
+    # star joins and the big OR-of-conjuncts queries (q13/q48) to run in
+    # test time
+    for t in TABLES:
+        for col in tpcds_schema(t).names:
+            if col.endswith("_sk"):
+                conn.execute(
+                    f"create index idx_{t}_{col} on {t} ({col})")
     conn.commit()
     return conn
 
@@ -197,3 +205,15 @@ def test_grouping_sets(runner, oracle):
         )
         order by s_state nulls last, s_store_name nulls last, n
     """)
+
+
+# -- the TPC-DS suite (adapted store-channel queries, tests/tpcds_queries.py)
+
+from tpcds_queries import Q as TPCDS_QUERIES
+
+
+@pytest.mark.parametrize(
+    "name,sql,oracle_sql",
+    TPCDS_QUERIES, ids=[t[0] for t in TPCDS_QUERIES])
+def test_tpcds_query(runner, oracle, name, sql, oracle_sql):
+    compare(runner, oracle, sql, oracle_sql)

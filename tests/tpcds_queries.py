@@ -1,0 +1,378 @@
+"""TPC-DS query texts (adapted from the public TPC-DS specification's
+query templates with fixed parameter values), restricted to the store
+sales channel and the column subset the generator produces — column
+substitutions (e.g. i_category for i_class) are noted inline. Engine
+results are validated against a SQLite oracle over the IDENTICAL
+generated data, so adapted parameters stay self-consistent.
+
+Each entry: (name, engine_sql, sqlite_sql_or_None).
+"""
+
+Q = []
+
+
+def q(name, sql, sqlite_sql=None):
+    Q.append((name, sql, sqlite_sql or sql))
+
+
+q("q3", """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manufact_id = 128 and d_moy = 11
+group by d_year, i_brand, i_brand_id
+order by d_year, sum_agg desc, brand_id
+limit 100
+""")
+
+q("q7", """
+select i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id order by i_item_id limit 100
+""")
+
+q("q13", """
+select avg(ss_quantity) q, avg(ss_ext_sales_price) e,
+       avg(ss_wholesale_cost) w, sum(ss_wholesale_cost) sw
+from store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2001
+  and ((ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M'
+        and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00
+        and hd_dep_count = 3)
+    or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'S' and cd_education_status = 'College'
+        and ss_sales_price between 50.00 and 100.00
+        and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('TX', 'OH', 'TX')
+        and ss_net_profit between 100 and 200)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('OR', 'NC', 'KY')
+        and ss_net_profit between 150 and 300))
+""")
+
+q("q19", """
+select i_brand_id brand_id, i_brand brand, i_manufact_id,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item, customer, customer_address, store
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 8 and d_moy = 11 and d_year = 1998
+  and ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5)
+  and ss_store_sk = s_store_sk
+group by i_brand, i_brand_id, i_manufact_id
+order by ext_price desc, i_brand, i_brand_id, i_manufact_id
+limit 100
+""")
+
+q("q34", """
+select c_last_name, c_first_name, c_salutation,
+       c_preferred_cust_flag, ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (d_dom between 1 and 3 or d_dom between 25 and 28)
+        and (hd_buy_potential = '>10000'
+             or hd_buy_potential = 'Unknown')
+        and hd_vehicle_count > 0
+        and d_year in (1999, 2000, 2001)
+        and s_county = 'Williamson County'
+      group by ss_ticket_number, ss_customer_sk) dn, customer
+where ss_customer_sk = c_customer_sk and cnt between 15 and 20
+order by c_last_name, c_first_name, c_salutation,
+         c_preferred_cust_flag desc, ss_ticket_number
+""".replace("c_salutation", "c_customer_id"))
+
+q("q42", """
+select d_year, i_category_id, i_category,
+       sum(ss_ext_sales_price) s
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+group by d_year, i_category_id, i_category
+order by s desc, d_year, i_category_id, i_category
+limit 100
+""")
+
+q("q43", """
+select s_store_name, s_store_id,
+       sum(case when d_day_name = 'Sunday' then ss_sales_price
+                else null end) sun_sales,
+       sum(case when d_day_name = 'Monday' then ss_sales_price
+                else null end) mon_sales,
+       sum(case when d_day_name = 'Friday' then ss_sales_price
+                else null end) fri_sales,
+       sum(case when d_day_name = 'Saturday' then ss_sales_price
+                else null end) sat_sales
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+  and s_gmt_offset = -5 and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id, sun_sales, mon_sales
+limit 100
+""")
+
+q("q48", """
+select sum(ss_quantity) s
+from store_sales, store, customer_demographics,
+     customer_address, date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2000
+  and ((cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+    or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'D'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 50.00 and 100.00))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('TX', 'NC', 'OH')
+        and ss_net_profit between 0 and 2000)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('KY', 'GA', 'VA')
+        and ss_net_profit between 150 and 3000))
+""")
+
+q("q52", """
+select d_year, i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+group by d_year, i_brand, i_brand_id
+order by d_year, ext_price desc, brand_id
+limit 100
+""")
+
+# i_class substituted with i_category (generator subset)
+q("q53", """
+select manufact_id, sum_sales,
+       avg(sum_sales) over (partition by manufact_id) avg_quarterly_sales
+from (select i_manufact_id manufact_id, d_qoy,
+             sum(ss_sales_price) sum_sales
+      from item, store_sales, date_dim, store
+      where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk and d_year = 2000
+        and i_category in ('Books', 'Children', 'Electronics')
+        and i_manager_id between 1 and 20
+      group by i_manufact_id, d_qoy) t
+order by manufact_id, sum_sales limit 100
+""")
+
+q("q55", """
+select i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+  and i_manager_id = 28 and d_moy = 11 and d_year = 1999
+group by i_brand, i_brand_id
+order by ext_price desc, i_brand_id
+limit 100
+""")
+
+q("q65", """
+select s_store_name, i_item_id, sc.revenue
+from store, item,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) revenue
+      from store_sales, date_dim
+      where ss_sold_date_sk = d_date_sk and d_year = 2001
+      group by ss_store_sk, ss_item_sk) sc,
+     (select ss_store_sk store_sk, avg(revenue) ave
+      from (select ss_store_sk, ss_item_sk,
+                   sum(ss_sales_price) revenue
+            from store_sales, date_dim
+            where ss_sold_date_sk = d_date_sk and d_year = 2001
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb
+where sb.store_sk = sc.ss_store_sk
+  and sc.revenue <= 0.1 * sb.ave
+  and s_store_sk = sc.ss_store_sk and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_id, sc.revenue
+limit 100
+""")
+
+q("q68", """
+select c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_coupon_amt) extended_tax,
+             sum(ss_list_price) list_price
+      from store_sales, date_dim, store,
+           household_demographics, customer_address
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+        and d_dom between 1 and 2
+        and (hd_dep_count = 4 or hd_vehicle_count = 3)
+        and d_year in (1999, 2000, 2001)
+        and s_city in ('Midway', 'Fairview')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+""")
+
+q("q73", """
+select c_last_name, c_first_name, c_salutation,
+       c_preferred_cust_flag, ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and d_dom between 1 and 2
+        and (hd_buy_potential = '>10000'
+             or hd_buy_potential = 'Unknown')
+        and hd_vehicle_count > 0
+        and d_year in (1999, 2000, 2001)
+        and s_county = 'Williamson County'
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+order by cnt desc, c_last_name asc
+""".replace("c_salutation", "c_customer_id"))
+
+q("q79", """
+select c_last_name, c_first_name,
+       substr(s_city, 1, 30) city30, ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk, s_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (hd_dep_count = 6 or hd_vehicle_count > 2)
+        and d_dow = 1 and d_year in (1999, 2000, 2001)
+        and s_number_employees between 200 and 295
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city) ms,
+     customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, city30, profit
+limit 100
+""".replace("d_dow = 1", "d_day_name = 'Monday'"))
+
+q("q88", """
+select *
+from (select count(*) h8_30_to_9 from store_sales,
+        household_demographics, time_dim, store
+      where ss_sold_time_sk = t_time_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+        and t_hour = 8 and t_minute >= 30
+        and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+             or (hd_dep_count = 2 and hd_vehicle_count <= 4))
+        and s_store_name = 'ese') s1,
+     (select count(*) h9_to_9_30 from store_sales,
+        household_demographics, time_dim, store
+      where ss_sold_time_sk = t_time_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+        and t_hour = 9 and t_minute < 30
+        and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+             or (hd_dep_count = 2 and hd_vehicle_count <= 4))
+        and s_store_name = 'ese') s2,
+     (select count(*) h12_to_12_30 from store_sales,
+        household_demographics, time_dim, store
+      where ss_sold_time_sk = t_time_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_store_sk = s_store_sk
+        and t_hour = 12 and t_minute < 30
+        and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+             or (hd_dep_count = 2 and hd_vehicle_count <= 4))
+        and s_store_name = 'ese') s3
+""")
+
+# i_class substituted with i_category; the window moved outside the
+# grouped subquery (same plan the reference builds after its
+# window-over-aggregation rewrite)
+q("q89", """
+select i_category, i_brand, s_store_name, s_company, d_moy, sum_sales,
+       avg_monthly_sales
+from (select i_category, i_brand, s_store_name, s_company, d_moy,
+             sum_sales,
+             avg(sum_sales) over (partition by i_category, i_brand,
+                                  s_store_name) avg_monthly_sales
+      from (select i_category, i_brand, s_store_name,
+                   s_store_id s_company, d_moy,
+                   sum(ss_sales_price) sum_sales
+            from item, store_sales, date_dim, store
+            where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+              and ss_store_sk = s_store_sk and d_year = 1999
+              and i_category in ('Books', 'Electronics', 'Sports')
+              and i_brand_id between 1 and 60
+            group by i_category, i_brand, s_store_name, s_store_id,
+                     d_moy) g) t
+where avg_monthly_sales <> 0
+  and abs(sum_sales - avg_monthly_sales) / avg_monthly_sales > 0.1
+order by sum_sales - avg_monthly_sales, s_company, d_moy
+limit 100
+""")
+
+q("q96", """
+select count(*) c
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and t_hour = 20 and t_minute >= 30 and hd_dep_count = 7
+  and s_store_name = 'ese'
+""")
+
+# i_class substituted with i_category; ratio over category partitions
+q("q98", """
+select i_item_id, i_category, i_current_price, itemrevenue,
+       itemrevenue * 100.0 / sum(itemrevenue)
+           over (partition by i_category) revenueratio
+from (select i_item_id, i_category, i_current_price,
+             sum(ss_ext_sales_price) itemrevenue
+      from store_sales, item, date_dim
+      where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+        and i_category in ('Sports', 'Books', 'Home')
+        and d_year = 1999 and d_moy = 2
+      group by i_item_id, i_category, i_current_price) t
+order by i_category, i_item_id
+limit 100
+""")
+
+q("q26_store", """
+select i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'F' and cd_marital_status = 'W'
+  and cd_education_status = 'Primary'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 1998
+group by i_item_id order by i_item_id limit 100
+""")
+
+q("q6_store", """
+select ca_state state, count(*) cnt
+from customer_address, customer, store_sales, date_dim, item
+where ca_address_sk = c_current_addr_sk
+  and c_customer_sk = ss_customer_sk
+  and ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and d_year = 2001 and d_moy = 1
+  and i_current_price > 1.2 *
+      (select avg(j.i_current_price) from item j
+       where j.i_category = i_category)
+group by ca_state having count(*) >= 10
+order by cnt, state limit 100
+""")
+
+q("q96_meal", """
+select t_meal_time, count(*) c
+from store_sales, time_dim
+where ss_sold_time_sk = t_time_sk and t_meal_time <> ''
+group by t_meal_time order by t_meal_time
+""")
