@@ -203,12 +203,15 @@ class ClusterRunner:
     def _request(self, url: str, method: str = "GET",
                  body: Optional[dict] = None,
                  retries: Optional[int] = None,
-                 timeout: float = 60) -> dict:
+                 timeout: float = 10) -> dict:
         """Remote-task HTTP with retry/backoff. Retrying is safe because
         every mutating endpoint is idempotent (task PUT is an upsert on
         the worker, DELETE/abort tolerate repeats). Latency-sensitive
         callers (the memory manager's poll/kill loop) pass retries=0 —
-        their next poll IS the retry."""
+        their next poll IS the retry. These are small-JSON control-plane
+        calls (create/status/delete): the 10s timeout bounds a
+        black-holed worker at ~a minute across the whole retry budget,
+        not 5 minutes (result pages stream through a separate client)."""
         data = json.dumps(body).encode() if body is not None else None
         budget = self.REQUEST_RETRIES if retries is None else retries
         last: Optional[Exception] = None
@@ -403,9 +406,13 @@ class ClusterRunner:
         return QueryResult(names=names, types=types, rows=rows)
 
     def _check_tasks(self, all_tasks: List[str]) -> None:
+        # failure-path diagnostic probes: single attempt with a short
+        # timeout — this path runs when something already looks wrong,
+        # and burning the full retry budget per task against a dead
+        # worker turns fail-fast into minutes of hanging
         for u in all_tasks:
             try:
-                st = self._request(u)
+                st = self._request(u, retries=0, timeout=5)
             except Exception as e:
                 raise QueryFailedError(
                     f"lost task {u}: {e}") from None
