@@ -240,8 +240,12 @@ class DistributedExecutor(_Executor):
                         param=a.param)
                 for a in node.aggs]
         group = list(node.group_indices)
-        from ..ops.aggregation import has_drain_agg
-        if has_drain_agg(aggs):
+        from ..ops.aggregation import percentile_drains
+        # final-step nodes consume STATE columns whose layout the raw
+        # agg input indices don't describe — never re-check them
+        if node.step != "final" and \
+                percentile_drains(aggs, _plan_schema(node.child).types,
+                                  bool(group)):
             # approx_percentile: colocate each group's raw rows via hash
             # exchange, then one exact segmented-sort pass per shard (no
             # mergeable state exists — the window-node pattern)

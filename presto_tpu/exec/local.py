@@ -664,11 +664,18 @@ class _Executor:
                 raise NotImplementedError(
                     "DISTINCT aggregates must be lowered by the planner")
         group = list(node.group_indices)
-        from ..ops.aggregation import has_drain_agg
-        if has_drain_agg(aggs):
-            # approx_percentile: no mergeable state — drain the input and
-            # evaluate in one segmented-sort pass (the sort-based engine's
-            # answer to the reference's QuantileDigest sketch state)
+        from ..ops.aggregation import percentile_drains
+        # final-step nodes consume STATE columns (the fragmenter decided
+        # drain-vs-sketch before splitting; agg input indices reference
+        # the raw child, not the state layout) — never re-check them
+        if node.step != "final" and \
+                percentile_drains(aggs, _plan_schema(node.child).types,
+                                  bool(group)):
+            # grouped/string approx_percentile: no mergeable state —
+            # drain the input and evaluate in one exact segmented-sort
+            # pass (global numeric forms carry bounded qdigest-style
+            # histogram state through the normal partial/final path
+            # below instead)
             b = self._drain(node.child)
             if b is None:
                 if group:
@@ -687,11 +694,17 @@ class _Executor:
         # of the state boundary this node covers.
         step = node.step
         if not group:
+            # sketch aggregates carry wide state tiles ([cap, m]
+            # registers / [cap, bins] histograms); merge them eagerly so
+            # peak memory stays a few tiles, not 64
+            merge_at = 4 if any(a.fn in ("approx_distinct",
+                                         "approx_percentile")
+                                for a in aggs) else 64
             parts: List[Batch] = []
             for b in self.run(node.child):
                 parts.append(global_aggregate(b, aggs, mode="partial")
                              if step != "final" else b)
-                if len(parts) >= 64:
+                if len(parts) >= merge_at:
                     parts = [global_aggregate(concat_batches(parts), aggs,
                                               mode="merge")]
             if not parts:

@@ -34,16 +34,34 @@ _SUPPORTED = ("sum", "count", "count_star", "min", "max", "avg",
               "var_samp", "var_pop", "stddev_samp", "stddev_pop",
               "bool_and", "bool_or", "approx_percentile",
               "approx_distinct")
-#: aggregates with no mergeable fixed-size state: the executor drains the
-#: input and evaluates in one 'single'-mode pass (reference computes these
-#: with QuantileDigest sketches — state/DigestAndPercentileState.java; the
-#: TPU engine is sort-based, so an exact segmented-sort select is both
-#: cheaper and within the sketch's error bound by definition)
+#: aggregates whose GROUPED form drains the input into one exact
+#: 'single'-mode pass (reference computes these with QuantileDigest
+#: sketches — state/DigestAndPercentileState.java). The GLOBAL numeric
+#: form instead carries bounded mergeable histogram state through
+#: partial -> exchange -> final like every other aggregate
+#: (ops/sketch.py qd_*); only grouped and string-input forms drain,
+#: because a dense per-group tile would be O(groups x bins) and
+#: dictionary ranks are batch-local (not mergeable across shards).
 DRAIN_FNS = ("approx_percentile",)
 
 
 def has_drain_agg(aggs) -> bool:
     return any(a.fn in DRAIN_FNS for a in aggs)
+
+
+def percentile_drains(aggs, input_types, grouped: bool) -> bool:
+    """True when approx_percentile aggregates must run as an exact
+    drain (see DRAIN_FNS): grouped aggregations and string inputs.
+    ``input_types`` is the child schema's type list."""
+    drains = [a for a in aggs if a.fn in DRAIN_FNS]
+    if not drains:
+        return False
+    if grouped:
+        return True
+    # accepts AggSpec (.input) and planner PlanAgg (.arg) alike
+    return any(
+        input_types[a.input if hasattr(a, "input") else a.arg].is_string
+        for a in drains)
 
 
 #: largest fused key-domain the no-sort dense group-by path handles; past
@@ -72,9 +90,14 @@ class AggSpec:
     # state layout produced by partial mode / consumed by final mode
     def state_types(self) -> List[Tuple[str, Type]]:
         base = self.name or self.fn
-        if self.fn in DRAIN_FNS:
-            raise NotImplementedError(
-                f"{self.fn} has no mergeable partial state (drain-only)")
+        if self.fn == "approx_percentile":
+            # fixed-size log-linear histogram: the bounded mergeable
+            # state the reference ships between partial and final steps
+            # (state/DigestAndPercentileState.java); only the GLOBAL
+            # numeric form uses it (grouped/string forms drain — see
+            # DRAIN_FNS)
+            from .sketch import QD_BINS
+            return [(f"{base}$qdig", T.QdigestStateType(QD_BINS))]
         if self.fn == "approx_distinct":
             # fixed-size HLL register vector: the bounded mergeable state
             # the reference ships between partial and final steps
@@ -332,6 +355,10 @@ def _segment_aggs(
             n_state = len(agg.state_types())
             s_cols = list(range(state_cursor, state_cursor + n_state))
             state_cursor += n_state
+            if agg.fn == "approx_percentile":
+                raise NotImplementedError(
+                    "grouped approx_percentile is drain-only "
+                    "(see percentile_drains)")
             if agg.fn == "approx_distinct":
                 # HLL merge = per-bucket max of register rows [n, m];
                 # 0 is the register identity so dead rows drop out
@@ -787,10 +814,9 @@ def global_aggregate(
     (reference AggregationOperator.java global aggregation semantics).
     'merge' consumes state columns and emits merged state columns."""
     assert mode in ("single", "partial", "final", "merge")
-    if has_drain_agg(aggs):
-        if mode != "single":
-            raise NotImplementedError(
-                "approx_percentile requires single-step aggregation")
+    if has_drain_agg(aggs) and mode == "single":
+        # exact one-pass path (drain callers and string inputs); the
+        # partial/merge/final modes below carry bounded histogram state
         regular = [a for a in aggs if a.fn not in DRAIN_FNS]
         base = global_aggregate(batch, regular, "single")
         computed = {}
@@ -828,6 +854,44 @@ def global_aggregate(
 
     state_cursor = 0
     for agg in aggs:
+        if agg.fn == "approx_percentile":
+            from .sketch import QD_BINS, qd_estimate, qd_update
+            if mode in ("final", "merge"):
+                col = batch.columns[state_cursor]
+                state_cursor += 1
+                counts = jnp.sum(
+                    jnp.where(mask[:, None], col.data,
+                              jnp.zeros_like(col.data)), axis=0)
+            else:
+                c = batch.columns[agg.input]
+                if c.dictionary is not None:
+                    raise NotImplementedError(
+                        "approx_percentile over strings is drain-only "
+                        "(see percentile_drains)")
+                valid = c.validity & mask
+                if agg.mask is not None:
+                    valid = valid & \
+                        batch.columns[agg.mask].data.astype(bool)
+                counts = qd_update(valid, c.data.astype(jnp.float64))
+            if mode in ("partial", "merge"):
+                (fname, ftype) = agg.state_types()[0]
+                out_fields.append((fname, ftype))
+                out_cols.append(Column(
+                    ftype,
+                    jnp.zeros((cap, QD_BINS), dtype=jnp.int64).at[0].set(
+                        counts),
+                    out_mask, None))
+            else:
+                p = float(agg.param if agg.param is not None else 0.5)
+                val, ok = qd_estimate(counts, p)
+                dt = agg.output_type.storage_dtype
+                if not jnp.issubdtype(dt, jnp.floating):
+                    val = jnp.round(val)
+                out_fields.append((agg.name or agg.fn, agg.output_type))
+                out_cols.append(Column(
+                    agg.output_type, pad(val, dt),
+                    jnp.zeros(cap, dtype=bool).at[0].set(ok), None))
+            continue
         if agg.fn == "approx_distinct":
             from .sketch import (hashed_column, hll_estimate, hll_m,
                                  hll_update)

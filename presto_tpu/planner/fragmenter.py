@@ -25,7 +25,7 @@ import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 from ..expr import ir
-from ..ops.aggregation import AggSpec, DRAIN_FNS as _DRAIN_FNS
+from ..ops.aggregation import AggSpec
 from ..sql.analyzer import Field
 from .plan import (
     AggregationNode, DistinctNode, FilterNode, GroupIdNode, JoinNode,
@@ -151,9 +151,21 @@ class _Fragmenter:
                 return dataclasses.replace(node, child=src), "fixed"
             src = self.cut(child, loc, OutputSpec("single"))
             return dataclasses.replace(node, child=src), "single"
-        if any(a.fn in _DRAIN_FNS for a in node.aggs):
-            # drain-only aggregates (approx_percentile) have no mergeable
-            # partial state: ship raw rows to one task and aggregate there
+        from ..ops.aggregation import percentile_drains
+        if percentile_drains(node.aggs, [f.type for f in child.fields],
+                             bool(node.group_indices)):
+            if node.group_indices:
+                # grouped approx_percentile: colocate each group's raw
+                # rows by key hash and run the exact single-step
+                # aggregation per task — parallel across tasks, unlike
+                # the reference's mergeable-sketch route but with the
+                # same exchange shape (partition by group keys)
+                src = self.cut(child, loc,
+                               OutputSpec("partition",
+                                          tuple(node.group_indices)))
+                return dataclasses.replace(node, child=src), "fixed"
+            # global string percentile: exact pass needs all rows in one
+            # task (dictionary ranks are batch-local)
             src = self.cut(child, loc, OutputSpec("single"))
             return dataclasses.replace(node, child=src), "single"
         keys = list(node.group_indices)

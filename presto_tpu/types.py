@@ -363,6 +363,32 @@ class HllStateType(Type):
 
 
 @dataclasses.dataclass(frozen=True)
+class QdigestStateType(Type):
+    """Quantile-histogram state for approx_percentile partials
+    (reference presto-main/.../operator/aggregation/state/
+    DigestAndPercentileState.java + airlift QuantileDigest). Column
+    ``data`` is a dense i64 tile [capacity, bins] of log-linear bin
+    counts (ops/sketch.py qd_*): fixed-size regardless of input rows,
+    merged with one vector add, shipped through exchanges as an
+    ordinary fixed-width column. ``bins`` must equal ops/sketch.py
+    QD_BINS (the layout constant lives there; callers pass it in)."""
+
+    bins: int
+    name: ClassVar[str] = "qdigeststate"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int64
+
+    @property
+    def storage_width(self) -> int:
+        return self.bins
+
+    def display(self) -> str:
+        return f"qdigeststate({self.bins})"
+
+
+@dataclasses.dataclass(frozen=True)
 class RowType(Type):
     """ROW(f1 T1, ...): struct of child columns. Column ``data`` is a
     tuple of (child_data, child_valid) pairs; ``dictionary`` is a tuple
@@ -524,6 +550,8 @@ def parse_type(text: str) -> Type:
             return CharType(args[0])
         if base == "hllstate":
             return HllStateType(args[0])
+        if base == "qdigeststate":
+            return QdigestStateType(args[0])
         raise ValueError(f"unknown parametric type {text!r}")
     simple = {
         "boolean": BOOLEAN,
