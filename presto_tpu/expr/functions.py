@@ -58,8 +58,10 @@ class Val:
                     jnp.zeros(n, dtype=bool), typ,
                     dictionary=() if typ.element.is_string else None,
                 )
+            width = getattr(typ, "storage_width", None)
+            shape = (n,) if width is None else (n, width)
             return Val(
-                jnp.full(n, typ.null_storage(), dtype=typ.storage_dtype),
+                jnp.zeros(shape, dtype=typ.storage_dtype),
                 jnp.zeros(n, dtype=bool), typ, literal=None,
             )
         if typ.is_string:
@@ -71,10 +73,13 @@ class Val:
                 jnp.ones(n, dtype=bool), typ, dictionary=(s,), literal=s,
             )
         storage = typ.to_storage(value)
-        return Val(
-            jnp.full(n, storage, dtype=typ.storage_dtype),
-            jnp.ones(n, dtype=bool), typ, literal=value,
-        )
+        if getattr(typ, "storage_width", None):
+            data = jnp.tile(
+                jnp.asarray(storage, dtype=typ.storage_dtype)[None, :],
+                (n, 1))
+        else:
+            data = jnp.full(n, storage, dtype=typ.storage_dtype)
+        return Val(data, jnp.ones(n, dtype=bool), typ, literal=value)
 
 
 def _all_valid(args: Sequence[Val]) -> jnp.ndarray:
@@ -101,6 +106,25 @@ def flag_err(cond: jnp.ndarray, code: int) -> jnp.ndarray:
 
 # -- decimal helpers ---------------------------------------------------------
 
+def _is_long_dec(t) -> bool:
+    return isinstance(t, T.DecimalType) and t.is_long
+
+
+def _dec_limbs(v: Val, to_scale: int):
+    """Numeric Val -> ([n, 2] limb tile at to_scale, overflow rows).
+    Decimal inputs rescale from their own scale; integrals from 0
+    (ops/int128.py; reference UnscaledDecimal128Arithmetic.rescale)."""
+    from ..ops import int128 as I
+    t = v.type
+    if isinstance(t, T.DecimalType):
+        x = v.data if t.is_long else I.from_i64(v.data)
+        return I.rescale(x, to_scale - t.scale)
+    if T.is_integral(t) or isinstance(t, T.BigintType):
+        return I.rescale(I.from_i64(v.data.astype(jnp.int64)), to_scale)
+    raise NotImplementedError(
+        f"cannot take decimal limbs of {t.display()}")
+
+
 def rescale_decimal(data: jnp.ndarray, from_scale: int, to_scale: int) -> jnp.ndarray:
     """Rescale int64 decimal storage, rounding half-up away from zero."""
     if to_scale == from_scale:
@@ -111,6 +135,50 @@ def rescale_decimal(data: jnp.ndarray, from_scale: int, to_scale: int) -> jnp.nd
     half = div // 2
     sign = jnp.sign(data)
     return sign * ((jnp.abs(data) + half) // div)
+
+
+def _cast_long_decimal(v: Val, to: Type) -> Val:
+    """Casts where the source or target is a long decimal (p > 18):
+    limb rescales with range checks (reference DecimalCasts.java +
+    UnscaledDecimal128Arithmetic). Out-of-range rows error with
+    NUMERIC_VALUE_OUT_OF_RANGE like the reference's throw."""
+    from ..ops import int128 as I
+    f = v.type
+    if isinstance(to, T.DecimalType):
+        if isinstance(f, T.DecimalType) or T.is_integral(f) \
+                or isinstance(f, T.BigintType):
+            x, ovf = _dec_limbs(v, to.scale)
+        elif T.is_floating(f):
+            bound = 10.0 ** (to.precision - to.scale)
+            scaled = v.data.astype(jnp.float64) * (10.0 ** to.scale)
+            half_up = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+            x = I.from_f64(half_up)
+            ovf = ~(jnp.abs(v.data.astype(jnp.float64)) < bound)
+        else:
+            raise NotImplementedError(
+                f"cast {f.display()} -> {to.display()}")
+        fits = I.fits_decimal(x, to.precision) & ~ovf
+        err = flag_err(v.valid & ~fits, E.NUMERIC_VALUE_OUT_OF_RANGE)
+        if to.is_long:
+            return Val(x, v.valid & fits, to, err=err)
+        return Val(I.lo(x), v.valid & fits, to, err=err)
+    # source is long decimal
+    if isinstance(to, T.DoubleType) or isinstance(to, T.RealType):
+        out = (I.to_f64(v.data) / (10.0 ** f.scale)).astype(to.storage_dtype)
+        return Val(out, v.valid, to)
+    if T.is_integral(to) or isinstance(to, T.BigintType):
+        x, _ = I.rescale(v.data, -f.scale)
+        fits = I.hi(x) == (I.lo(x) >> 63)       # value fits one limb
+        narrow = I.lo(x)
+        if not isinstance(to, T.BigintType):
+            info = jnp.iinfo(to.storage_dtype)
+            fits = fits & (narrow >= info.min) & (narrow <= info.max)
+        err = flag_err(v.valid & ~fits, E.NUMERIC_VALUE_OUT_OF_RANGE)
+        return Val(narrow.astype(to.storage_dtype), v.valid & fits, to,
+                   err=err)
+    if isinstance(to, T.BooleanType):
+        return Val(~I.is_zero(v.data), v.valid, to)
+    raise NotImplementedError(f"cast {f.display()} -> {to.display()}")
 
 
 def _unify_numeric(a: Val, b: Val) -> Tuple[Val, Val, Type]:
@@ -141,6 +209,8 @@ def cast_val(v: Val, to: Type) -> Val:
                    jnp.zeros(n, dtype=bool), to,
                    dictionary=() if to.is_string else None, err=v.err)
     data = v.data
+    if _is_long_dec(f) or _is_long_dec(to):
+        return _cast_long_decimal(v, to)
     if isinstance(f, T.DecimalType) and isinstance(to, T.DecimalType):
         return Val(rescale_decimal(data, f.scale, to.scale), v.valid, to)
     if isinstance(to, T.DoubleType) or isinstance(to, T.RealType):
@@ -340,10 +410,73 @@ def lookup(name: str) -> FunctionImpl:
     return _REGISTRY[name]
 
 
+def _long_decimal_arith(op: str, a: Val, b: Val, out, valid) -> Val:
+    """Decimal arithmetic through int128 limb kernels (reference
+    DecimalOperators.java long-decimal paths over Int128). add/sub/mul
+    are exact with NUMERIC_VALUE_OUT_OF_RANGE on 38-digit overflow;
+    division supports divisors whose unscaled value fits 31 bits
+    (precision <= 9 — the short-division kernel's bound), which covers
+    constants and typical scaled divisors."""
+    from ..ops import int128 as I
+    s_out = out.scale
+    sa = a.type.scale if isinstance(a.type, T.DecimalType) else 0
+    sb = b.type.scale if isinstance(b.type, T.DecimalType) else 0
+    if op in ("add", "sub"):
+        xa, oa = _dec_limbs(a, s_out)
+        xb, ob = _dec_limbs(b, s_out)
+        res = I.add(xa, xb) if op == "add" else I.sub(xa, xb)
+        rhs = xb if op == "add" else I.neg(xb)
+        wrap = I.add_overflows(xa, rhs, res)
+        fits = I.fits_decimal(res, out.precision) & ~(oa | ob | wrap)
+    elif op == "mul":
+        xa, oa = _dec_limbs(a, sa)
+        xb, ob = _dec_limbs(b, sb)
+        prod, om = I.mul(xa, xb)
+        res, orr = I.rescale(prod, s_out - (sa + sb))
+        fits = I.fits_decimal(res, out.precision) & ~(oa | ob | om | orr)
+    elif op == "div":
+        # the short-division kernel needs |unscaled divisor| < 2^31:
+        # any <= 9-digit decimal or sub-bigint integer qualifies, as
+        # does a compile-time constant that happens to fit
+        small_type = (isinstance(b.type, T.DecimalType)
+                      and not b.type.is_long and b.type.precision <= 9) \
+            or (T.is_integral(b.type)
+                and not isinstance(b.type, T.BigintType))
+        small_literal = False
+        if b.literal is not None and not _is_long_dec(b.type):
+            unscaled = (b.type.to_storage(b.literal)
+                        if isinstance(b.type, T.DecimalType)
+                        else int(b.literal))
+            small_literal = abs(unscaled) < 2 ** 31
+        if not (small_type or small_literal):
+            raise NotImplementedError(
+                "long decimal division needs a divisor with unscaled "
+                "value under 2^31 (cast the divisor down or use DOUBLE)")
+        num, on = _dec_limbs(a, s_out + sb)
+        db = b.data.astype(jnp.int64)
+        zero = db == 0
+        q = I.div_round_half_up(num, jnp.abs(jnp.where(zero, 1, db)))
+        q = I.where(db < 0, I.neg(q), q)
+        err = flag_err(valid & zero, E.DIVISION_BY_ZERO)
+        fits = I.fits_decimal(q, out.precision) & ~on & ~zero
+        err = err | flag_err(valid & ~zero & ~fits,
+                             E.NUMERIC_VALUE_OUT_OF_RANGE)
+        data = q if out.is_long else I.lo(q)
+        return Val(data, valid & fits, out, err=err)
+    else:
+        raise NotImplementedError(f"long decimal {op} is not supported")
+    err = flag_err(valid & ~fits, E.NUMERIC_VALUE_OUT_OF_RANGE)
+    data = res if out.is_long else I.lo(res)
+    return Val(data, valid & fits, out, err=err)
+
+
 def _arith(op):
     def impl(args: List[Val], out: Type) -> Val:
         a, b = args
         valid = a.valid & b.valid
+        if isinstance(out, T.DecimalType) and (
+                out.is_long or _is_long_dec(a.type) or _is_long_dec(b.type)):
+            return _long_decimal_arith(op, a, b, out, valid)
         if isinstance(out, T.DecimalType):
             s_out = out.scale
             sa = a.type.scale if isinstance(a.type, T.DecimalType) else 0
@@ -417,7 +550,36 @@ for _name, _op in [("add", "add"), ("subtract", "sub"), ("multiply", "mul"),
 @register("negate")
 def _negate(args, out):
     (a,) = args
+    if _is_long_dec(a.type):
+        from ..ops import int128 as I
+        return Val(I.neg(a.data), a.valid, out)
     return Val(-a.data, a.valid, out)
+
+
+def _long_dec_compare(a: Val, b: Val, op: str) -> Val:
+    """Compare when either side is a long decimal and both are exact
+    numerics: rescale to the wider scale, limb compare. When the
+    rescale would exceed 38 digits (extreme scale gap), fall back to
+    f64 compare (beyond-38-digit distinctions round away, documented)."""
+    from ..ops import int128 as I
+    sa = a.type.scale if isinstance(a.type, T.DecimalType) else 0
+    sb = b.type.scale if isinstance(b.type, T.DecimalType) else 0
+    pa = a.type.precision if isinstance(a.type, T.DecimalType) else 19
+    pb = b.type.precision if isinstance(b.type, T.DecimalType) else 19
+    s = max(sa, sb)
+    valid = a.valid & b.valid
+    if max(pa + s - sa, pb + s - sb) > 38:
+        fa = cast_val(a, T.DOUBLE).data
+        fb = cast_val(b, T.DOUBLE).data
+        data = {"eq": fa == fb, "ne": fa != fb, "lt": fa < fb,
+                "le": fa <= fb, "gt": fa > fb, "ge": fa >= fb}[op]
+        return Val(data, valid, T.BOOLEAN)
+    xa, _ = _dec_limbs(a, s)
+    xb, _ = _dec_limbs(b, s)
+    data = {"eq": I.eq(xa, xb), "ne": ~I.eq(xa, xb),
+            "lt": I.lt(xa, xb), "le": I.le(xa, xb),
+            "gt": I.lt(xb, xa), "ge": I.le(xb, xa)}[op]
+    return Val(data, valid, T.BOOLEAN)
 
 
 def _cmp(op):
@@ -425,6 +587,9 @@ def _cmp(op):
         a, b = args
         if a.type.is_string or b.type.is_string:
             return _string_compare(a, b, op)
+        if (_is_long_dec(a.type) or _is_long_dec(b.type)) \
+                and not (T.is_floating(a.type) or T.is_floating(b.type)):
+            return _long_dec_compare(a, b, op)
         if a.type != b.type:
             a, b, _ = _unify_numeric(a, b)
         valid = a.valid & b.valid
@@ -448,6 +613,9 @@ def _not(args, out):
 @register("abs")
 def _abs(args, out):
     (a,) = args
+    if _is_long_dec(a.type):
+        from ..ops import int128 as I
+        return Val(I.abs_(a.data), a.valid, out)
     return Val(jnp.abs(a.data), a.valid, out)
 
 
@@ -467,6 +635,8 @@ register("exp")(_dbl_fn(jnp.exp))
 @register("floor")
 def _floor(args, out):
     (a,) = args
+    if _is_long_dec(a.type):
+        return Val(_long_dec_floor_ceil(a, ceil=False), a.valid, out)
     if isinstance(a.type, T.DecimalType):
         div = 10 ** a.type.scale
         return Val(jnp.floor_divide(a.data, div) * div, a.valid, out)
@@ -475,9 +645,35 @@ def _floor(args, out):
     return Val(jnp.floor(a.data), a.valid, out)
 
 
+def _long_dec_floor_ceil(a: Val, ceil: bool) -> jnp.ndarray:
+    """Exact floor/ceil to integer multiples of 10**scale for long
+    decimals: truncate the fraction digits by digit division, then bump
+    toward -inf (floor of negatives) / +inf (ceil of positives) when
+    any fraction digit was nonzero."""
+    from ..ops import int128 as I
+    s = a.type.scale
+    m = I.abs_(a.data)
+    k = s
+    rem_any = jnp.zeros(a.data.shape[:-1], dtype=bool)
+    while k > 0:
+        step = min(k, 9)
+        m, rr = I.divmod_small_abs(m, 10 ** step)
+        rem_any = rem_any | (rr != 0)
+        k -= step
+    neg_in = I.is_neg(a.data)
+    bump_rows = rem_any & (neg_in != ceil)   # floor: negatives; ceil: positives
+    bump = bump_rows.astype(jnp.int64)
+    m = I.add(m, I.pack(jnp.zeros_like(bump), bump))
+    signed = I.where(neg_in, I.neg(m), m)
+    back, _ = I.rescale(signed, s)
+    return back
+
+
 @register("ceil")
 def _ceil(args, out):
     (a,) = args
+    if _is_long_dec(a.type):
+        return Val(_long_dec_floor_ceil(a, ceil=True), a.valid, out)
     if isinstance(a.type, T.DecimalType):
         div = 10 ** a.type.scale
         return Val(-(jnp.floor_divide(-a.data, div)) * div, a.valid, out)
@@ -492,11 +688,24 @@ def _round(args, out):
     digits = 0
     if len(args) > 1:
         # digits must be a compile-time constant (Literal-backed)
-        try:
-            digits = int(np.asarray(args[1].data)[0])
-        except Exception as e:
-            raise NotImplementedError("round() with non-constant digits") from e
+        if args[1].literal is not None:
+            digits = int(args[1].literal)
+        else:
+            try:
+                digits = int(np.asarray(args[1].data)[0])
+            except Exception as e:
+                raise NotImplementedError(
+                    "round() with non-constant digits") from e
+    if _is_long_dec(a.type):
+        if digits >= a.type.scale:
+            return Val(a.data, a.valid, out)   # nothing to round away
+        from ..ops import int128 as I
+        x, _ = I.rescale(a.data, digits - a.type.scale)  # half-up here
+        x, _ = I.rescale(x, a.type.scale - digits)
+        return Val(x, a.valid, out)
     if isinstance(a.type, T.DecimalType):
+        if digits >= a.type.scale:
+            return Val(a.data, a.valid, out)   # nothing to round away
         data = rescale_decimal(a.data, a.type.scale, digits)
         data = rescale_decimal(data, digits, a.type.scale)
         return Val(data, a.valid, out)
@@ -672,6 +881,9 @@ def _log(args, out):
 @register("sign")
 def _sign(args, out):
     (a,) = args
+    if _is_long_dec(a.type):
+        from ..ops import int128 as I
+        return Val(I.sign(a.data).astype(out.storage_dtype), a.valid, out)
     # decimal input: out is decimal(1,0), so the raw -1/0/1 is already
     # correctly scaled; double/bigint keep their type
     return Val(jnp.sign(a.data).astype(out.storage_dtype), a.valid, out)
@@ -1249,19 +1461,23 @@ def infer_call_type(name: str, arg_types: List[Type]) -> Type:
     if name in ("add", "subtract", "multiply", "divide", "modulus"):
         a, b = arg_types
         if isinstance(a, T.DecimalType) or isinstance(b, T.DecimalType):
+            # Presto's decimal operator signatures (reference
+            # type/DecimalOperators.java), precision saturating at the
+            # Int128-backed MAX_PRECISION 38
             sa = a.scale if isinstance(a, T.DecimalType) else 0
-            pa = a.precision if isinstance(a, T.DecimalType) else 18
+            pa = a.precision if isinstance(a, T.DecimalType) else 19
             sb = b.scale if isinstance(b, T.DecimalType) else 0
-            pb = b.precision if isinstance(b, T.DecimalType) else 18
+            pb = b.precision if isinstance(b, T.DecimalType) else 19
             if T.is_floating(a) or T.is_floating(b):
                 return T.DOUBLE
             if name == "multiply":
-                return T.DecimalType(min(18, pa + pb), min(18, sa + sb))
+                return T.DecimalType(min(38, pa + pb), min(38, sa + sb))
             if name == "divide":
-                # Presto: scale = max(s1 + p2 - s2, ...) — simplified:
-                return T.DecimalType(18, max(sa, sb, 6))
+                s = max(sa, sb)
+                p = min(38, pa + sb + max(0, sb - sa))
+                return T.DecimalType(max(p, s), s)
             s = max(sa, sb)
-            p = min(18, max(pa - sa, pb - sb) + s + 1)
+            p = min(38, max(pa - sa, pb - sb) + s + 1)
             return T.DecimalType(p, s)
         t = T.common_super_type(a, b)
         if t is None:

@@ -123,10 +123,11 @@ class AggSpec:
                 (f"{base}$cnt", T.BIGINT)]
 
     def _sum_type(self) -> Type:
-        if self.fn == "avg":
-            # avg accumulates in the input/widened domain
-            return self.output_type if not isinstance(self.output_type, T.DecimalType) \
-                else T.DecimalType(18, self.output_type.scale)
+        if isinstance(self.output_type, T.DecimalType):
+            # decimal sums/avgs accumulate in decimal(38, s) two-limb
+            # state like the reference (DecimalSumAggregation Int128
+            # state; ops/int128.py digit-plane exact sums)
+            return T.DecimalType(38, self.output_type.scale)
         return self.output_type
 
 
@@ -141,9 +142,16 @@ def mark_distinct_flags(batch: Batch,
     for ci in cols:
         c = batch.columns[ci]
         data = c.data
+        ops.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))
+        if getattr(data, "ndim", 1) == 2:
+            from . import int128 as I
+            ops.append(jnp.where(c.validity, I.hi(data),
+                                 jnp.zeros_like(I.hi(data))))
+            ops.append(jnp.where(c.validity, I.lo(data),
+                                 jnp.zeros_like(I.lo(data))))
+            continue
         if data.dtype == jnp.bool_:
             data = data.astype(jnp.int32)
-        ops.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))
         ops.append(jnp.where(c.validity, data, jnp.zeros_like(data)))
     idx = jnp.arange(batch.capacity, dtype=jnp.int64)
     out = jax.lax.sort(ops + [idx], num_keys=len(ops), is_stable=True)
@@ -168,9 +176,18 @@ def _group_key_ops(batch: Batch,
     for gi in group_indices:
         c = batch.columns[gi]
         data = c.data
+        key_ops.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))  # nulls last
+        if getattr(data, "ndim", 1) == 2:
+            # long-decimal limb pairs: lexicographic (hi, unsigned lo)
+            # is value order (ops/int128.py sortable_lo)
+            from . import int128 as I
+            key_ops.append(jnp.where(c.validity, I.hi(data),
+                                     jnp.zeros_like(I.hi(data))))
+            key_ops.append(jnp.where(c.validity, I.sortable_lo(data),
+                                     jnp.zeros_like(I.lo(data))))
+            continue
         if data.dtype == jnp.bool_:
             data = data.astype(jnp.int32)
-        key_ops.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))  # nulls last
         # neutralize NULL rows' data so stale values can't split NULL groups
         key_ops.append(jnp.where(c.validity, data, jnp.zeros_like(data)))
     return key_ops
@@ -394,6 +411,19 @@ def _segment_aggs(
                 m2 = red.sum(jnp.where(live, m2_in + nf * dev * dev, 0.0)) - wdev * wdev / n
                 results.append((mean + wdev / n, m2, cnt))
                 continue
+            stype = agg.state_types()[0][1]
+            if isinstance(stype, T.DecimalType) and stype.is_long:
+                from . import int128 as I
+                val_in = col_data[s_cols[0]]        # [n, 2] limbs
+                cnt_raw = col_data[s_cols[1]]
+                cnt = red.sum(jnp.where(mask, cnt_raw, 0))
+                live = mask & (cnt_raw > 0)
+                if agg.fn in ("sum", "avg"):
+                    val = _checked_sum128(val_in, live, red.sum)
+                else:
+                    val = _minmax128(val_in, live, red, agg.fn)
+                results.append((val, cnt))
+                continue
             val_in = col_data[s_cols[0]]
             cnt_raw = col_data[s_cols[1]]
             cnt_in = jnp.where(mask, cnt_raw, 0)
@@ -464,6 +494,18 @@ def _segment_aggs(
             results.append((val, cnt))
             continue
         acc_t = agg.state_types()[0][1]
+        if isinstance(acc_t, T.DecimalType) and acc_t.is_long:
+            # decimal(38) accumulation: short inputs sign-extend to
+            # limbs, long inputs pass through; sums are exact digit-
+            # plane scatters (ops/int128.py)
+            from . import int128 as I
+            x = data if data.ndim == 2 else I.from_i64(data)
+            if agg.fn in ("sum", "avg"):
+                val = _checked_sum128(x, valid, red.sum)
+            else:
+                val = _minmax128(x, valid, red, agg.fn)
+            results.append((val, cnt))
+            continue
         acc_dtype = acc_t.storage_dtype
         x = data.astype(acc_dtype)
         if agg.fn in ("sum", "avg"):
@@ -479,6 +521,37 @@ def _segment_aggs(
             val = red.max(contrib)
         results.append((val, cnt))
     return results
+
+
+def _checked_sum128(x: jnp.ndarray, live: jnp.ndarray, red_sum) -> jnp.ndarray:
+    """Exact 128-bit sum of limb tiles [n, 2] with overflow poisoning:
+    groups whose true sum exceeds 38 digits (or that merge an already
+    poisoned partial) yield the OVERFLOW_SENTINEL, which raises
+    NUMERIC_VALUE_OUT_OF_RANGE when the value is decoded (the deferred
+    analogue of the reference DecimalSumAggregation throw)."""
+    from . import int128 as I
+    planes = jnp.where(live[:, None], I.digit_sum_tiles(x), 0)
+    val, ovf = I.from_digit_sum_tiles_checked(red_sum(planes))
+    ovf = ovf | ~I.fits_decimal(val, 38)
+    poisoned = red_sum((live & I.is_overflow_sentinel(x))
+                       .astype(jnp.int32)) > 0
+    sent = jnp.broadcast_to(jnp.asarray(I.OVERFLOW_SENTINEL), val.shape)
+    return jnp.where((ovf | poisoned)[..., None], sent, val)
+
+
+def _minmax128(x: jnp.ndarray, live: jnp.ndarray, red, fn: str) -> jnp.ndarray:
+    """Grouped min/max over int128 limb tiles [n, 2]: lexicographic
+    (hi, unsigned lo) in two segment reductions — reduce hi, then lo
+    among rows tied at the winning hi."""
+    from . import int128 as I
+    h = I.hi(x)
+    l = I.sortable_lo(x)
+    op = red.min if fn == "min" else red.max
+    sent_h = _max_sentinel(h.dtype) if fn == "min" else _min_sentinel(h.dtype)
+    mh = op(jnp.where(live, h, sent_h))
+    tie = live & (h == red.gather(mh))
+    ml = op(jnp.where(tie, l, sent_h))
+    return I.pack(mh, ml ^ I.SIGN64)
 
 
 def _rank_reduce(codes: jnp.ndarray, live: jnp.ndarray, red,
@@ -549,6 +622,22 @@ def _finalize(agg: AggSpec, parts: Tuple[jnp.ndarray, ...]) -> Tuple[jnp.ndarray
     valid = cnt > 0
     if agg.fn in ("bool_and", "bool_or"):
         return val > 0, valid
+    if val.ndim == 2:
+        # long-decimal limb state (sum/avg/min/max over decimals)
+        from . import int128 as I
+        out_t = agg.output_type
+        short_out = isinstance(out_t, T.DecimalType) and not out_t.is_long
+        if agg.fn == "avg":
+            den = jnp.clip(cnt, 1, (1 << 31) - 1)
+            q = I.div_round_half_up(val, den)
+            # a poisoned (overflowed) sum stays poisoned through the
+            # divide so the overflow still raises at decode
+            q = I.where(I.is_overflow_sentinel(val),
+                        jnp.broadcast_to(jnp.asarray(I.OVERFLOW_SENTINEL),
+                                         q.shape), q)
+            # |avg| <= max|x| < 10^p: always fits a short output
+            return (I.lo(q) if short_out else q), valid
+        return (I.lo(val) if short_out else val), valid
     if agg.fn == "avg":
         if isinstance(agg.output_type, T.DecimalType):
             den = jnp.maximum(cnt, 1)
@@ -566,6 +655,10 @@ def _percentile_input(batch: Batch, input_idx: int, mask_idx):
     codes map through lexicographic ranks so value order is string order
     (codes are appearance-ordered); unrank maps the winner back to a code."""
     c = batch.columns[input_idx]
+    if getattr(c.data, "ndim", 1) == 2:
+        raise NotImplementedError(
+            "grouped approx_percentile over decimal(>18) is not "
+            "supported (cast to decimal(18,s) or double)")
     valid = c.validity & batch.row_mask
     if mask_idx is not None:
         valid = valid & batch.columns[mask_idx].data.astype(bool)
@@ -714,10 +807,13 @@ def grouped_aggregate(
     cap = output_capacity or batch.capacity
     from_states = mode in ("final", "merge")
     n_keys = len(group_indices)
-    if any(a.fn == "approx_distinct" for a in aggs):
-        # HLL states are [rows, m] tiles; the dense broadcast-compare
-        # reducer would materialize [rows, K, m] — route through the
-        # sort path whose segment ops stay 2D
+    if any(a.fn == "approx_distinct" for a in aggs) or any(
+            getattr(st, "storage_width", None)
+            for a in aggs if a.fn not in DRAIN_FNS
+            for _, st in a.state_types()):
+        # wide states (HLL register tiles, decimal(38) limb pairs) need
+        # the sort path whose segment ops keep a leading row dim; the
+        # dense broadcast-compare reducer would materialize [rows, K, w]
         allow_dense = False
     dense = (_dense_group_code(batch, group_indices,
                                limit=min(cap, _DENSE_GROUP_LIMIT))
@@ -850,6 +946,10 @@ def global_aggregate(
     out_mask = jnp.arange(cap) < 1
 
     def pad(scalar, dtype):
+        scalar = jnp.asarray(scalar)
+        if scalar.ndim:                    # limb pairs and other vectors
+            return jnp.zeros((cap,) + scalar.shape,
+                             dtype=dtype).at[0].set(scalar.astype(dtype))
         return jnp.zeros(cap, dtype=dtype).at[0].set(scalar.astype(dtype))
 
     state_cursor = 0
@@ -872,7 +972,14 @@ def global_aggregate(
                 if agg.mask is not None:
                     valid = valid & \
                         batch.columns[agg.mask].data.astype(bool)
-                counts = qd_update(valid, c.data.astype(jnp.float64))
+                if getattr(c.data, "ndim", 1) == 2:
+                    # long-decimal limbs: histogram over the f64 image
+                    # of the unscaled value (monotone, so percentile
+                    # bins land identically)
+                    from . import int128 as I
+                    counts = qd_update(valid, I.to_f64(c.data))
+                else:
+                    counts = qd_update(valid, c.data.astype(jnp.float64))
             if mode in ("partial", "merge"):
                 (fname, ftype) = agg.state_types()[0]
                 out_fields.append((fname, ftype))
@@ -885,7 +992,11 @@ def global_aggregate(
                 p = float(agg.param if agg.param is not None else 0.5)
                 val, ok = qd_estimate(counts, p)
                 dt = agg.output_type.storage_dtype
-                if not jnp.issubdtype(dt, jnp.floating):
+                if isinstance(agg.output_type, T.DecimalType) \
+                        and agg.output_type.is_long:
+                    from . import int128 as I
+                    val = I.from_f64(jnp.round(val))
+                elif not jnp.issubdtype(dt, jnp.floating):
                     val = jnp.round(val)
                 out_fields.append((agg.name or agg.fn, agg.output_type))
                 out_cols.append(Column(
@@ -946,6 +1057,19 @@ def global_aggregate(
                     live, cols[1].data + nf * dev * dev,
                     0.0)) - wdev * wdev / n
                 parts = (mean + wdev / n, m2, cnt)
+            elif isinstance(agg.state_types()[0][1], T.DecimalType) \
+                    and agg.state_types()[0][1].is_long:
+                from . import int128 as I
+                cnt_raw = cols[1].data
+                live = mask & (cnt_raw > 0)
+                cnt = jnp.sum(jnp.where(mask, cnt_raw, 0))
+                v = cols[0].data               # [n, 2] limb states
+                if agg.fn in ("sum", "avg"):
+                    val = _checked_sum128(
+                        v, live, lambda p: jnp.sum(p, axis=0))
+                else:
+                    val = _minmax128_scalar(v, live, agg.fn)
+                parts = (val, cnt)
             else:
                 cnt_raw = cols[1].data
                 live = mask & (cnt_raw > 0)
@@ -1004,6 +1128,16 @@ def global_aggregate(
                     val = _rank_reduce_scalar(c.data, valid, c.dictionary,
                                               agg.fn)
                     parts = (val, cnt)
+                elif isinstance(agg.state_types()[0][1], T.DecimalType) \
+                        and agg.state_types()[0][1].is_long:
+                    from . import int128 as I
+                    x = c.data if c.data.ndim == 2 else I.from_i64(c.data)
+                    if agg.fn in ("sum", "avg"):
+                        val = _checked_sum128(
+                            x, valid, lambda p: jnp.sum(p, axis=0))
+                    else:
+                        val = _minmax128_scalar(x, valid, agg.fn)
+                    parts = (val, cnt)
                 else:
                     acc_dtype = agg.state_types()[0][1].storage_dtype
                     x = c.data.astype(acc_dtype)
@@ -1041,6 +1175,20 @@ def global_aggregate(
     return Batch(Schema(out_fields), out_cols, out_mask)
 
 
+def _minmax128_scalar(x: jnp.ndarray, live: jnp.ndarray,
+                      fn: str) -> jnp.ndarray:
+    """Global min/max over int128 limb tiles [n, 2] -> [2]."""
+    from . import int128 as I
+    h = I.hi(x)
+    l = I.sortable_lo(x)
+    op = jnp.min if fn == "min" else jnp.max
+    sent = _max_sentinel(h.dtype) if fn == "min" else _min_sentinel(h.dtype)
+    mh = op(jnp.where(live, h, sent))
+    tie = live & (h == mh)
+    ml = op(jnp.where(tie, l, sent))
+    return I.pack(mh, ml ^ I.SIGN64)
+
+
 def _finalize_scalar(agg: AggSpec, parts):
     if agg.fn in _VARIANCE_FNS:
         return _variance_out(agg, *parts)
@@ -1048,6 +1196,21 @@ def _finalize_scalar(agg: AggSpec, parts):
     valid = cnt > 0
     if agg.fn in ("bool_and", "bool_or"):
         return val > 0, valid
+    if val.ndim == 1 and val.shape == (2,) \
+            and agg.fn in ("sum", "avg", "min", "max") \
+            and isinstance(agg.state_types()[0][1], T.DecimalType) \
+            and agg.state_types()[0][1].is_long:
+        from . import int128 as I
+        out_t = agg.output_type
+        short_out = isinstance(out_t, T.DecimalType) and not out_t.is_long
+        if agg.fn == "avg":
+            den = jnp.clip(cnt, 1, (1 << 31) - 1)
+            q = I.div_round_half_up(val, den)
+            q = I.where(I.is_overflow_sentinel(val),
+                        jnp.broadcast_to(jnp.asarray(I.OVERFLOW_SENTINEL),
+                                         q.shape), q)
+            return (I.lo(q) if short_out else q), valid
+        return (I.lo(val) if short_out else val), valid
     if agg.fn == "avg":
         if isinstance(agg.output_type, T.DecimalType):
             den = jnp.maximum(cnt, 1)

@@ -1,0 +1,373 @@
+"""Two-limb int128 vector kernels: the storage/arithmetic layer for
+long decimals (precision 19..38).
+
+The reference models decimal(38) as a Java Int128 in flat limb arrays
+(reference presto-spi/.../spi/block/Int128ArrayBlock.java,
+spi/type/Decimals.java MAX_PRECISION = 38, decimal arithmetic in
+spi/type/UnscaledDecimal128Arithmetic.java). The TPU shape of the same
+idea: a column of long decimals is an [capacity, 2] i64 tile —
+``value = hi * 2**64 + (lo mod 2**64)`` with ``hi`` signed and ``lo``
+holding the low 64 bits' two's-complement pattern — and every operation
+is a handful of branch-free vector ops over the limbs. i64 adds wrap
+two's-complement on XLA, so carries come from unsigned compares
+(sign-bit-flipped signed compares), never per-element control flow.
+
+Multiplication and base-10 rescaling decompose limbs into 32-bit
+digits: 32x32 partial products fit u64 exactly, and short division by a
+< 2**31 divisor runs as a static 4-step digit loop with a carried
+remainder (each step's ``r * 2**32 + digit`` fits i64). Exact sums over
+rows decompose the same way: four digit segment-sums recombine with
+carry propagation (ops/scatter_agg.py applies the identical trick to
+make 64-bit group sums fast; here it makes 128-bit sums *possible*).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+SIGN64 = jnp.int64(-(1 << 63))
+MASK32 = jnp.int64(0xFFFFFFFF)
+
+#: largest value magnitude a decimal(38) may hold, as Python int
+MAX_UNSCALED = 10 ** 38 - 1
+
+
+# -- packing ----------------------------------------------------------------
+
+def pack(hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([hi.astype(jnp.int64), lo.astype(jnp.int64)], axis=-1)
+
+
+def hi(x: jnp.ndarray) -> jnp.ndarray:
+    return x[..., 0]
+
+
+def lo(x: jnp.ndarray) -> jnp.ndarray:
+    return x[..., 1]
+
+
+def limbs_of(value: int) -> Tuple[int, int]:
+    """Python int -> (hi, lo) limb ints (lo as SIGNED two's complement)."""
+    lo_u = value & ((1 << 64) - 1)
+    h = value >> 64
+    if not -(1 << 63) <= h < (1 << 63):
+        raise OverflowError(f"{value} out of int128 range")
+    return h, lo_u - (1 << 64) if lo_u >= (1 << 63) else lo_u
+
+
+def int_of(h: int, l: int) -> int:
+    """(hi, lo) limb ints -> Python int."""
+    return (int(h) << 64) + (int(l) & ((1 << 64) - 1))
+
+
+def const(value: int) -> jnp.ndarray:
+    h, l = limbs_of(value)
+    return pack(jnp.int64(h), jnp.int64(l))
+
+
+def from_i64(v: jnp.ndarray) -> jnp.ndarray:
+    """Sign-extend i64 values into limb pairs."""
+    v = v.astype(jnp.int64)
+    return pack(v >> 63, v)
+
+
+# -- compares ---------------------------------------------------------------
+
+def _ult(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Unsigned < over i64 bit patterns."""
+    return (a ^ SIGN64) < (b ^ SIGN64)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return (hi(a) == hi(b)) & (lo(a) == lo(b))
+
+
+def lt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return (hi(a) < hi(b)) | ((hi(a) == hi(b)) & _ult(lo(a), lo(b)))
+
+
+def le(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return lt(a, b) | eq(a, b)
+
+
+def is_neg(x: jnp.ndarray) -> jnp.ndarray:
+    return hi(x) < 0
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    return (hi(x) == 0) & (lo(x) == 0)
+
+
+def sign(x: jnp.ndarray) -> jnp.ndarray:
+    """-1 / 0 / 1 as i64."""
+    return jnp.where(is_neg(x), jnp.int64(-1),
+                     jnp.where(is_zero(x), jnp.int64(0), jnp.int64(1)))
+
+
+def sortable_lo(x: jnp.ndarray) -> jnp.ndarray:
+    """lo limb transformed so SIGNED i64 order matches unsigned order
+    (for (hi, sortable_lo) lexicographic sort keys)."""
+    return lo(x) ^ SIGN64
+
+
+# -- add / sub / neg --------------------------------------------------------
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    l = lo(a) + lo(b)                       # wraps mod 2^64
+    carry = _ult(l, lo(a)).astype(jnp.int64)
+    return pack(hi(a) + hi(b) + carry, l)
+
+
+def add_overflows(a: jnp.ndarray, b: jnp.ndarray,
+                  s: jnp.ndarray) -> jnp.ndarray:
+    """True where a + b = s overflowed int128 (same-sign operands,
+    different-sign result)."""
+    return ((hi(a) < 0) == (hi(b) < 0)) & ((hi(s) < 0) != (hi(a) < 0))
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    l = -lo(a)                              # wraps
+    h = jnp.where(lo(a) == 0, -hi(a), ~hi(a))
+    return pack(h, l)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return add(a, neg(b))
+
+
+def abs_(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(is_neg(x)[..., None], neg(x), x)
+
+
+def where(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise select over limb pairs (cond is row-shaped)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# -- digit decomposition ----------------------------------------------------
+
+def digits32(x: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """(d0, d1, d2, d3): x = sum di * 2**(32 i); d0..d2 in [0, 2**32),
+    d3 = arithmetic high digit (signed). All i64."""
+    d0 = lo(x) & MASK32
+    d1 = (lo(x) >> 32) & MASK32
+    d2 = hi(x) & MASK32
+    d3 = hi(x) >> 32
+    return d0, d1, d2, d3
+
+
+def from_digits(d0, d1, d2, d3) -> jnp.ndarray:
+    """Recombine possibly-carrying digit values (each i64; d0..d2 may
+    exceed 32 bits, carries propagate upward; d3 absorbs the rest)."""
+    t0 = d0
+    c0 = t0 >> 32
+    t1 = d1 + c0
+    c1 = t1 >> 32
+    t2 = d2 + c1
+    c2 = t2 >> 32
+    t3 = d3 + c2
+    l = (t0 & MASK32) | ((t1 & MASK32) << 32)
+    h = (t2 & MASK32) | ((t3 & MASK32) << 32)
+    return pack(h, l)
+
+
+# -- multiplication ---------------------------------------------------------
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Signed 128x128 -> low 128 product + overflow flag (any bits past
+    the 127-bit magnitude). Magnitude multiply, sign fixup."""
+    an, bn = is_neg(a), is_neg(b)
+    am, bm = abs_(a), abs_(b)
+    a0, a1, a2, a3 = digits32(am)
+    b0, b1, b2, b3 = digits32(bm)
+    ad = [a0, a1, a2, a3]
+    bd = [b0, b1, b2, b3]
+
+    def p(i: int, j: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        # 32x32 partial product, split into (lo32, hi32); u64 is exact
+        full = ad[i].astype(jnp.uint64) * bd[j].astype(jnp.uint64)
+        return ((full & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64),
+                (full >> jnp.uint64(32)).astype(jnp.int64))
+
+    # accumulate digit sums (each term < 2^32; <= 8 terms, fits i64)
+    s = [jnp.zeros_like(a0) for _ in range(5)]
+    overflow = jnp.zeros(a0.shape, dtype=bool)
+    for i in range(4):
+        for j in range(4):
+            plo, phi = p(i, j)
+            k = i + j
+            if k < 4:
+                s[k] = s[k] + plo
+                s[k + 1] = s[k + 1] + phi
+            else:
+                overflow = overflow | (plo != 0) | (phi != 0)
+    m = from_digits(s[0], s[1], s[2], s[3])
+    # bits spilling past digit 3, magnitude sign bit set, or high
+    # partial of digit 3 all mean the magnitude left 127 bits
+    carry_out = (s[3] + ((s[2] + ((s[1] + (s[0] >> 32)) >> 32)) >> 32)) >> 32
+    overflow = overflow | (s[4] != 0) | (carry_out != 0) | is_neg(m)
+    out = jnp.where((an ^ bn)[..., None], neg(m), m)
+    return out, overflow
+
+
+def mul_small(a: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """a * k for a static Python int k >= 0 (k < 2**63)."""
+    return mul(a, jnp.broadcast_to(const(k), a.shape))
+
+
+# -- short division (magnitudes) --------------------------------------------
+
+def divmod_small_abs(x: jnp.ndarray, d) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Nonnegative x divided by divisor d (static int or i64 array,
+    1 <= d < 2**31): (quotient limbs, remainder i64). Classic base-2**32
+    short division — remainder < 2**31 keeps every step in i64."""
+    if isinstance(d, int):
+        d = jnp.int64(d)
+    d = jnp.clip(d.astype(jnp.int64), 1, (1 << 31) - 1)
+    d0, d1, d2, d3 = digits32(x)
+    r = jnp.zeros_like(d0)
+    qs = []
+    for di in (d3, d2, d1, d0):
+        cur = (r << 32) + di
+        qs.append(cur // d)
+        r = cur % d
+    q3, q2, q1, q0 = qs
+    l = (q0 & MASK32) | ((q1 & MASK32) << 32)
+    h = (q2 & MASK32) | ((q3 & MASK32) << 32)
+    return pack(h, l), r
+
+
+def div_round_half_up(x: jnp.ndarray, d) -> jnp.ndarray:
+    """Signed x / d (d as in divmod_small_abs), rounding half up away
+    from zero (Presto decimal rounding)."""
+    if isinstance(d, int):
+        d = jnp.int64(d)
+    neg_in = is_neg(x)
+    q, r = divmod_small_abs(abs_(x), d)
+    bump = (2 * r >= d.astype(jnp.int64)).astype(jnp.int64)
+    q = add(q, pack(jnp.zeros_like(bump), bump))
+    return jnp.where(neg_in[..., None], neg(q), q)
+
+
+# -- base-10 rescale --------------------------------------------------------
+
+_P9 = 10 ** 9
+
+
+def rescale(x: jnp.ndarray, delta: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x * 10**delta (delta > 0) or round-half-up(x / 10**-delta)
+    (delta < 0). Static delta. Returns (value, overflow)."""
+    overflow = jnp.zeros(x.shape[:-1], dtype=bool)
+    if delta == 0:
+        return x, overflow
+    if delta > 0:
+        while delta > 0:
+            step = min(delta, 18)
+            x, o = mul_small(x, 10 ** step)
+            overflow = overflow | o
+            delta -= step
+        return x, overflow
+    k = -delta
+    # all but the last step truncate (exact digit drops happen only at
+    # the final rounding position, matching integer half-up semantics)
+    neg_in = is_neg(x)
+    m = abs_(x)
+    while k > 9:
+        m, _ = divmod_small_abs(m, _P9)
+        k -= 9
+    d = 10 ** k
+    q, r = divmod_small_abs(m, d)
+    bump = (2 * r >= d).astype(jnp.int64)
+    q = add(q, pack(jnp.zeros_like(bump), bump))
+    return jnp.where(neg_in[..., None], neg(q), q), overflow
+
+
+# -- float conversion -------------------------------------------------------
+
+def to_f64(x: jnp.ndarray) -> jnp.ndarray:
+    lo_u = (lo(x) ^ SIGN64).astype(jnp.float64) + jnp.float64(2.0 ** 63)
+    return hi(x).astype(jnp.float64) * jnp.float64(2.0 ** 64) + lo_u
+
+
+def from_f64(v: jnp.ndarray) -> jnp.ndarray:
+    """Round-to-nearest f64 -> int128 (|v| must be < 2**127; f64 only
+    carries 53 significant bits, so low bits of huge values are zeros)."""
+    v = jnp.round(v)
+    h = jnp.floor(v / (2.0 ** 64))
+    frac = v - h * (2.0 ** 64)
+    # the quotient rounds, so frac can fall outside [0, 2^64) by an ulp
+    # of v — renormalize or the lo limb is off by a whole 2^64
+    h = jnp.where(frac < 0, h - 1, jnp.where(frac >= 2.0 ** 64, h + 1, h))
+    frac = jnp.where(frac < 0, frac + 2.0 ** 64,
+                     jnp.where(frac >= 2.0 ** 64, frac - 2.0 ** 64, frac))
+    l_signed = jnp.where(frac >= 2.0 ** 63,
+                         frac - 2.0 ** 64, frac).astype(jnp.int64)
+    return pack(h.astype(jnp.int64), l_signed)
+
+
+# -- exact row sums via digit decomposition ---------------------------------
+
+def digit_sum_tiles(x: jnp.ndarray) -> jnp.ndarray:
+    """[..., 4] digit planes of limb tiles, ready for per-digit
+    segment/global sums (sums of < 2**31 rows cannot overflow i64)."""
+    d0, d1, d2, d3 = digits32(x)
+    return jnp.stack([d0, d1, d2, d3], axis=-1)
+
+
+def from_digit_sum_tiles(s: jnp.ndarray) -> jnp.ndarray:
+    """Recombine [..., 4] summed digit planes into limb pairs."""
+    return from_digits(s[..., 0], s[..., 1], s[..., 2], s[..., 3])
+
+
+def from_digit_sum_tiles_checked(s: jnp.ndarray):
+    """Like from_digit_sum_tiles but also detects int128 overflow: the
+    carried top digit must fit 32 signed bits, and digit sums of up to
+    2^31 rows keep it exactly in i64 — so detection sees the TRUE sum,
+    never a wrapped one. Returns (value, overflow)."""
+    t0 = s[..., 0]
+    c0 = t0 >> 32
+    t1 = s[..., 1] + c0
+    c1 = t1 >> 32
+    t2 = s[..., 2] + c1
+    c2 = t2 >> 32
+    t3 = s[..., 3] + c2
+    ovf = t3 != ((t3 << 32) >> 32)
+    l = (t0 & MASK32) | ((t1 & MASK32) << 32)
+    h = (t2 & MASK32) | ((t3 & MASK32) << 32)
+    return pack(h, l), ovf
+
+
+#: poisoned limb pattern for decimal aggregate overflow: unreachable by
+#: any value with |v| <= 10^38 (|hi| would be < 2^63), detected at
+#: result decode (types.DecimalType.from_storage) and re-poisoned
+#: through merges — the deferred-raise analogue of the reference's
+#: throw in DecimalSumAggregation
+OVERFLOW_SENTINEL = np.array([-(1 << 63), 1], dtype=np.int64)
+
+
+def is_overflow_sentinel(x: jnp.ndarray) -> jnp.ndarray:
+    return (hi(x) == jnp.int64(-(1 << 63))) & (lo(x) == jnp.int64(1))
+
+
+# -- bounds -----------------------------------------------------------------
+
+def fits_decimal(x: jnp.ndarray, precision: int) -> jnp.ndarray:
+    """|x| <= 10**precision - 1 (the reference's overflow contract,
+    UnscaledDecimal128Arithmetic.overflows)."""
+    bound = const(10 ** precision - 1)
+    m = abs_(x)
+    return le(m, jnp.broadcast_to(bound, m.shape)) & ~is_neg(m)
+
+
+# -- host conversion --------------------------------------------------------
+
+def np_limbs(values, null_value: int = 0) -> np.ndarray:
+    """Python ints -> [n, 2] i64 numpy limb array (host-side builder)."""
+    out = np.empty((len(values), 2), dtype=np.int64)
+    for i, v in enumerate(values):
+        h, l = limbs_of(null_value if v is None else int(v))
+        out[i, 0] = h
+        out[i, 1] = l
+    return out

@@ -49,6 +49,15 @@ def _key_arrays(batch: Batch, key_cols: Sequence[int]
     for i in key_cols:
         c = batch.columns[i]
         d = c.data
+        if getattr(d, "ndim", 1) == 2:
+            # long-decimal limb pairs: two lexicographic operands
+            # (signed hi, unsigned-ordered lo) — downstream compares
+            # key tuples generically, so arity just grows by one
+            from .int128 import SIGN64
+            ops.append(d[..., 0])
+            ops.append(d[..., 1] ^ SIGN64)
+            valid = c.validity if valid is None else valid & c.validity
+            continue
         if jnp.issubdtype(d.dtype, jnp.floating):
             # +0.0 canonicalization (-0.0 + 0.0 == +0.0): SQL equality
             # joins the two zeros. NaN keys compare by bit pattern

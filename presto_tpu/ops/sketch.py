@@ -205,6 +205,10 @@ def hashed_column(data: jnp.ndarray, dictionary) -> jnp.ndarray:
         table = jnp.asarray(hash_dictionary(tuple(dictionary)))
         codes = jnp.clip(data.astype(jnp.int32), 0, table.shape[0] - 1)
         return splitmix64(jnp.take(table, codes, axis=0).astype(jnp.int64))
+    if getattr(data, "ndim", 1) == 2:
+        # long-decimal limb pairs: chain both limbs through the mixer
+        return splitmix64(data[..., 0] ^
+                          splitmix64(data[..., 1]).astype(jnp.int64))
     if data.dtype == jnp.bool_:
         return splitmix64(data.astype(jnp.int64))
     if jnp.issubdtype(data.dtype, jnp.floating):

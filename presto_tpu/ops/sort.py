@@ -61,6 +61,17 @@ def _sortable(col: Column, key: SortKey) -> List[jnp.ndarray]:
     """Transform one column into ascending-sortable operand(s):
     [null_rank, data'] where smaller sorts first."""
     data = col.data
+    nulls_first = key.effective_nulls_first()
+    null_rank = jnp.where(col.validity, 1, 0) if nulls_first else jnp.where(col.validity, 0, 1)
+    if getattr(data, "ndim", 1) == 2:
+        # long-decimal limb pairs: two operands (hi, unsigned-ordered lo)
+        from . import int128 as I
+        h, l = I.hi(data), I.sortable_lo(data)
+        if not key.ascending:
+            h, l = ~h, ~l
+        h = jnp.where(col.validity, h, jnp.zeros_like(h))
+        l = jnp.where(col.validity, l, jnp.zeros_like(l))
+        return [null_rank.astype(jnp.int32), h, l]
     if col.type.is_string:
         data = rank_codes(data, col.dictionary)
     if data.dtype == jnp.bool_:
@@ -71,8 +82,6 @@ def _sortable(col: Column, key: SortKey) -> List[jnp.ndarray]:
         else:
             # avoid INT_MIN overflow: flip bits instead of negating
             data = ~data
-    nulls_first = key.effective_nulls_first()
-    null_rank = jnp.where(col.validity, 1, 0) if nulls_first else jnp.where(col.validity, 0, 1)
     # NULL rows tie on null_rank; neutralize their data operand so stale
     # values never order two NULLs differently from each other's payload
     data = jnp.where(col.validity, data, jnp.zeros_like(data))

@@ -202,6 +202,12 @@ def evaluate_window(
         c = batch.columns[pi]
         operands.append(jnp.where(c.validity, 0, 1).astype(jnp.int32))
         d = c.data
+        if getattr(d, "ndim", 1) == 2:
+            # long-decimal limb pairs: two operands (hi, unsigned lo)
+            from .int128 import SIGN64
+            operands.append(d[..., 0])
+            operands.append(d[..., 1] ^ SIGN64)
+            continue
         operands.append(d.astype(jnp.int32) if d.dtype == jnp.bool_ else d)
     n_part_ops = len(operands)
     for k in order_by:

@@ -76,6 +76,10 @@ def hash_partition_ids(batch: Batch, key_cols: Sequence[int],
         elif jnp.issubdtype(data.dtype, jnp.floating):
             # value-deterministic int image (collisions only co-locate)
             data = (data * 65536.0).astype(jnp.int64)
+        if getattr(data, "ndim", 1) == 2:
+            # long-decimal limb pairs fold into one word first
+            data = data[..., 0] ^ _splitmix64(
+                data[..., 1].astype(jnp.uint64)).astype(jnp.int64)
         h = _splitmix64(h ^ data.astype(jnp.uint64)
                         ^ (c.validity.astype(jnp.uint64) << jnp.uint64(63)))
     return (h % jnp.uint64(n_partitions)).astype(jnp.int32)
@@ -112,7 +116,9 @@ def repartition_by_ids(batch: Batch, pid: jnp.ndarray,
                                  (n_partitions,) + c.validity.shape)
         rdata = jax.lax.all_to_all(data, axis_name, 0, 0, tiled=False)
         rvalid = jax.lax.all_to_all(valid, axis_name, 0, 0, tiled=False)
-        out_cols.append(Column(c.type, rdata.reshape(-1),
+        # fold (peer, row) but keep trailing dims (limb pairs, tiles)
+        out_cols.append(Column(c.type,
+                               rdata.reshape((-1,) + rdata.shape[2:]),
                                rvalid.reshape(-1) & out_mask, c.dictionary))
     return Batch(batch.schema, out_cols, out_mask)
 
@@ -165,7 +171,8 @@ def repartition_by_hash_compact(batch: Batch, key_cols: Sequence[int],
         v = jnp.take(c.validity, src, axis=0) & slot_live
         rd = jax.lax.all_to_all(d, axis_name, 0, 0, tiled=False)
         rv = jax.lax.all_to_all(v, axis_name, 0, 0, tiled=False)
-        out_cols.append(Column(c.type, rd.reshape(-1),
+        out_cols.append(Column(c.type,
+                               rd.reshape((-1,) + rd.shape[2:]),
                                rv.reshape(-1) & out_mask, c.dictionary))
     return Batch(batch.schema, out_cols, out_mask)
 

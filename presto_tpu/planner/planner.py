@@ -1179,9 +1179,20 @@ class _Planner:
                                 ignore_order=not has_order)
         if fn in ("sum", "avg", "min", "max", "count"):
             arg, arg_t = col_of(call.args[0])
-            out_t = (T.BIGINT if fn == "count" else
-                     T.DOUBLE if fn == "avg" else
-                     _agg_output_type(fn, arg_t))
+            if isinstance(arg_t, T.DecimalType) and arg_t.is_long:
+                raise AnalysisError(
+                    "window aggregates over decimal(>18) are not "
+                    "supported yet (cast to decimal(18,s) or double)")
+            if fn == "sum" and isinstance(arg_t, T.DecimalType):
+                # the window kernel runs i64 cumsum differences, which
+                # are exact for short-decimal inputs; keep the short
+                # output type here (the group-by path widens to
+                # decimal(38) like the reference)
+                out_t: T.Type = T.DecimalType(18, arg_t.scale)
+            else:
+                out_t = (T.BIGINT if fn == "count" else
+                         T.DOUBLE if fn == "avg" else
+                         _agg_output_type(fn, arg_t))
             return WindowFnSpec(fn, (arg,), out_t, name,
                                 ignore_order=not has_order)
         raise AnalysisError(f"window function {fn}() is not supported")
@@ -1509,7 +1520,9 @@ def _agg_output_type(fn: str, arg: T.Type) -> T.Type:
         return T.BIGINT
     if fn == "sum":
         if isinstance(arg, T.DecimalType):
-            return T.DecimalType(18, arg.scale)
+            # reference DecimalSumAggregation: sum(decimal) is always
+            # decimal(38, s) with Int128 state
+            return T.DecimalType(38, arg.scale)
         if T.is_integral(arg):
             return T.BIGINT
         return T.DOUBLE if isinstance(arg, (T.DoubleType, T.RealType)) \

@@ -124,7 +124,12 @@ def literal_type(node: A.Expression) -> T.Type:
         d = node.value.as_tuple()
         scale = max(0, -int(d.exponent))
         precision = max(len(d.digits), scale)
-        return T.DecimalType(min(precision, 18), min(scale, 18))
+        # literals past 38 digits would silently round; refuse like the
+        # reference parser (Decimals.parse overflow)
+        if precision > 38:
+            raise AnalysisError(
+                f"DECIMAL literal exceeds 38 digits: {node.value}")
+        return T.DecimalType(precision, scale)
     if isinstance(node, A.DoubleLiteral):
         return T.DOUBLE
     if isinstance(node, A.StringLiteral):
@@ -150,9 +155,23 @@ def coerce(e: ir.Expr, to: T.Type) -> ir.Expr:
         if isinstance(to, (T.DoubleType, T.RealType)):
             return ir.lit(float(v), to)
         if T.is_integral(to):
-            return ir.lit(int(v), to)
+            # Presto integral casts round half-up and range-check; an
+            # out-of-range constant falls through to the runtime cast,
+            # which raises through the row error channel
+            import decimal as _d
+            with _d.localcontext() as ctx:
+                ctx.prec = 60
+                iv = int(Decimal(str(v)).quantize(
+                    0, rounding=_d.ROUND_HALF_UP))
+            bits = {"tinyint": 7, "smallint": 15, "integer": 31,
+                    "bigint": 63}[to.name]
+            if -(1 << bits) <= iv < (1 << bits):
+                return ir.lit(iv, to)
+            return ir.cast(e, to)
         if isinstance(to, T.DecimalType):
-            return ir.lit(Decimal(str(v)), to)
+            if abs(Decimal(str(v))) < Decimal(10) ** (to.precision - to.scale):
+                return ir.lit(Decimal(str(v)), to)
+            return ir.cast(e, to)
         if isinstance(to, (T.VarcharType, T.CharType)):
             return ir.lit(str(v), to)
     return ir.cast(e, to)

@@ -936,6 +936,18 @@ class _Parser:
         return base
 
     def _ident_primary(self) -> A.Expression:
+        # DECIMAL 'ddd.dd' typed literal (reference SqlBase.g4
+        # DECIMAL_VALUE / AstBuilder.visitTypeConstructor)
+        t = self.peek()
+        if t.kind == "IDENT" and t.text.lower() == "decimal" \
+                and self.peek(1).kind == "STRING":
+            self.next()
+            s = self.next()
+            try:
+                return A.DecimalLiteral(Decimal(s.text.strip()))
+            except Exception as e:
+                raise SqlSyntaxError(f"bad DECIMAL literal {s.text!r}",
+                                     t.line, t.col) from e
         name = self.identifier()
         # function call?
         if self.at_op("("):

@@ -149,10 +149,13 @@ class RealType(Type):
 
 @dataclasses.dataclass(frozen=True)
 class DecimalType(Type):
-    """Short decimal: int64 storage scaled by 10**scale.
+    """DECIMAL(p, s): unscaled-integer storage scaled by 10**scale.
 
-    Presto supports precision up to 38 via Int128; we support p<=18 for now
-    (covers all of TPC-H/TPC-DS). Reference: spi/type/DecimalType.java.
+    p <= 18 ("short") stores one i64 per value; p in 19..38 ("long")
+    stores a two-limb [capacity, 2] i64 tile — value = hi * 2**64 +
+    (lo mod 2**64), the TPU-columnar shape of the reference's Int128
+    (reference spi/type/DecimalType.java MAX_PRECISION = 38,
+    spi/block/Int128ArrayBlock.java; limb kernels in ops/int128.py).
     """
 
     precision: int = 18
@@ -160,36 +163,72 @@ class DecimalType(Type):
     name: ClassVar[str] = "decimal"
 
     def __post_init__(self):
-        if not (1 <= self.precision <= 18):
+        if not (1 <= self.precision <= 38):
             raise ValueError(f"unsupported decimal precision {self.precision}")
         if not (0 <= self.scale <= self.precision):
             raise ValueError(f"bad decimal scale {self.scale}")
 
     @property
+    def is_long(self) -> bool:
+        return self.precision > 18
+
+    @property
     def storage_dtype(self):
         return jnp.int64
+
+    @property
+    def storage_width(self):
+        # None (absent) for short decimals keeps their 1-D columns
+        return 2 if self.is_long else None
 
     def display(self) -> str:
         return f"decimal({self.precision},{self.scale})"
 
-    def to_storage(self, value: Any) -> int:
+    def null_storage(self):
+        return (0, 0) if self.is_long else 0
+
+    def to_storage(self, value: Any):
         # round-half-up like Presto's Decimals.encodeScaledValue
+        import decimal
         from decimal import Decimal, ROUND_HALF_UP
 
-        d = Decimal(str(value)).quantize(
-            Decimal(1).scaleb(-self.scale), rounding=ROUND_HALF_UP
-        )
-        unscaled = int(d.scaleb(self.scale))
+        with decimal.localcontext() as ctx:
+            ctx.prec = 60                   # enough for 38-digit values
+            d = Decimal(str(value)).quantize(
+                Decimal(1).scaleb(-self.scale), rounding=ROUND_HALF_UP
+            )
+            unscaled = int(d.scaleb(self.scale))
         if abs(unscaled) >= 10 ** self.precision:
             raise ValueError(
                 f"value {value!r} out of range for {self.display()}"
             )
+        if self.is_long:
+            lo_u = unscaled & ((1 << 64) - 1)
+            return (unscaled >> 64,
+                    lo_u - (1 << 64) if lo_u >= (1 << 63) else lo_u)
         return unscaled
 
     def from_storage(self, value: Any):
+        import decimal
         from decimal import Decimal
 
-        return Decimal(int(value)).scaleb(-self.scale)
+        with decimal.localcontext() as ctx:
+            ctx.prec = 60
+            if self.is_long:
+                h, l = (int(value[0]), int(value[1]))
+                if h == -(1 << 63) and l == 1:
+                    # ops/int128.py OVERFLOW_SENTINEL: a decimal
+                    # aggregate exceeded 38 digits (deferred raise,
+                    # reference DecimalSumAggregation overflow throw)
+                    from .errors import NUMERIC_VALUE_OUT_OF_RANGE, QueryError
+                    raise QueryError(
+                        NUMERIC_VALUE_OUT_OF_RANGE,
+                        "decimal aggregate overflowed 38 digits")
+                unscaled = (h << 64) + (l & ((1 << 64) - 1))
+                if unscaled >= 1 << 127:
+                    unscaled -= 1 << 128
+                return Decimal(unscaled).scaleb(-self.scale)
+            return Decimal(int(value)).scaleb(-self.scale)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -493,17 +532,14 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
             # decimal + real -> real in Presto
             return REAL
         if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+            # widen to long decimal past 18 digits like the reference
+            # (TypeCoercion over Int128-backed DecimalType; precision
+            # saturates at 38 keeping the wider scale)
             scale = max(a.scale, b.scale)
             int_digits = max(a.precision - a.scale, b.precision - b.scale)
-            if int_digits + scale > 18:
-                # Presto widens to long decimal (Int128); we cap at short
-                # decimal and refuse rather than silently losing digits.
-                return None
-            return DecimalType(int_digits + scale, scale)
+            return DecimalType(min(int_digits + scale, 38), scale)
         if isinstance(a, DecimalType) and is_integral(b):
-            # bigint needs 19 integer digits, beyond short-decimal range;
-            # coerce bigint+decimal via decimal(18,0) only when it fits.
-            int_digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 18}[b.name]
+            int_digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}[b.name]
             return common_super_type(a, DecimalType(int_digits, 0))
         if isinstance(b, DecimalType) and is_integral(a):
             return common_super_type(b, a)
