@@ -245,6 +245,10 @@ class _Executor:
         self._materialized: Dict[PlanNode, List[Batch]] = {}
         # runtime (dynamic-filter) scan bounds: scan node -> [(col, lo, hi)]
         self.dynamic_pushdown: Dict[PlanNode, List[Tuple]] = {}
+        # grouped (lifespan) execution: scan node -> the split list it
+        # is currently restricted to (one bucket's files; reference
+        # execution/Lifespan.java:26 + scheduler/group/LifespanScheduler)
+        self.lifespan_splits: Dict[PlanNode, List] = {}
         from ..memory import QueryMemoryPool
 
         def _int_prop(name, default=None):
@@ -409,6 +413,10 @@ class _Executor:
         n_threads = int(self.session.properties.get("scan_threads", 2))
         splits = conn.split_manager.splits(
             node.table, max(n_threads, 1))
+        lifespan = self.lifespan_splits.get(node)
+        if lifespan is not None:
+            # grouped execution: only this bucket's splits this pass
+            splits = lifespan
         if n_threads <= 1 or len(splits) <= 1:
             for split in splits:
                 src = conn.page_source(split, list(node.columns),
@@ -751,7 +759,88 @@ class _Executor:
         finally:
             buf.close()
 
+    def _lifespan_partitions(self, node: JoinNode):
+        """Partition-wise (grouped / lifespan) execution check: when both
+        join sides scan hive-partitioned tables whose partition keys are
+        covered pairwise by the equi-join keys, rows only ever match
+        within equal partition values — so the join can run one bucket
+        at a time, bounding peak HBM at O(bucket) instead of O(table)
+        (reference execution/Lifespan.java:26,
+        execution/scheduler/group/LifespanScheduler.java,
+        PipelineExecutionStrategy.GROUPED_EXECUTION).
+
+        Returns (left_scan, right_scan, ordered common partition value
+        tuples) or None."""
+        if node.join_type != "inner":
+            return None
+        if not bool_property(self.session, "grouped_execution", True):
+            return None
+
+        def unwrap(n):
+            while isinstance(n, FilterNode):
+                n = n.child
+            return n if isinstance(n, TableScanNode) else None
+
+        ls, rs = unwrap(node.left), unwrap(node.right)
+        if ls is None or rs is None or ls is rs:
+            return None
+        # memoized (shared-subtree) scans cache their first bucket's
+        # output; never lifespan-restrict them
+        if any(n in self._ever_shared
+               for n in (ls, rs, node.left, node.right)):
+            return None
+
+        def partition_info(scan):
+            conn = self.session.catalogs.get(scan.catalog)
+            keys_fn = getattr(conn, "partition_keys", None)
+            if keys_fn is None:
+                return None
+            keys = keys_fn(scan.table.table)
+            if not keys:
+                return None
+            try:
+                idx = [scan.columns.index(k) for k in keys]
+            except ValueError:
+                return None     # partition column not even scanned
+            # one split enumeration per side; bucket selection later is
+            # a dict lookup, not a directory re-walk per bucket
+            by_value: Dict[Tuple, List] = {}
+            for s in conn.split_manager.splits(scan.table, 1):
+                if len(s.info) > 1:
+                    by_value.setdefault(tuple(s.info[1]), []).append(s)
+            return idx, by_value
+
+        li, ri = partition_info(ls), partition_info(rs)
+        if li is None or ri is None or len(li[0]) != len(ri[0]):
+            return None
+        # every partition-key position must be an equi-join pair
+        pairs = set(zip(node.left_keys, node.right_keys))
+        if any((lk, rk) not in pairs
+               for lk, rk in zip(li[0], ri[0])):
+            return None
+        common = sorted(li[1].keys() & ri[1].keys())
+        return ls, rs, [(li[1][v], ri[1][v]) for v in common]
+
     def _JoinNode(self, node: JoinNode) -> Iterator[Batch]:
+        lifespan = self._lifespan_partitions(node)
+        if lifespan is not None:
+            ls, rs, buckets = lifespan
+            for lsplits, rsplits in buckets:
+                self.lifespan_splits[ls] = lsplits
+                self.lifespan_splits[rs] = rsplits
+                # dynamic-filter bounds are bucket-local: bounds pushed
+                # while joining bucket k must not prune bucket k+1
+                saved_dyn = dict(self.dynamic_pushdown)
+                try:
+                    yield from self._join_once(node)
+                finally:
+                    self.lifespan_splits.pop(ls, None)
+                    self.lifespan_splits.pop(rs, None)
+                    self.dynamic_pushdown = saved_dyn
+            return
+        yield from self._join_once(node)
+
+    def _join_once(self, node: JoinNode) -> Iterator[Batch]:
         payload = list(range(len(node.right.fields)))
         payload_names = [f"$b{i}" for i in payload]
         if node.join_type == "cross":

@@ -116,8 +116,16 @@ class LocalRunner:
                 properties={**session.properties, **(properties or {})})
         if isinstance(stmt, A.Query):
             plan = optimize(plan_query(stmt, session), session)
-            return execute_plan(plan, session, self.rows_per_batch,
-                                cancel_event=cancel_event)
+            try:
+                return execute_plan(plan, session, self.rows_per_batch,
+                                    cancel_event=cancel_event)
+            finally:
+                if session is not self.session:
+                    # the executor stamped its memory stats on the
+                    # per-query overlay; surface them on the shared
+                    # session like property-less queries do
+                    self.session.last_memory_stats = \
+                        session.last_memory_stats
         if isinstance(stmt, A.Explain):
             if not isinstance(stmt.statement, A.Query):
                 raise ValueError("EXPLAIN requires a query")

@@ -447,7 +447,7 @@ def _long_decimal_arith(op: str, a: Val, b: Val, out, valid) -> Val:
             unscaled = (b.type.to_storage(b.literal)
                         if isinstance(b.type, T.DecimalType)
                         else int(b.literal))
-            small_literal = abs(unscaled) < 2 ** 31
+            small_literal = abs(unscaled) <= 2 ** 31
         if not (small_type or small_literal):
             raise NotImplementedError(
                 "long decimal division needs a divisor with unscaled "

@@ -539,6 +539,24 @@ def _checked_sum128(x: jnp.ndarray, live: jnp.ndarray, red_sum) -> jnp.ndarray:
     return jnp.where((ovf | poisoned)[..., None], sent, val)
 
 
+class _GlobalReducer:
+    """Single-group reducer with the _SegReducers surface (min/max/sum
+    collapse all rows; gather broadcasts), so grouped and global code
+    paths share the int128 kernels below."""
+
+    def sum(self, x):
+        return jnp.sum(x, axis=0)
+
+    def min(self, x):
+        return jnp.min(x, axis=0)
+
+    def max(self, x):
+        return jnp.max(x, axis=0)
+
+    def gather(self, per_group):
+        return per_group
+
+
 def _minmax128(x: jnp.ndarray, live: jnp.ndarray, red, fn: str) -> jnp.ndarray:
     """Grouped min/max over int128 limb tiles [n, 2]: lexicographic
     (hi, unsigned lo) in two segment reductions — reduce hi, then lo
@@ -552,6 +570,25 @@ def _minmax128(x: jnp.ndarray, live: jnp.ndarray, red, fn: str) -> jnp.ndarray:
     tie = live & (h == red.gather(mh))
     ml = op(jnp.where(tie, l, sent_h))
     return I.pack(mh, ml ^ I.SIGN64)
+
+
+def _finalize_dec128(agg: AggSpec, val: jnp.ndarray, cnt: jnp.ndarray):
+    """Shared long-decimal finalize: avg divide (poisoned past the
+    2^31-row divisor bound and through overflowed sums), short-output
+    narrowing. ``val`` is [..., 2] limbs."""
+    from . import int128 as I
+    out_t = agg.output_type
+    short_out = isinstance(out_t, T.DecimalType) and not out_t.is_long
+    if agg.fn == "avg":
+        den = jnp.clip(cnt, 1, 1 << 31)
+        q = I.div_round_half_up(val, den)
+        # poisoned sums stay poisoned; counts past the short-division
+        # bound poison too rather than divide by a clipped count
+        bad = I.is_overflow_sentinel(val) | (cnt > (1 << 31))
+        q = I.where(bad, jnp.broadcast_to(jnp.asarray(I.OVERFLOW_SENTINEL),
+                                          q.shape), q)
+        return (I.lo(q) if short_out else q)
+    return (I.lo(val) if short_out else val)
 
 
 def _rank_reduce(codes: jnp.ndarray, live: jnp.ndarray, red,
@@ -624,20 +661,7 @@ def _finalize(agg: AggSpec, parts: Tuple[jnp.ndarray, ...]) -> Tuple[jnp.ndarray
         return val > 0, valid
     if val.ndim == 2:
         # long-decimal limb state (sum/avg/min/max over decimals)
-        from . import int128 as I
-        out_t = agg.output_type
-        short_out = isinstance(out_t, T.DecimalType) and not out_t.is_long
-        if agg.fn == "avg":
-            den = jnp.clip(cnt, 1, (1 << 31) - 1)
-            q = I.div_round_half_up(val, den)
-            # a poisoned (overflowed) sum stays poisoned through the
-            # divide so the overflow still raises at decode
-            q = I.where(I.is_overflow_sentinel(val),
-                        jnp.broadcast_to(jnp.asarray(I.OVERFLOW_SENTINEL),
-                                         q.shape), q)
-            # |avg| <= max|x| < 10^p: always fits a short output
-            return (I.lo(q) if short_out else q), valid
-        return (I.lo(val) if short_out else val), valid
+        return _finalize_dec128(agg, val, cnt), valid
     if agg.fn == "avg":
         if isinstance(agg.output_type, T.DecimalType):
             den = jnp.maximum(cnt, 1)
@@ -1178,15 +1202,7 @@ def global_aggregate(
 def _minmax128_scalar(x: jnp.ndarray, live: jnp.ndarray,
                       fn: str) -> jnp.ndarray:
     """Global min/max over int128 limb tiles [n, 2] -> [2]."""
-    from . import int128 as I
-    h = I.hi(x)
-    l = I.sortable_lo(x)
-    op = jnp.min if fn == "min" else jnp.max
-    sent = _max_sentinel(h.dtype) if fn == "min" else _min_sentinel(h.dtype)
-    mh = op(jnp.where(live, h, sent))
-    tie = live & (h == mh)
-    ml = op(jnp.where(tie, l, sent))
-    return I.pack(mh, ml ^ I.SIGN64)
+    return _minmax128(x, live, _GlobalReducer(), fn)
 
 
 def _finalize_scalar(agg: AggSpec, parts):
@@ -1200,17 +1216,7 @@ def _finalize_scalar(agg: AggSpec, parts):
             and agg.fn in ("sum", "avg", "min", "max") \
             and isinstance(agg.state_types()[0][1], T.DecimalType) \
             and agg.state_types()[0][1].is_long:
-        from . import int128 as I
-        out_t = agg.output_type
-        short_out = isinstance(out_t, T.DecimalType) and not out_t.is_long
-        if agg.fn == "avg":
-            den = jnp.clip(cnt, 1, (1 << 31) - 1)
-            q = I.div_round_half_up(val, den)
-            q = I.where(I.is_overflow_sentinel(val),
-                        jnp.broadcast_to(jnp.asarray(I.OVERFLOW_SENTINEL),
-                                         q.shape), q)
-            return (I.lo(q) if short_out else q), valid
-        return (I.lo(val) if short_out else val), valid
+        return _finalize_dec128(agg, val, cnt), valid
     if agg.fn == "avg":
         if isinstance(agg.output_type, T.DecimalType):
             den = jnp.maximum(cnt, 1)

@@ -221,11 +221,12 @@ def mul_small(a: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 def divmod_small_abs(x: jnp.ndarray, d) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Nonnegative x divided by divisor d (static int or i64 array,
-    1 <= d < 2**31): (quotient limbs, remainder i64). Classic base-2**32
-    short division — remainder < 2**31 keeps every step in i64."""
+    1 <= d <= 2**31): (quotient limbs, remainder i64). Classic base-2**32
+    short division — remainder < 2**31 keeps every step in i64 (d = 2**31
+    exactly still fits: r <= 2**31 - 1, so r*2**32 + digit < 2**63)."""
     if isinstance(d, int):
         d = jnp.int64(d)
-    d = jnp.clip(d.astype(jnp.int64), 1, (1 << 31) - 1)
+    d = jnp.clip(d.astype(jnp.int64), 1, 1 << 31)
     d0, d1, d2, d3 = digits32(x)
     r = jnp.zeros_like(d0)
     qs = []

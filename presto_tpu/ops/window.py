@@ -205,10 +205,15 @@ def evaluate_window(
         if getattr(d, "ndim", 1) == 2:
             # long-decimal limb pairs: two operands (hi, unsigned lo)
             from .int128 import SIGN64
-            operands.append(d[..., 0])
-            operands.append(d[..., 1] ^ SIGN64)
+            operands.append(jnp.where(c.validity, d[..., 0],
+                                      jnp.zeros_like(d[..., 0])))
+            operands.append(jnp.where(c.validity, d[..., 1] ^ SIGN64,
+                                      jnp.zeros_like(d[..., 1])))
             continue
-        operands.append(d.astype(jnp.int32) if d.dtype == jnp.bool_ else d)
+        d = d.astype(jnp.int32) if d.dtype == jnp.bool_ else d
+        # neutralize NULL rows' storage so stale values can't split one
+        # NULL partition into many (same rule as _group_key_ops)
+        operands.append(jnp.where(c.validity, d, jnp.zeros_like(d)))
     n_part_ops = len(operands)
     for k in order_by:
         operands.extend(_sortable(batch.columns[k.column], k))

@@ -80,6 +80,10 @@ def hash_partition_ids(batch: Batch, key_cols: Sequence[int],
             # long-decimal limb pairs fold into one word first
             data = data[..., 0] ^ _splitmix64(
                 data[..., 1].astype(jnp.uint64)).astype(jnp.int64)
+        # neutralize NULL rows' storage: stale per-row garbage (e.g.
+        # from nullif-produced NULLs) must not scatter one NULL key
+        # group across shards — validity is mixed separately below
+        data = jnp.where(c.validity, data, jnp.zeros_like(data))
         h = _splitmix64(h ^ data.astype(jnp.uint64)
                         ^ (c.validity.astype(jnp.uint64) << jnp.uint64(63)))
     return (h % jnp.uint64(n_partitions)).astype(jnp.int32)

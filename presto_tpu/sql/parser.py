@@ -944,7 +944,16 @@ class _Parser:
             self.next()
             s = self.next()
             try:
-                return A.DecimalLiteral(Decimal(s.text.strip()))
+                d = Decimal(s.text.strip())
+                if not d.is_finite():
+                    raise ValueError("non-finite")
+                # normalize exponent forms (1E5) to plain digits so the
+                # (precision, scale) derivation sees the true magnitude
+                if int(d.as_tuple().exponent) > 0:
+                    d = d.quantize(Decimal(1))
+                return A.DecimalLiteral(d)
+            except SqlSyntaxError:
+                raise
             except Exception as e:
                 raise SqlSyntaxError(f"bad DECIMAL literal {s.text!r}",
                                      t.line, t.col) from e

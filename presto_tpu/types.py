@@ -203,9 +203,8 @@ class DecimalType(Type):
                 f"value {value!r} out of range for {self.display()}"
             )
         if self.is_long:
-            lo_u = unscaled & ((1 << 64) - 1)
-            return (unscaled >> 64,
-                    lo_u - (1 << 64) if lo_u >= (1 << 63) else lo_u)
+            from .ops.int128 import limbs_of
+            return limbs_of(unscaled)
         return unscaled
 
     def from_storage(self, value: Any):
@@ -215,6 +214,7 @@ class DecimalType(Type):
         with decimal.localcontext() as ctx:
             ctx.prec = 60
             if self.is_long:
+                from .ops.int128 import int_of
                 h, l = (int(value[0]), int(value[1]))
                 if h == -(1 << 63) and l == 1:
                     # ops/int128.py OVERFLOW_SENTINEL: a decimal
@@ -224,7 +224,7 @@ class DecimalType(Type):
                     raise QueryError(
                         NUMERIC_VALUE_OUT_OF_RANGE,
                         "decimal aggregate overflowed 38 digits")
-                unscaled = (h << 64) + (l & ((1 << 64) - 1))
+                unscaled = int_of(h, l)
                 if unscaled >= 1 << 127:
                     unscaled -= 1 << 128
                 return Decimal(unscaled).scaleb(-self.scale)
