@@ -301,6 +301,11 @@ class DistributedExecutor(_Executor):
                     merged = compact_fn(merged)
                 state = merged
         if state is None:
+            if node.default_gids and step in ("single", "final"):
+                # grouping sets over empty input: synthesize the empty
+                # sets' grand-total rows (see local._default_grouping_batch)
+                from .local import _default_grouping_batch
+                yield self._pad_shardable(_default_grouping_batch(node))
             return
         if step == "partial":
             # states stay shard-local: the downstream FINAL node owns
@@ -310,7 +315,13 @@ class DistributedExecutor(_Executor):
         state = self._repartitioner(key_idx)(state)
         final_fn = self._smap(
             lambda b: grouped_aggregate(b, key_idx, aggs, mode="final"), 1)
-        yield final_fn(state)
+        out = final_fn(state)
+        if node.default_gids and step in ("single", "final") \
+                and out.host_count() == 0:
+            from .local import _default_grouping_batch
+            yield self._pad_shardable(_default_grouping_batch(node))
+            return
+        yield out
 
     def _global_agg(self, node: AggregationNode,
                     aggs: List[AggSpec]) -> Batch:

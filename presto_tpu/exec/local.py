@@ -52,6 +52,27 @@ class QueryResult:
     rows: List[tuple]
 
 
+def _default_grouping_batch(node: AggregationNode) -> Batch:
+    """One default row per empty grouping set for empty-input
+    aggregations (reference AggregationNode.hasDefaultOutput +
+    AggregationOperator's default output page): keys NULL, $group_id set,
+    count-family aggregates 0, everything else NULL."""
+    nk = len(node.group_indices)
+    data: Dict[str, tuple] = {}
+    n = len(node.default_gids)
+    for pos, f in enumerate(node.fields):
+        if pos < nk - 1:
+            vals = [None] * n
+        elif pos == nk - 1:                 # the $group_id column
+            vals = [int(g) for g in node.default_gids]
+        else:
+            agg = node.aggs[pos - nk]
+            zero = agg.fn in ("count", "count_star", "approx_distinct")
+            vals = [0 if zero else None] * n
+        data[f.name] = (f.type, vals)
+    return Batch.from_pydict(data)
+
+
 def run_init_plans(ex, plan: LogicalPlan) -> None:
     """Run uncorrelated scalar subqueries (init plans), exposing results to
     the main plan AND to later init plans: inner subqueries are appended
@@ -755,7 +776,19 @@ class _Executor:
                     concurrency)
             for p in partials:
                 buf.add_partial(p)
-            yield from buf.results(final=step != "partial")
+            if node.default_gids and step in ("single", "final"):
+                # grouping sets over EMPTY input: the empty sets still
+                # owe their grand-total rows (reference
+                # AggregationNode.hasDefaultOutput); detect zero output
+                # groups (aggregated outputs are small, so the host
+                # count is cheap) and synthesize them
+                outs = list(buf.results(final=True))
+                live = sum(b.host_count() for b in outs)
+                yield from outs
+                if live == 0:
+                    yield _default_grouping_batch(node)
+            else:
+                yield from buf.results(final=step != "partial")
         finally:
             buf.close()
 
@@ -821,7 +854,38 @@ class _Executor:
         common = sorted(li[1].keys() & ri[1].keys())
         return ls, rs, [(li[1][v], ri[1][v]) for v in common]
 
+    def _coalesce(self, it: Iterator[Batch],
+                  min_cap: int = 1 << 15) -> Iterator[Batch]:
+        """Merge runs of small batches into fewer larger ones. Selective
+        joins compact their outputs to tiny buckets; on a ~100ms-RTT
+        tunneled device every downstream operator then pays dispatch
+        latency PER BATCH, dwarfing its kernel time. Capacity (not a
+        live-count sync) decides: batches at or above min_cap pass
+        through, smaller ones buffer until their capacities sum past it
+        (the role of the reference's PageBuffer/page coalescing in
+        exchange clients)."""
+        pend: List[Batch] = []
+        acc = 0
+        for b in it:
+            if b.capacity >= min_cap:
+                if pend:
+                    yield (pend[0] if len(pend) == 1
+                           else concat_batches(pend))
+                    pend, acc = [], 0
+                yield b
+                continue
+            pend.append(b)
+            acc += b.capacity
+            if acc >= min_cap:
+                yield concat_batches(pend)
+                pend, acc = [], 0
+        if pend:
+            yield pend[0] if len(pend) == 1 else concat_batches(pend)
+
     def _JoinNode(self, node: JoinNode) -> Iterator[Batch]:
+        yield from self._coalesce(self._join_dispatch(node))
+
+    def _join_dispatch(self, node: JoinNode) -> Iterator[Batch]:
         lifespan = self._lifespan_partitions(node)
         if lifespan is not None:
             ls, rs, buckets = lifespan

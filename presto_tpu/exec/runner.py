@@ -47,6 +47,8 @@ class LocalRunner:
         self.transactions = TransactionManager()
         self.events = EventListenerManager()
         self.access_control = AccessControl()    # allow-all until rules set
+        from ..server.security import RoleManager
+        self.roles = RoleManager()               # enforce=False by default
         self.rows_per_batch = rows_per_batch
         self.query_log = catalogs.get("system").query_log
         self._query_seq = 0
@@ -116,6 +118,8 @@ class LocalRunner:
                 properties={**session.properties, **(properties or {})})
         if isinstance(stmt, A.Query):
             plan = optimize(plan_query(stmt, session), session)
+            if self.roles.enforce:
+                self._check_select_privileges(plan, user)
             try:
                 return execute_plan(plan, session, self.rows_per_batch,
                                     cancel_event=cancel_event)
@@ -217,6 +221,45 @@ class LocalRunner:
         if isinstance(stmt, A.ResetSession):
             self.session.properties.pop(stmt.name, None)
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.CreateRole):
+            self.roles.create_role(stmt.name, user)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.DropRole):
+            self.roles.drop_role(stmt.name, user)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.GrantRoles):
+            self.roles.grant_roles(stmt.roles, stmt.grantees, user)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.RevokeRoles):
+            self.roles.revoke_roles(stmt.roles, stmt.grantees, user)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.GrantPrivileges):
+            cat, _, tab = self._object_key(stmt.table)
+            self.roles.grant_table(stmt.privileges, cat, tab,
+                                   stmt.grantee, user)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.RevokePrivileges):
+            cat, _, tab = self._object_key(stmt.table)
+            self.roles.revoke_table(stmt.privileges, cat, tab,
+                                    stmt.grantee, user)
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.SetRole):
+            # session-scoped role selection; ALL/NONE accepted for
+            # compatibility (enforcement consults all granted roles)
+            self.session.properties["role"] = stmt.role
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, A.ShowRoles):
+            return QueryResult(["Role"], [T.VARCHAR],
+                               [(r,) for r in self.roles.list_roles()])
+        if isinstance(stmt, A.ShowGrants):
+            tbl = None
+            if stmt.table:
+                cat, _, tab = self._object_key(stmt.table)
+                tbl = (cat, tab)
+            return QueryResult(
+                ["Grantee", "Catalog", "Table", "Privilege"],
+                [T.VARCHAR] * 4,
+                self.roles.list_grants(tbl))
         if isinstance(stmt, A.StartTransaction):
             tx_id = self.transactions.begin(stmt.isolation,
                                             stmt.read_only, user=user)
@@ -320,10 +363,29 @@ class LocalRunner:
         schema = self.session.schema if len(name) < 2 else name[-2]
         return (catalog, schema, name[-1])
 
+    def _check_select_privileges(self, plan: LogicalPlan,
+                                 user: str) -> None:
+        """SQL-standard enforcement: every scanned table needs SELECT
+        for the user (directly or via a role) when the role manager is
+        enforcing (reference security/AccessControlManager.checkCanSelectFromColumns)."""
+        from ..planner.plan import TableScanNode
+
+        def walk(n):
+            if isinstance(n, TableScanNode):
+                self.roles.check_table_privilege(
+                    user, n.catalog, n.table.table, "SELECT")
+            for c in n.children:
+                walk(c)
+        for p in [plan.root] + list(plan.init_plans):
+            walk(p)
+
     # -- write path (reference TableWriterOperator + finishInsert) ----------
     def _writable(self, name, user: str = ""):
         catalog = self.session.catalog if len(name) < 3 else name[-3]
         self.access_control.check_can_access_catalog(user, catalog)
+        if self.roles.enforce:
+            self.roles.check_table_privilege(user, catalog, name[-1],
+                                             "INSERT")
         conn = self.session.catalogs.get(catalog)
         if not hasattr(conn, "create_table"):
             raise ValueError(f"catalog {catalog!r} is not writable")

@@ -293,12 +293,41 @@ def _dense_key_columns(batch: Batch, group_indices: Sequence[int],
 class _SegReducers:
     """Group reductions over a precomputed group id via ``segment_*``
     scatter ops — the right shape when group ids are dense from a sort
-    (num_segments is large, ids are sorted runs)."""
+    (num_segments is large, ids are sorted runs).
 
-    def __init__(self, group_id: jnp.ndarray, cap: int):
+    When ``starts`` is provided (sorted-run group ids with per-group
+    start indices, absent groups pointing one past the end), 64-bit
+    sums take the scan path instead of the scatter: i64 goes through
+    the Pallas digit-plane cumsum (ops/pallas_scan.py, exact), f64
+    through an XLA cumsum + boundary differences — the 64-bit scatter
+    runs ~8M rows/s on this chip while linear scans stream 50-80x
+    faster. f64 prefix differences round differently than per-group
+    scatter order, which SQL sum(double) permits."""
+
+    def __init__(self, group_id: jnp.ndarray, cap: int,
+                 starts: Optional[jnp.ndarray] = None,
+                 n_rows: Optional[int] = None):
         self.gid, self.cap = group_id, cap
+        self.starts, self.n_rows = starts, n_rows
 
     def sum(self, x):
+        if self.starts is not None and getattr(x, "ndim", 0) == 1:
+            from .pallas_scan import pallas_supported, segment_sum_sorted_i64
+            if x.dtype == jnp.int64 and pallas_supported():
+                return segment_sum_sorted_i64(
+                    x, self.starts, self.cap,
+                    max_rows_per_group=self.n_rows)
+            if x.dtype == jnp.float64 and pallas_supported():
+                n = x.shape[0]
+                csum = jnp.cumsum(x)
+                prev = jnp.clip(self.starts - 1, 0, n - 1)
+                ends = jnp.concatenate(
+                    [jnp.clip(self.starts[1:] - 1, 0, n - 1),
+                     jnp.full((1,), n - 1, self.starts.dtype)])
+                hi = jnp.take(csum, ends, axis=0)
+                lo = jnp.where(self.starts <= 0, 0.0,
+                               jnp.take(csum, prev, axis=0))
+                return hi - lo
         return jax.ops.segment_sum(x, self.gid, num_segments=self.cap)
 
     def min(self, x):
@@ -877,16 +906,21 @@ def grouped_aggregate(
                 c.dictionary,
             ))
 
+        # sorted-run starts for the scan-path 64-bit sums (absent groups
+        # point one past the end — see pallas_scan.segment_sum_sorted_i64)
+        starts = jnp.where(out_mask, bidx,
+                           batch.capacity).astype(jnp.int32)
+        red = _SegReducers(group_id, cap, starts=starts,
+                           n_rows=batch.capacity)
         if from_states:
             state_data = s_data[n_keys:]
             state_dicts = [c.dictionary for c in batch.columns[n_keys:]]
             seg = _segment_aggs(aggs, state_data, s_valid[n_keys:], s_mask,
-                                _SegReducers(group_id, cap),
-                                from_states=True, col_dicts=state_dicts)
+                                red, from_states=True,
+                                col_dicts=state_dicts)
         else:
             seg = _segment_aggs(aggs, s_data, s_valid, s_mask,
-                                _SegReducers(group_id, cap),
-                                from_states=False,
+                                red, from_states=False,
                                 col_dicts=[c.dictionary
                                            for c in batch.columns])
 

@@ -559,7 +559,8 @@ def _prune(node: PlanNode, required: List[int]) -> Tuple[PlanNode, Dict[int, int
         inner = AggregationNode(
             child=child,
             group_indices=tuple(cmap[g] for g in node.group_indices),
-            aggs=aggs, fields=fields, step=node.step)
+            aggs=aggs, fields=fields, step=node.step,
+            default_gids=node.default_gids)
         # remap required through (keys keep positions, aggs shift)
         agg_pos = {n_keys + j: n_keys + k for k, j in enumerate(kept_aggs)}
         inner_map = {**{i: i for i in range(n_keys)}, **agg_pos}
@@ -966,4 +967,5 @@ def _try_eager_agg(agg: AggregationNode,
         for i, a in enumerate(agg.aggs))
     return AggregationNode(
         child=above, group_indices=tuple(range(n_keys)),
-        aggs=final_aggs, fields=agg.fields, step="final")
+        aggs=final_aggs, fields=agg.fields, step="final",
+        default_gids=agg.default_gids)

@@ -132,6 +132,13 @@ class AggregationNode(PlanNode):
     aggs: Tuple[PlanAgg, ...]
     fields: Tuple[Field, ...]
     step: str = "single"
+    # grouping-sets support (reference AggregationNode.groupIdSymbol +
+    # hasDefaultOutput): $group_id values — indexes into the feeding
+    # GroupIdNode's sets — that must still emit a default row (count=0,
+    # other aggs NULL, keys NULL) when the input is empty; these are the
+    # ROLLUP/CUBE empty sets, whose grand-total row exists even over
+    # empty input
+    default_gids: Tuple[int, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -963,11 +963,13 @@ class _Planner:
         """GROUP BY ROLLUP/CUBE/GROUPING SETS, lowered single-pass via
         GroupIdNode (reference plan/GroupIdNode.java +
         operator/GroupIdOperator.java): replicate rows per grouping set
-        with absent keys nulled, aggregate once over (keys..., $group_id),
-        and compute GROUPING() values by SWITCH on $group_id. Empty
-        grouping sets (the ROLLUP grand-total row) go through a separate
-        global-aggregation branch so they still emit their row over empty
-        input, then UNION ALL."""
+        with absent keys nulled, aggregate ONCE over (keys..., $group_id)
+        — empty sets (the ROLLUP grand-total row) included, so the whole
+        input pipeline runs exactly once — and compute GROUPING() values
+        by SWITCH on $group_id. Empty sets' grand-total rows over EMPTY
+        input come from AggregationNode.default_gids (reference
+        AggregationNode.hasDefaultOutput): the executor synthesizes the
+        default rows when the aggregation produced no groups."""
         from .plan import GroupIdNode, UnionNode
 
         if any(a.distinct for a in aggs):
@@ -995,8 +997,8 @@ class _Planner:
             return sum((0 if idxs[a] in s else 1) << (m - 1 - a)
                        for a in range(m))
 
-        nonempty = [s for s in spec.grouping_sets if s]
-        n_empty = sum(1 for s in spec.grouping_sets if not s)
+        all_sets = list(spec.grouping_sets)
+        nonempty = [s for s in all_sets if s]
         out_fields = (tuple(pre_fields[:nk]) + tuple(agg_fields)
                       + tuple(Field(f"_grouping{k}", T.BIGINT)
                               for k in range(len(grouping_calls))))
@@ -1005,7 +1007,7 @@ class _Planner:
         if nonempty:
             gid_field = Field("$group_id", T.BIGINT)
             gid_node = GroupIdNode(
-                child=pre, grouping_sets=tuple(nonempty), n_keys=nk,
+                child=pre, grouping_sets=tuple(all_sets), n_keys=nk,
                 fields=tuple(pre_fields) + (gid_field,))
             gid_idx = len(pre_fields)
             agg_node = AggregationNode(
@@ -1013,7 +1015,9 @@ class _Planner:
                 group_indices=tuple(range(nk)) + (gid_idx,),
                 aggs=tuple(aggs),
                 fields=(tuple(pre_fields[:nk]) + (gid_field,)
-                        + tuple(agg_fields)))
+                        + tuple(agg_fields)),
+                default_gids=tuple(g for g, s in enumerate(all_sets)
+                                   if not s))
             # agg layout: [keys..., $group_id, aggs...]
             exprs: List[ir.Expr] = [
                 ir.input_ref(i, pre_fields[i].type) for i in range(nk)]
@@ -1021,7 +1025,7 @@ class _Planner:
                       for j, af in enumerate(agg_fields)]
             gid_ref = ir.input_ref(nk, T.BIGINT)
             for idxs in call_arg_idx:
-                vals = [grouping_val(s, idxs) for s in nonempty]
+                vals = [grouping_val(s, idxs) for s in all_sets]
                 if len(set(vals)) == 1:
                     exprs.append(ir.lit(vals[0], T.BIGINT))
                     continue
@@ -1034,18 +1038,22 @@ class _Planner:
                 exprs.append(ir.special(ir.Form.SWITCH, T.BIGINT, *ops))
             branches.append(ProjectNode(child=agg_node, exprs=tuple(exprs),
                                         fields=out_fields))
-
-        for _ in range(n_empty):
-            g_agg = AggregationNode(
-                child=pre, group_indices=(), aggs=tuple(aggs),
-                fields=tuple(agg_fields))
-            exprs = [ir.lit(None, pre_fields[i].type) for i in range(nk)]
-            exprs += [ir.input_ref(j, af.type)
-                      for j, af in enumerate(agg_fields)]
-            for idxs in call_arg_idx:
-                exprs.append(ir.lit(grouping_val((), idxs), T.BIGINT))
-            branches.append(ProjectNode(child=g_agg, exprs=tuple(exprs),
-                                        fields=out_fields))
+        else:
+            # only empty sets (GROUPING SETS ((), ...)): plain global
+            # aggregation branches, one row each
+            for _ in all_sets:
+                g_agg = AggregationNode(
+                    child=pre, group_indices=(), aggs=tuple(aggs),
+                    fields=tuple(agg_fields))
+                exprs = [ir.lit(None, pre_fields[i].type)
+                         for i in range(nk)]
+                exprs += [ir.input_ref(j, af.type)
+                          for j, af in enumerate(agg_fields)]
+                for idxs in call_arg_idx:
+                    exprs.append(ir.lit(grouping_val((), idxs), T.BIGINT))
+                branches.append(ProjectNode(child=g_agg,
+                                            exprs=tuple(exprs),
+                                            fields=out_fields))
 
         node: PlanNode = (branches[0] if len(branches) == 1 else
                           UnionNode(children_=tuple(branches),

@@ -344,15 +344,23 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, {})
 
     def _authenticate(self) -> bool:
-        """HTTP Basic against the installed password authenticator
-        (reference server/security/AuthenticationFilter.java); no
-        authenticator = open server, header-asserted identity."""
+        """HTTP Basic against the installed password authenticator and
+        Bearer (JWT) against the installed token authenticator
+        (reference server/security/AuthenticationFilter.java chaining
+        multiple authenticators); none installed = open server,
+        header-asserted identity."""
         auth = self._srv.authenticator
-        if auth is None:
+        jwt = getattr(self._srv, "jwt_authenticator", None)
+        if auth is None and jwt is None:
             return True
         import base64
         header = self.headers.get("Authorization", "")
-        if header.startswith("Basic "):
+        if jwt is not None and header.startswith("Bearer "):
+            principal = jwt.authenticate(header[7:].strip())
+            if principal:
+                self._auth_user = principal
+                return True
+        if auth is not None and header.startswith("Basic "):
             try:
                 raw = base64.b64decode(header[6:]).decode()
                 user, _, password = raw.partition(":")
@@ -408,9 +416,10 @@ class PrestoTpuServer:
 
     def __init__(self, runner=None, host: str = "127.0.0.1", port: int = 0,
                  resource_groups: Optional[Dict] = None,
-                 authenticator=None):
+                 authenticator=None, jwt_authenticator=None):
         from .resource_groups import ResourceGroupManager
         self.authenticator = authenticator
+        self.jwt_authenticator = jwt_authenticator
         if runner is None:
             from ..exec.runner import LocalRunner
             runner = LocalRunner()

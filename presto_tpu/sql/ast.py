@@ -538,3 +538,60 @@ class InsertInto(Node):
     name: Tuple[str, ...]
     query: Query
     columns: Tuple[str, ...] = ()
+
+
+# -- roles & privileges (reference sql/tree/CreateRole.java, Grant.java,
+# -- Revoke.java, SetRole.java, ShowGrants.java; spi/security/RoleGrant)
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateRole(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DropRole(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class GrantRoles(Node):
+    roles: Tuple[str, ...]
+    grantees: Tuple[str, ...]
+    admin_option: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RevokeRoles(Node):
+    roles: Tuple[str, ...]
+    grantees: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class GrantPrivileges(Node):
+    privileges: Tuple[str, ...]          # SELECT/INSERT/DELETE or ALL
+    table: Tuple[str, ...]
+    grantee: str
+    grant_option: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RevokePrivileges(Node):
+    privileges: Tuple[str, ...]
+    table: Tuple[str, ...]
+    grantee: str
+
+
+@dataclasses.dataclass(frozen=True)
+class SetRole(Node):
+    role: str                            # a role name, or ALL / NONE
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowRoles(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowGrants(Node):
+    table: Tuple[str, ...] = ()

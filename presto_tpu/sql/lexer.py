@@ -44,6 +44,7 @@ KEYWORDS = frozenset("""
     analyze describe catalogs schemas tables columns functions
     over partition rows range preceding following unbounded current row
     start transaction commit rollback work isolation level only
+    grant revoke role roles grants to option
 """.split())
 
 # Keywords that can still be used as identifiers in non-ambiguous positions
@@ -54,6 +55,7 @@ NON_RESERVED = frozenset("""
     count sum avg min max coalesce nullif interval
     over partition rows range preceding following unbounded current row
     start transaction commit rollback work isolation level only
+    role roles grants option
 """.split())
 
 

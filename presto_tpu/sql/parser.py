@@ -151,10 +151,18 @@ class _Parser:
             return A.ShowColumns(self.qualified_name())
         if self.at_kw("set"):
             self.next()
+            if self.accept_kw("role"):
+                t = self.next()
+                return A.SetRole(t.text.lower() if t.kind == "KEYWORD"
+                                 else t.text)
             self.expect_kw("session")
             name = ".".join(self.qualified_name())
             self.expect_op("=")
             return A.SetSession(name, self.expression())
+        if self.at_kw("grant"):
+            return self._grant_revoke(grant=True)
+        if self.at_kw("revoke"):
+            return self._grant_revoke(grant=False)
         if self.at_kw("reset"):
             self.next()
             self.expect_kw("session")
@@ -194,6 +202,8 @@ class _Parser:
             return self._create()
         if self.at_kw("drop"):
             self.next()
+            if self.accept_kw("role"):
+                return A.DropRole(self.identifier())
             is_view = False
             if self.peek().kind == "IDENT" \
                     and self.peek().text.lower() == "view":
@@ -265,11 +275,91 @@ class _Parser:
             return A.ShowCatalogs()
         if self.accept_kw("session"):
             return A.ShowSession()
+        if self.accept_kw("roles"):
+            return A.ShowRoles()
+        if self.accept_kw("grants"):
+            table: tuple = ()
+            if self.accept_kw("on"):
+                self.accept_kw("table")
+                table = self.qualified_name()
+            return A.ShowGrants(table)
         t = self.peek()
         raise SqlSyntaxError(f"unsupported SHOW {t.text!r}", t.line, t.col)
 
+    def _grant_revoke(self, grant: bool) -> A.Node:
+        """GRANT/REVOKE of roles and of table privileges (reference
+        sql/tree/Grant.java + GrantRoles.java; SqlBase.g4 grant rules)."""
+        self.next()                       # grant | revoke
+        # role form: GRANT r1, r2 TO u1, u2 — detected by the absence of
+        # a privilege keyword / ALL / ON
+        privs: List[str] = []
+        is_priv = False
+        t = self.peek()
+        if t.kind == "KEYWORD" and t.text in ("select", "insert", "all"):
+            is_priv = True
+        elif t.kind == "IDENT" and t.text.lower() in ("delete", "update"):
+            is_priv = True
+        if is_priv:
+            if self.accept_kw("all"):
+                if self.peek().kind == "IDENT" \
+                        and self.peek().text.lower() == "privileges":
+                    self.next()
+                privs = ["SELECT", "INSERT", "DELETE"]
+            else:
+                while True:
+                    privs.append(self.next().text.upper())
+                    if not self.accept_op(","):
+                        break
+            self.expect_kw("on")
+            self.accept_kw("table")
+            table = self.qualified_name()
+            if grant:
+                self.expect_kw("to")
+            else:
+                self.expect_kw("from")
+            grantee = self._grantee()
+            opt = False
+            if grant and self.accept_kw("with"):
+                self.expect_kw("grant")
+                self.expect_kw("option")
+                opt = True
+            return (A.GrantPrivileges(tuple(privs), table, grantee, opt)
+                    if grant else
+                    A.RevokePrivileges(tuple(privs), table, grantee))
+        roles = [self.identifier()]
+        while self.accept_op(","):
+            roles.append(self.identifier())
+        if grant:
+            self.expect_kw("to")
+        else:
+            self.expect_kw("from")
+        grantees = [self._grantee()]
+        while self.accept_op(","):
+            grantees.append(self._grantee())
+        admin = False
+        if grant and self.accept_kw("with"):
+            t = self.next()
+            if t.text.lower() != "admin":
+                raise SqlSyntaxError("expected ADMIN OPTION", t.line, t.col)
+            self.expect_kw("option")
+            admin = True
+        return (A.GrantRoles(tuple(roles), tuple(grantees), admin)
+                if grant else A.RevokeRoles(tuple(roles), tuple(grantees)))
+
+    def _grantee(self) -> str:
+        # optional USER/ROLE prefix like the reference's principal rule
+        t = self.peek()
+        if t.kind == "IDENT" and t.text.lower() in ("user",) \
+                and self.peek(1).kind in ("IDENT", "QIDENT"):
+            self.next()
+        elif self.at_kw("role") and self.peek(1).kind in ("IDENT", "QIDENT"):
+            self.next()
+        return self.identifier()
+
     def _create(self) -> A.Node:
         self.expect_kw("create")
+        if self.accept_kw("role"):
+            return A.CreateRole(self.identifier())
         or_replace = False
         if self.accept_kw("or"):
             t = self.next()

@@ -149,6 +149,29 @@ def test_q27(runner, oracle):
     assert g_states == {0, 1}
 
 
+def test_rollup_over_empty_input_emits_grand_total(runner):
+    """The ROLLUP empty set owes its grand-total row even over empty
+    input (reference AggregationNode.hasDefaultOutput): one row with
+    NULL keys and count 0 — synthesized by the executor now that the
+    empty set rides the single GroupId pipeline instead of a separate
+    global-aggregation branch."""
+    rows = runner.execute(
+        "select d_year, count(*), sum(d_date_sk) from date_dim "
+        "where d_date_sk < 0 group by rollup(d_year)").rows
+    assert rows == [(None, 0, None)]
+
+
+def test_rollup_single_pipeline(runner):
+    """The plan for ROLLUP contains exactly ONE aggregation pipeline —
+    no Union re-executing the input for the grand-total set."""
+    out = runner.execute(
+        "explain select d_year, count(*) from date_dim "
+        "group by rollup(d_year)")
+    text = "\n".join(r[0] for r in out.rows)
+    assert "Union" not in text
+    assert text.count("TableScan") == 1
+
+
 def test_scan_counts(runner, oracle):
     for t in TABLES:
         compare(runner, oracle, f"select count(*) from {t}")
