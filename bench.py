@@ -699,9 +699,10 @@ def main() -> None:
     # dominate the device's milliseconds of compute and the ratio
     # measures latency, not throughput
     sf_ds = float(os.environ.get("BENCH_SF_DS", "10"))
-    # hard wall-clock budget: skip remaining configs rather than risk the
-    # whole run (and every completed number) being killed by a timeout
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "3000"))
+    # hard wall-clock budget: the driver kills the bench process at
+    # ~1800s, so leave headroom — skip remaining configs rather than risk
+    # the whole run (and every completed number) being killed
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1380"))
     t_start = time.perf_counter()
 
     import signal
@@ -715,6 +716,18 @@ def main() -> None:
     alarm_ok = hasattr(signal, "SIGALRM")
     if alarm_ok:
         signal.signal(signal.SIGALRM, _on_alarm)
+
+    def emit(results):
+        """Print the CURRENT summary as one JSON line. Called after every
+        config (not just at the end) so that if the driver kills this
+        process mid-run, the last stdout line is still a complete summary
+        of every config that finished — round 4 lost ALL its numbers by
+        printing only at exit (BENCH_r04: rc=124, parsed=null)."""
+        headline = dict(next((r for r in results if "_q1_" in r["metric"]),
+                             results[0]))
+        headline["sub_metrics"] = [r for r in results
+                                   if r["metric"] != headline["metric"]]
+        print(json.dumps(headline), flush=True)
 
     results = []
     for name, sf, fn, prefix in (
@@ -731,9 +744,10 @@ def main() -> None:
         print(f"[bench] {name} sf={sf:g} starting at {elapsed:.0f}s",
               file=sys.stderr, flush=True)
         # per-config watchdog: one pathological compile/run must not eat
-        # every later config's slot (completed numbers stay reportable)
+        # every later config's slot NOR push the whole process past the
+        # driver's kill timeout (completed numbers stay reportable)
         if alarm_ok:
-            signal.alarm(int(max(budget_s * 1.2 - elapsed, 120)))
+            signal.alarm(int(max(budget_s * 1.05 - elapsed, 120)))
         try:
             total, dev_s, np_s = fn(sf)
         except _ConfigTimeout:
@@ -751,12 +765,7 @@ def main() -> None:
             "unit": "rows/s",
             "vs_baseline": round(np_s / dev_s, 3),
         })
-
-    headline = dict(next((r for r in results if "_q1_" in r["metric"]),
-                         results[0]))
-    headline["sub_metrics"] = [r for r in results
-                               if r["metric"] != headline["metric"]]
-    print(json.dumps(headline))
+        emit(results)
 
 
 if __name__ == "__main__":

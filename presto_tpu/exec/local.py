@@ -991,6 +991,13 @@ class _Executor:
             prep = (self._prepare_join_build(build, node.right_keys,
                                              summary=summary)
                     if build is not None else None)
+            # ONE build-side multiplicity readback replaces the per-probe-
+            # batch match_count_max sync (each a tunnel RTT): the max key
+            # multiplicity of the build bounds every probe batch's match
+            # count, so the static expansion factor is known up front
+            maxk_bound = (self._build_multiplicity(prep)
+                          if build is not None and not node.build_unique
+                          else None)
             for probe in probe_stream():
                 if build is None:
                     if node.join_type == "inner":
@@ -1003,12 +1010,12 @@ class _Executor:
                         for out in self._probe_outer_residual(
                                 node, probe, build, payload,
                                 payload_names, prep, residual_outer,
-                                full_acc):
+                                full_acc, maxk=maxk_bound):
                             yield compact(out)
                     else:
                         for out in self._probe_batches(
                                 node, probe, build, payload,
-                                payload_names, prep):
+                                payload_names, prep, maxk=maxk_bound):
                             if residual_fn is not None:
                                 out = residual_fn(out)
                             yield compact(out)
@@ -1112,6 +1119,7 @@ class _Executor:
                 bpart = store.partition_batch(p)
                 part_matched = None
                 part_prep = None
+                part_maxk = None
                 for probe_p in pstore.partition_batches(
                         p, self.rows_per_batch):
                     if bpart is None:
@@ -1121,6 +1129,8 @@ class _Executor:
                     if part_prep is None:
                         part_prep = self._prepare_join_build(
                             bpart, node.right_keys)
+                        if not node.build_unique:
+                            part_maxk = self._build_multiplicity(part_prep)
                     if residual_outer is not None:
                         # each probe row hashes to exactly one partition,
                         # so per-partition outer semantics compose to the
@@ -1130,7 +1140,7 @@ class _Executor:
                         for out in self._probe_outer_residual(
                                 node, probe_p, bpart, payload,
                                 payload_names, part_prep, residual_outer,
-                                part_acc):
+                                part_acc, maxk=part_maxk):
                             yield out
                         if part_acc is not None \
                                 and part_acc["m"] is not None:
@@ -1140,7 +1150,8 @@ class _Executor:
                         continue
                     for out in self._probe_batches(node, probe_p, bpart,
                                                    payload, payload_names,
-                                                   part_prep):
+                                                   part_prep,
+                                                   maxk=part_maxk):
                         yield residual_fn(out) if residual_fn is not None \
                             else out
                     if node.join_type == "full":
@@ -1211,9 +1222,21 @@ class _Executor:
                         build, keys, lo, bucket_capacity(span))
         return prepare_build_jit(build, keys)
 
+    def _build_multiplicity(self, prepared) -> Optional[int]:
+        """Host int of the build's max key multiplicity (one readback,
+        amortized over every probe batch of the join) — or None when the
+        build is skewed past SKEW_MATCH_LIMIT. The bound is only used to
+        size expand_join when it is SMALL: for a skewed build, sizing
+        every probe batch by the hottest key would push all batches into
+        the chunked skew path (most probe batches never touch the hot
+        key), so those fall back to the per-batch match_count_max sync."""
+        from ..ops.jitcache import max_multiplicity_jit
+        m = int(max_multiplicity_jit(prepared))
+        return m if m <= self.SKEW_MATCH_LIMIT else None
+
     def _probe_batches(self, node: JoinNode, probe: Batch, build: Batch,
                        payload, payload_names,
-                       prepared=None) -> Iterator[Batch]:
+                       prepared=None, maxk=None) -> Iterator[Batch]:
         schema = _plan_schema(node)
         lkeys, rkeys = list(node.left_keys), list(node.right_keys)
         if prepared is None:
@@ -1226,8 +1249,12 @@ class _Executor:
                                   payload, payload_names, jt, prepared)
             yield Batch(schema, out.columns, out.row_mask)
             return
-        maxk = int(match_count_max_jit(probe, build, lkeys, rkeys,
-                                       prepared))
+        if maxk is None:
+            # skewed build (or standalone call): per-probe-batch count —
+            # only batches that actually hit the hot key pay the chunked
+            # skew loop below
+            maxk = int(match_count_max_jit(probe, build, lkeys, rkeys,
+                                           prepared))
         limit = self.SKEW_MATCH_LIMIT
         if maxk <= limit:
             out = expand_join_jit(
@@ -1252,7 +1279,7 @@ class _Executor:
     def _probe_outer_residual(self, node: JoinNode, probe: Batch,
                               build: Batch, payload, payload_names,
                               prepared, residual_fn,
-                              full_acc) -> Iterator[Batch]:
+                              full_acc, maxk=None) -> Iterator[Batch]:
         """LEFT/FULL OUTER probe with a residual (join-filter) predicate:
         a probe row pairs with the build rows whose keys match AND whose
         residual passes; a probe row with no surviving match is
@@ -1294,8 +1321,9 @@ class _Executor:
             yield Batch(schema, cols, probe.row_mask)
             return
 
-        maxk = int(match_count_max_jit(probe, build, lkeys, rkeys,
-                                       prepared))
+        if maxk is None:
+            maxk = int(match_count_max_jit(probe, build, lkeys, rkeys,
+                                           prepared))
         limit = self.SKEW_MATCH_LIMIT
         if maxk <= limit:
             subs = [(build, bucket_capacity(max(maxk, 1), minimum=1),
@@ -1386,6 +1414,9 @@ class _Executor:
         fkeys = list(node.filtering_keys)
         prep = (self._prepare_join_build(build, fkeys)
                 if build is not None else None)
+        res_maxk = (self._build_multiplicity(prep)
+                    if build is not None and node.residual is not None
+                    else None)
         for b in self.run(node.source):
             if build is None:
                 if node.negated:
@@ -1399,8 +1430,8 @@ class _Executor:
                                           node.negated, node.null_aware,
                                           prep)
             else:
-                maxk = int(match_count_max_jit(b, build, skeys, fkeys,
-                                               prep))
+                maxk = res_maxk if res_maxk is not None else int(
+                    match_count_max_jit(b, build, skeys, fkeys, prep))
                 mask = mark_exists_mask(
                     b, build, skeys, fkeys, node.residual, node.negated,
                     bucket_capacity(max(maxk, 1), minimum=1), ex=self)

@@ -105,6 +105,15 @@ def match_count_max_jit(probe, build, probe_keys, build_keys, prepared):
                         tuple(build_keys))(probe, build, prepared)
 
 
+from .join import max_multiplicity  # noqa: E402
+
+#: max build-key multiplicity of a prepared build — ONE readback per
+#: build, replacing the per-probe-batch match_count_max syncs for
+#: non-skewed builds (jit retraces per prepared-pytree structure, so one
+#: wrapper covers both the direct and sorted layouts)
+max_multiplicity_jit = jax.jit(max_multiplicity)
+
+
 @functools.lru_cache(maxsize=None)
 def _match_mask(pkeys, bkeys):
     return jax.jit(lambda p, b, prep: build_match_mask(

@@ -261,10 +261,13 @@ def match_count_max(
 ) -> jnp.ndarray:
     """Max build matches for any live probe key (device scalar).
 
-    The host syncs this once per (probe, build) pair to pick the static
-    expansion factor for ``expand_join`` — the capacity analogue of
-    Presto's PositionLinks chain length (reference operator/
-    ArrayPositionLinks.java).
+    The skew fallback: for non-skewed builds the executor sizes
+    ``expand_join`` from the probe-independent ``max_multiplicity`` bound
+    (one readback per build); when that bound exceeds SKEW_MATCH_LIMIT it
+    syncs this per (probe, build) pair instead, so only probe batches
+    that actually hit the hot key pay the chunked skew loop — the
+    capacity analogue of Presto's PositionLinks chain length (reference
+    operator/ArrayPositionLinks.java).
     """
     prepared = prepared or build_sorted(build, build_keys)
     q_ops, pvalid = _key_arrays(probe, probe_keys)
@@ -274,6 +277,37 @@ def match_count_max(
     lo, hi = _range_lookup(q_ops, prepared)
     cnt = jnp.where(live, hi - lo, 0)
     return jnp.max(cnt) if cnt.shape[0] else jnp.asarray(0)
+
+
+def max_multiplicity(prepared) -> jnp.ndarray:
+    """Max live-key multiplicity of a PREPARED build side (device scalar).
+
+    A probe-independent upper bound on ``match_count_max`` for EVERY probe
+    batch: no probe key can match more build rows than the most frequent
+    build key has. The executor reads this back ONCE per build and reuses
+    it as the static expansion factor for all probe batches — replacing a
+    per-probe-batch ``match_count_max`` sync (each a full tunnel RTT).
+    Mirrors the reference's build-side PositionLinks, whose chain lengths
+    are likewise a property of the build alone (reference
+    operator/ArrayPositionLinks.java).
+    """
+    if _is_direct(prepared):
+        cnt_table = prepared[2]
+        if cnt_table.shape[0] == 0:
+            return jnp.asarray(0, dtype=jnp.int64)
+        return jnp.max(cnt_table).astype(jnp.int64)
+    s_ops, slive, _ = prepared
+    n = s_ops[0].shape[0]
+    if n == 0:
+        return jnp.asarray(0, dtype=jnp.int64)
+    idx = jnp.arange(n, dtype=jnp.int64)
+    diff = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for op in s_ops:
+        diff = diff | (op != jnp.roll(op, 1))
+    start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(diff, idx, -1))
+    # dead rows share one sentinel run; exclude them via slive
+    return jnp.max(jnp.where(slive, idx - start + 1, 0))
 
 
 def expand_join(
