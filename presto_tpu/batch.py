@@ -163,7 +163,10 @@ class Batch:
         return jnp.sum(self.row_mask.astype(jnp.int32))
 
     def host_count(self) -> int:
-        return int(self.count())
+        # explicit device_get: an int() on a device scalar is an IMPLICIT
+        # transfer, which jax.transfer_guard("disallow") rejects — sizing
+        # syncs are deliberate and should read as such
+        return int(jax.device_get(self.count()))
 
     def column(self, name: str) -> Column:
         return self.columns[self.schema.index_of(name)]
@@ -271,14 +274,14 @@ class Batch:
     # -- export -------------------------------------------------------------
     def to_pylist(self) -> List[Tuple]:
         """Decode live rows to python tuples (for tests / client results)."""
-        mask = np.asarray(self.row_mask)
+        mask = np.asarray(jax.device_get(self.row_mask))
         out_cols = []
         for col in self.columns:
             if isinstance(col.type, (ArrayType, MapType)):
                 out_cols.append(_composite_to_pylist(col, mask))
                 continue
-            data = np.asarray(col.data)[mask]
-            valid = np.asarray(col.validity)[mask]
+            data = np.asarray(jax.device_get(col.data))[mask]
+            valid = np.asarray(jax.device_get(col.validity))[mask]
             vals: List[Any] = []
             for d, v in zip(data, valid):
                 if not v:
@@ -342,7 +345,7 @@ def _composite_to_pylist(col: Column, mask: np.ndarray) -> List[Any]:
                     else None)
         return typ.from_storage(d)
 
-    valid = np.asarray(col.validity)[mask]
+    valid = np.asarray(jax.device_get(col.validity))[mask]
     if isinstance(col.type, ArrayType):
         values, lengths, elem_valid = (np.asarray(a) for a in col.data)
         values, lengths, elem_valid = values[mask], lengths[mask], elem_valid[mask]

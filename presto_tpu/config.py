@@ -73,12 +73,18 @@ def _parquet_factory(props):
     return ParquetConnector(props["parquet.root"])
 
 
+def _sqlite_factory(props):
+    from .connectors.sqlite import connector_factory
+    return connector_factory(props)
+
+
 CONNECTOR_FACTORIES: Dict[str, Callable] = {
     "tpch": _tpch_factory,
     "tpcds": _tpcds_factory,
     "memory": _memory_factory,
     "orc": _orc_factory,
     "parquet": _parquet_factory,
+    "sqlite": _sqlite_factory,
 }
 
 

@@ -126,5 +126,8 @@ class CatalogManager:
             raise KeyError(f"unknown catalog {name!r}")
         return self._catalogs[name]
 
+    def exists(self, name: str) -> bool:
+        return name in self._catalogs
+
     def names(self) -> List[str]:
         return sorted(self._catalogs)

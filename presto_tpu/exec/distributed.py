@@ -11,9 +11,11 @@ operator/ExchangeClient.java implement the shuffle). Here:
   (NamedSharding over "dp"), so elementwise stages (scan-filter-project)
   parallelize via GSPMD with zero collectives;
 - exchanges are collectives inside shard_map: FIXED_HASH distribution is
-  repartition_by_hash (all_to_all over ICI), FIXED_BROADCAST is a
-  replicated device_put of the build side, GATHER (final output / merge)
-  is an all_gather;
+  the quota-compacted all_to_all over ICI (repartition_by_hash_compact),
+  FIXED_BROADCAST is a device-to-device all-gather of the build side,
+  GATHER (final output / merge) is an all_gather; no operator stages
+  batches through the host — sort/top-n/window/unnest run shard-local
+  with one collective merge;
 - aggregation splits into partial (shard-local) -> hash exchange -> final,
   exactly Presto's PARTIAL/FINAL AggregationNode split, but fused into one
   jitted program per stage instead of two tasks and a wire format.
@@ -85,12 +87,6 @@ class DistributedExecutor(_Executor):
                 for c in batch.columns]
         return Batch(batch.schema, cols, put(batch.row_mask))
 
-    def _replicate(self, batch: Batch) -> Batch:
-        put = lambda x: jax.device_put(x, self._replicated)
-        cols = [Column(c.type, put(c.data), put(c.validity), c.dictionary)
-                for c in batch.columns]
-        return Batch(batch.schema, cols, put(batch.row_mask))
-
     def _smap(self, fn, n_in: int, replicated_in: Sequence[int] = (),
               n_out: int = 1):
         in_specs = tuple(
@@ -106,8 +102,17 @@ class DistributedExecutor(_Executor):
         """Max live rows on any shard (host sync) — sizes compactions."""
         per = self._smap(
             lambda b: jnp.sum(b.row_mask, keepdims=True).astype(jnp.int64), 1)
-        counts = np.asarray(per(batch))
+        counts = np.asarray(jax.device_get(per(batch)))
         return int(counts.max()) if counts.size else 0
+
+    def _replicate_device(self, batch: Batch) -> Batch:
+        """Re-shard a row-sharded batch to fully-replicated WITHOUT a host
+        round trip: jit identity with replicated output sharding makes XLA
+        insert the all-gather over ICI (the FIXED_BROADCAST exchange,
+        reference operator/ExchangeClient.java pulling a broadcast buffer —
+        here device-to-device only)."""
+        return jax.jit(lambda b: b,
+                       out_shardings=self._replicated)(batch)
 
     def _repartitioner(self, key_cols: Sequence[int]):
         """Quota-compacted hash exchange driver: one cheap collective
@@ -123,7 +128,8 @@ class DistributedExecutor(_Executor):
 
         def repart(batch: Batch) -> Batch:
             quota = bucket_capacity(
-                max(int(np.asarray(counts_fn(batch)).max()), 1))
+                max(int(np.asarray(jax.device_get(counts_fn(batch))).max()),
+                    1))
             fn = fns.get(quota)
             if fn is None:
                 fn = fns[quota] = self._smap(
@@ -181,8 +187,11 @@ class DistributedExecutor(_Executor):
                 continue
             from ..batch import unify_dictionaries
             for ci, c in enumerate(p.columns):
-                d = np.asarray(c.data)
-                v = np.asarray(c.validity)
+                # explicit device_get: scan staging deliberately rounds
+                # through the host to stack per-shard chunks; implicit-
+                # transfer guards must not see it as a leak
+                d = np.asarray(jax.device_get(c.data))
+                v = np.asarray(jax.device_get(c.validity))
                 if c.dictionary is not None:
                     if vocabs[ci] is None:
                         vocabs[ci] = c.dictionary
@@ -203,7 +212,7 @@ class DistributedExecutor(_Executor):
                     v = np.pad(v, (0, pad))
                 datas[ci].append(d)
                 valids[ci].append(v)
-            m = np.asarray(p.row_mask)
+            m = np.asarray(jax.device_get(p.row_mask))
             if cap - m.shape[0]:
                 m = np.pad(m, (0, cap - m.shape[0]))
             masks.append(m)
@@ -263,8 +272,10 @@ class DistributedExecutor(_Executor):
                                                 mode="single"), 1)
                 yield fn(b)
             else:
-                yield self._pad_shardable(
-                    global_aggregate(_to_host(b), aggs, mode="single"))
+                fn = self._smap(
+                    lambda x: global_aggregate(
+                        _gathered(x, self.axis), aggs, mode="single"), 1)
+                yield _keep_first_shard(fn(b), self.n)
             return
         if not group:
             yield self._global_agg(node, aggs)
@@ -378,8 +389,9 @@ class DistributedExecutor(_Executor):
         lkeys, rkeys = list(node.left_keys), list(node.right_keys)
         replicated = node.distribution == "replicated"
         if replicated:
-            # FIXED_BROADCAST: build side replicated to every shard
-            build_side = self._replicate(_to_host(build))
+            # FIXED_BROADCAST: build side replicated to every shard —
+            # device-to-device all-gather, no host staging
+            build_side = self._replicate_device(build)
         else:
             # FIXED_HASH: build repartitioned by join key over ICI once
             build_side = self._repartitioner(rkeys)(build)
@@ -462,11 +474,27 @@ class DistributedExecutor(_Executor):
                          survived | reinstate), bmask
 
         count_fn = None
+        maxk_static: Optional[int] = None
         if not node.build_unique:
-            def local_count(p: Batch, b: Batch) -> jnp.ndarray:
-                return match_count_max(p, b, lkeys, rkeys)[None]
-            count_fn = self._smap(local_count, 2,
-                                  replicated_in=(1,) if replicated else ())
+            # ONE build-side multiplicity readback bounds every probe
+            # batch's match count (mirrors exec/local.py): the per-probe-
+            # batch count sync only returns for skewed builds, where the
+            # bound would oversize every batch's expansion
+            from ..ops.join import build_sorted, max_multiplicity
+            mult_fn = self._smap(
+                lambda b: max_multiplicity(
+                    build_sorted(b, rkeys))[None].astype(jnp.int64), 1,
+                replicated_in=(0,) if replicated else ())
+            bound = int(np.asarray(
+                jax.device_get(mult_fn(build_side))).max())
+            if bound <= self.SKEW_MATCH_LIMIT:
+                maxk_static = bucket_capacity(max(bound, 1), minimum=1)
+            else:
+                def local_count(p: Batch, b: Batch) -> jnp.ndarray:
+                    return match_count_max(p, b, lkeys, rkeys)[None]
+                count_fn = self._smap(
+                    local_count, 2,
+                    replicated_in=(1,) if replicated else ())
 
         repart_probe = None if replicated else self._repartitioner(lkeys)
         join_fns: Dict[int, object] = {}
@@ -479,10 +507,13 @@ class DistributedExecutor(_Executor):
             if repart_probe is not None:
                 probe = repart_probe(probe)
             maxk = 1
-            if count_fn is not None:
+            if maxk_static is not None:
+                maxk = maxk_static
+            elif count_fn is not None:
                 maxk = bucket_capacity(
-                    max(int(np.asarray(count_fn(probe, build_side)).max()),
-                        1), minimum=1)
+                    max(int(np.asarray(jax.device_get(
+                        count_fn(probe, build_side))).max()), 1),
+                    minimum=1)
             fn = join_fns.get(maxk)
             if fn is None:
                 if residual_outer:
@@ -532,7 +563,7 @@ class DistributedExecutor(_Executor):
                 if neg:
                     yield b
             return
-        build_rep = self._replicate(_to_host(build))
+        build_rep = self._replicate_device(build)
 
         if node.residual is None:
             def local(b: Batch, flt: Batch) -> Batch:
@@ -546,16 +577,25 @@ class DistributedExecutor(_Executor):
             return
 
         # mark-join (EXISTS with residual): shard-local against the
-        # replicated filtering side; expansion factor host-synced per chunk
+        # replicated filtering side; expansion factor from ONE build-side
+        # multiplicity readback (skewed builds per-chunk, as in the join)
         from .local import mark_exists_mask
-        count_fn = self._smap(
+        from ..ops.join import build_sorted, max_multiplicity
+        mult_fn = self._smap(
+            lambda f: max_multiplicity(
+                build_sorted(f, fkeys))[None].astype(jnp.int64), 1,
+            replicated_in=(0,))
+        bound = int(np.asarray(jax.device_get(mult_fn(build_rep))).max())
+        res_maxk = (bucket_capacity(max(bound, 1), minimum=1)
+                    if bound <= self.SKEW_MATCH_LIMIT else None)
+        count_fn = (None if res_maxk is not None else self._smap(
             lambda p, f: match_count_max(p, f, skeys, fkeys)[None], 2,
-            replicated_in=(1,))
+            replicated_in=(1,)))
         fns: Dict[int, object] = {}
         for b in self.run(node.source):
-            maxk = bucket_capacity(
-                max(int(np.asarray(count_fn(b, build_rep)).max()), 1),
-                minimum=1)
+            maxk = res_maxk if res_maxk is not None else bucket_capacity(
+                max(int(np.asarray(jax.device_get(
+                    count_fn(b, build_rep))).max()), 1), minimum=1)
             fn = fns.get(maxk)
             if fn is None:
                 def local_mark(p: Batch, f: Batch, _k=maxk) -> Batch:
@@ -627,35 +667,56 @@ class DistributedExecutor(_Executor):
                                     pid, self.axis, n)
             return sort_batch(ex, keys)
 
-        yield self._pad_shardable(_to_host(self._smap(program, 1)(b)))
+        # shard-major concatenation of the range-partitioned shards IS the
+        # global order — yield the device-resident sharded batch directly
+        yield self._smap(program, 1)(b)
 
     def _TopNNode(self, node: TopNNode) -> Iterator[Batch]:
+        """Shard-local top-n accumulation (collective-free per batch),
+        then ONE device-side all-gather merge at the end — replaces the
+        round-4 path that gathered every candidate batch to the host
+        (reference TopNOperator keeps a per-driver heap the same way and
+        merges once at output)."""
         keys = [SortKey(k.index, k.ascending, k.nulls_first)
                 for k in node.keys]
         cap = bucket_capacity(node.count)
         local_topn = self._smap(
             lambda b: top_n(b, keys, node.count).compact(cap, check=False), 1)
+        merge_fn = self._smap(
+            lambda s, c: top_n(concat_batches([s, c]), keys,
+                               node.count).compact(cap, check=False), 2)
         state: Optional[Batch] = None
         for b in self.run(node.child):
-            cand = _to_host(local_topn(b))     # [n*cap] gathered
-            merged = cand if state is None else concat_batches([state, cand])
-            state = top_n(merged, keys, node.count).compact(cap)
+            cand = local_topn(b)
+            state = cand if state is None else merge_fn(state, cand)
         if state is not None:
-            yield self._pad_shardable(sort_batch(state, keys))
+            # every shard computes the same global top-n over the gathered
+            # candidates; mask all but shard 0's copy
+            final_fn = self._smap(
+                lambda s: sort_batch(
+                    top_n(_gathered(s, self.axis), keys, node.count),
+                    keys), 1)
+            yield _keep_first_shard(final_fn(state), self.n)
 
     def _UnnestNode(self, node) -> Iterator[Batch]:
-        # gather to host, expand, re-shard: capacity changes (cap*L) would
-        # otherwise break mesh divisibility for downstream exchanges
+        # shard-local expansion: every shard expands by the same static
+        # element count L, so per-shard capacity stays uniform (cap_l*L)
+        # and downstream exchanges keep mesh divisibility
         from .local import unnest_expand_fn, _plan_schema as _ps
-        b = self._drain(node.child)
-        if b is None:
-            return
         exprs = tuple(self._resolve(e) for e in node.exprs)
         fn = unnest_expand_fn(exprs, node.ordinality, _ps(node))
-        out, err = fn(_to_host(b))
-        if err is not None:
-            self.error_flags.append(err)
-        yield self._pad_shardable(out)
+
+        def local_unnest(x: Batch):
+            out, err = fn(x)
+            e = (jnp.zeros((1,), jnp.int32) if err is None
+                 else err.reshape(1).astype(jnp.int32))
+            return out, e
+
+        sfn = self._smap(local_unnest, 1, n_out=2)
+        for b in self.run(node.child):
+            out, err = sfn(b)
+            self.error_flags.append(jnp.max(err))
+            yield out
 
     def _WindowNode(self, node) -> Iterator[Batch]:
         from ..ops.window import WindowSpec, evaluate_window
@@ -676,10 +737,13 @@ class DistributedExecutor(_Executor):
                 lambda x: evaluate_window(x, parts, keys, specs), 1)
             out = fn(b)
         else:
-            # single global partition: evaluate on the gathered batch,
-            # re-shard so downstream exchanges see mesh-divisible capacity
-            out = self._pad_shardable(
-                evaluate_window(_to_host(b), parts, keys, specs))
+            # single global partition: every shard evaluates the window
+            # over the device-gathered batch (replicated compute over ICI;
+            # no host round trip); keep shard 0's copy
+            fn = self._smap(
+                lambda x: evaluate_window(_gathered(x, self.axis),
+                                          parts, keys, specs), 1)
+            out = _keep_first_shard(fn(b), self.n)
         yield Batch(schema, out.columns, out.row_mask)
 
     def _DistinctNode(self, node: DistinctNode) -> Iterator[Batch]:
@@ -735,14 +799,6 @@ def _keep_first_shard(b: Batch, n: int) -> Batch:
     per = cap // n
     keep = jnp.arange(cap) < per
     return Batch(b.schema, b.columns, b.row_mask & keep)
-
-
-def _to_host(b: Batch) -> Batch:
-    """Materialize a sharded batch as host arrays (gather)."""
-    cols = [Column(c.type, jnp.asarray(np.asarray(c.data)),
-                   jnp.asarray(np.asarray(c.validity)), c.dictionary)
-            for c in b.columns]
-    return Batch(b.schema, cols, jnp.asarray(np.asarray(b.row_mask)))
 
 
 def _host_col(typ, vocab):

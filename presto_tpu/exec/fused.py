@@ -1,0 +1,134 @@
+"""Whole-pipeline fusion: one jitted program per join probe pipeline.
+
+The reference compiles each operator to bytecode but still moves data
+between operators one Page at a time through the Driver loop (reference
+operator/Driver.java:367-400). On this backend the equivalent
+per-operator dispatch is far more expensive: every operator boundary is
+a separate XLA executable whose outputs MATERIALIZE in HBM — a chain of
+N unique-build dimension joins re-writes the full fact-table width N
+times and pays N kernel-launch round trips per batch (the "~15 gather
+passes" q27 diagnosis in docs/perf.md).
+
+This module fuses a probe pipeline — a chain of unique-build lookup
+joins, filters, and projections over one streaming source — into ONE
+jitted function. XLA then keeps intermediate columns in registers/HBM
+exactly once, dead columns are eliminated end-to-end, and a probe batch
+pays one dispatch for the whole chain. The analogue in spirit of the
+reference's ScanFilterAndProjectOperator fusion (reference
+operator/ScanFilterAndProjectOperator.java:62), generalized to join
+chains.
+
+Fusion is semantics-preserving: each stage applies the SAME kernel the
+standalone operator would (lookup_join / eval_expr), so results are
+identical; only materialization boundaries change. The executor decides
+WHAT to fuse (exec/local.py _try_fused_chain) and keeps the generic
+per-operator path for everything else (skewed builds, residual filters,
+outer tails, shared subtrees).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import Batch, Column, Schema
+from ..expr import ir
+from ..expr.compiler import Val, eval_expr, merge_err
+from .. import types as T  # noqa: F401  (type objects live in stage fields)
+from ..ops.join import lookup_join
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinStage:
+    """One unique-build lookup join. ``dyn_keys`` are probe-schema column
+    indices with runtime [lo, hi] bounds from the build summary (inner
+    joins only) — values arrive as traced scalars so changing bounds
+    never recompiles."""
+    lkeys: Tuple[int, ...]
+    rkeys: Tuple[int, ...]
+    payload: Tuple[int, ...]
+    names: Tuple[str, ...]
+    join_type: str                        # inner | left
+    out_fields: Tuple[Tuple[str, object], ...]
+    dyn_keys: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterStage:
+    pred: ir.Expr
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectStage:
+    exprs: Tuple[ir.Expr, ...]
+    out_names: Tuple[str, ...]
+
+
+def _vals(batch: Batch):
+    inputs = [Val(c.data, c.validity, c.type, c.dictionary)
+              for c in batch.columns]
+    if not inputs:
+        inputs = [Val(batch.row_mask, batch.row_mask, T.BOOLEAN)]
+    return inputs
+
+
+@functools.lru_cache(maxsize=None)
+def fused_pipeline(stages: Tuple[object, ...]):
+    """jitted fn(probe, preps, builds, dyns) -> (Batch, err_or_None).
+
+    ``preps``/``builds``/``dyns`` are tuples with one entry per JoinStage
+    (bottom-up order); ``dyns[i]`` is an [n_bounds, 2] i64 array aligned
+    with that stage's dyn_keys. Capacity/schema specialization happens
+    inside jax.jit (pytree structure + shapes are the dispatch key), so
+    one cache entry serves every batch size bucket of the chain.
+    """
+
+    def run(probe: Batch, preps, builds, dyns):
+        cur = probe
+        errs = []
+        ji = 0
+        for st in stages:
+            if isinstance(st, JoinStage):
+                if st.dyn_keys:
+                    keep = cur.row_mask
+                    b = dyns[ji]
+                    for j, ki in enumerate(st.dyn_keys):
+                        c = cur.columns[ki]
+                        keep = keep & c.validity & (c.data >= b[j, 0]) \
+                            & (c.data <= b[j, 1])
+                    cur = Batch(cur.schema, cur.columns, keep)
+                out = lookup_join(cur, builds[ji], st.lkeys, st.rkeys,
+                                  st.payload, st.names, st.join_type,
+                                  prepared=preps[ji])
+                cur = Batch(Schema(list(st.out_fields)), out.columns,
+                            out.row_mask)
+                ji += 1
+            elif isinstance(st, FilterStage):
+                p = eval_expr(st.pred, _vals(cur))
+                keep = cur.row_mask & p.valid & p.data
+                if p.err is not None:
+                    errs.append(jnp.max(jnp.where(cur.row_mask, p.err,
+                                                  jnp.int32(0))))
+                cur = Batch(cur.schema, cur.columns, keep)
+            else:  # ProjectStage
+                outs = [eval_expr(e, _vals(cur)) for e in st.exprs]
+                cols = [Column(o.type, o.data, o.valid & cur.row_mask,
+                               o.dictionary) for o in outs]
+                row_errs = merge_err(*[o.err for o in outs])
+                if row_errs is not None:
+                    errs.append(jnp.max(jnp.where(cur.row_mask, row_errs,
+                                                  jnp.int32(0))))
+                cur = Batch(Schema([(n, e.type) for n, e in
+                                    zip(st.out_names, st.exprs)]),
+                            cols, cur.row_mask)
+        err: Optional[jnp.ndarray] = None
+        if errs:
+            err = errs[0]
+            for e in errs[1:]:
+                err = jnp.maximum(err, e)
+        return cur, err
+
+    return jax.jit(run)

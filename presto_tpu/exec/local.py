@@ -887,6 +887,12 @@ class _Executor:
 
     def _join_dispatch(self, node: JoinNode) -> Iterator[Batch]:
         lifespan = self._lifespan_partitions(node)
+        if lifespan is None and bool_property(self.session,
+                                              "fused_pipeline", True):
+            fused = self._try_fused_chain(node)
+            if fused is not None:
+                yield from fused
+                return
         if lifespan is not None:
             ls, rs, buckets = lifespan
             for lsplits, rsplits in buckets:
@@ -903,6 +909,170 @@ class _Executor:
                     self.dynamic_pushdown = saved_dyn
             return
         yield from self._join_once(node)
+
+    def _try_fused_chain(self, top: JoinNode):
+        """Head check for whole-pipeline fusion (exec/fused.py): a chain
+        of unique-build inner/left lookup joins with interleaved filters
+        and projections over one streaming source fuses into ONE jitted
+        program per probe batch. Returns the output iterator, or None
+        when the shape doesn't qualify — skewed/non-unique builds,
+        residual predicates, FULL OUTER, cross joins, shared interior
+        subtrees — in which case the generic per-operator path runs
+        unchanged. EXPLAIN ANALYZE attributes the fused chain's work to
+        the head join (interior nodes never execute standalone)."""
+        def join_ok(j: PlanNode) -> bool:
+            return (isinstance(j, JoinNode)
+                    and j.join_type in ("inner", "left")
+                    and j.build_unique and j.residual is None)
+
+        if not join_ok(top):
+            return None
+        nodes: List[PlanNode] = []       # top-down
+        cur: PlanNode = top
+        njoins = 0
+        while True:
+            if cur is not top and cur in self._shared:
+                break                    # memoized source boundary
+            if join_ok(cur):
+                nodes.append(cur)
+                njoins += 1
+                cur = cur.left
+            elif isinstance(cur, (FilterNode, ProjectNode)):
+                nodes.append(cur)
+                cur = cur.child
+            else:
+                break
+        if njoins < 2:
+            return None
+        return self._run_fused_chain(nodes, cur)
+
+    def _run_fused_chain(self, nodes: List[PlanNode], source: PlanNode):
+        """Drain + prepare every build in the chain (bottom-up), push all
+        dynamic-filter bounds to the source scan BEFORE it starts (the
+        generic path can only push the bottom join's bounds), then stream
+        the probe source through the fused program."""
+        from .fused import (FilterStage, JoinStage, ProjectStage,
+                            fused_pipeline)
+        from .spill import HostPartitionStore, SpillableBuildBuffer
+
+        order = list(reversed(nodes))
+        # current-schema index -> source-schema index (for scan pushdown)
+        src_map = {i: i for i in range(len(source.fields))}
+        scan_target = self._dynamic_scan_target(source) \
+            if isinstance(source, TableScanNode) else None
+        dyn_enabled = bool_property(self.session,
+                                    "enable_dynamic_filtering", True)
+        stages: List[object] = []
+        preps: List[object] = []
+        builds: List[Batch] = []
+        dyns: List[jnp.ndarray] = []
+        bufs: List = []
+
+        def close_bufs() -> None:
+            for bf in bufs:
+                bf.close()
+
+        try:
+            ok = self._drain_fused_builds(
+                order, src_map, scan_target, dyn_enabled, stages, preps,
+                builds, dyns, bufs)
+        except BaseException:
+            close_bufs()
+            raise
+        if not ok:
+            close_bufs()
+            return None
+
+        fn = fused_pipeline(tuple(stages))
+        preps_t, builds_t, dyns_t = tuple(preps), tuple(builds), tuple(dyns)
+        compact = self._compactor()
+
+        def stream() -> Iterator[Batch]:
+            try:
+                for probe in self.run(source):
+                    out, err = fn(probe, preps_t, builds_t, dyns_t)
+                    if err is not None:
+                        self.error_flags.append(err)
+                    yield compact(out)
+            finally:
+                close_bufs()
+        return stream()
+
+    def _drain_fused_builds(self, order, src_map, scan_target, dyn_enabled,
+                            stages, preps, builds, dyns, bufs) -> bool:
+        """Drain + prepare every build of a fused chain, appending to the
+        caller's lists; False = shape disqualified (empty/spilled build),
+        fall back to the generic path."""
+        from .fused import FilterStage, JoinStage, ProjectStage
+        from .spill import HostPartitionStore, SpillableBuildBuffer
+
+        for nd in order:
+            if isinstance(nd, FilterNode):
+                stages.append(FilterStage(self._resolve(nd.predicate)))
+                continue
+            if isinstance(nd, ProjectNode):
+                exprs = tuple(self._resolve(e) for e in nd.exprs)
+                stages.append(ProjectStage(
+                    exprs, tuple(f.name for f in nd.fields)))
+                new_map = {}
+                for out_i, e in enumerate(exprs):
+                    if isinstance(e, ir.InputRef) and e.index in src_map:
+                        new_map[out_i] = src_map[e.index]
+                src_map = new_map
+                continue
+            # JoinStage: drain + prepare this build now (through the
+            # spillable buffer for memory accounting; a build the pool
+            # forces to host can't fuse — generic path re-drains it)
+            buf = SpillableBuildBuffer(self.pool, "join-build",
+                                       list(nd.right_keys),
+                                       self.spill_partitions)
+            bufs.append(buf)
+            for b in self.run(nd.right):
+                buf.add(b)
+            build = buf.finish()
+            if build is None or isinstance(build, HostPartitionStore):
+                return False             # empty/spilled: generic path
+            summary = self._build_summary(build, nd.right_keys)
+            if int(summary[0]) == 0:
+                return False
+            scap = bucket_capacity(max(int(summary[0]), 1))
+            if scap < build.capacity:
+                from ..ops.jitcache import compact_jit
+                build = compact_jit(build, scap)
+            prep = self._prepare_join_build(build, nd.right_keys,
+                                            summary=summary)
+            dyn_keys: Tuple[int, ...] = ()
+            dyn_val = jnp.zeros((0, 2), dtype=jnp.int64)
+            if nd.join_type == "inner" and dyn_enabled:
+                bounds = self._summary_bounds(summary, nd.left_keys)
+                if bounds:
+                    dyn_keys = tuple(k for k, _, _ in bounds)
+                    dyn_val = jnp.asarray([[lo, hi]
+                                           for _, lo, hi in bounds],
+                                          dtype=jnp.int64)
+                    if scan_target is not None:
+                        scan, smap = scan_target
+                        extra = []
+                        for k, lo, hi in bounds:
+                            si = src_map.get(k)
+                            si = smap.get(si) if si is not None else None
+                            if si is not None:
+                                extra.append((scan.columns[si], lo, hi))
+                        if extra:
+                            self.dynamic_pushdown.setdefault(
+                                scan, []).extend(extra)
+            stages.append(JoinStage(
+                lkeys=tuple(nd.left_keys), rkeys=tuple(nd.right_keys),
+                payload=tuple(range(len(nd.right.fields))),
+                names=tuple(f"$b{i}"
+                            for i in range(len(nd.right.fields))),
+                join_type=nd.join_type,
+                out_fields=tuple((f.name, f.type) for f in nd.fields),
+                dyn_keys=dyn_keys))
+            preps.append(prep)
+            builds.append(build)
+            dyns.append(dyn_val)
+        return True
 
     def _join_once(self, node: JoinNode) -> Iterator[Batch]:
         payload = list(range(len(node.right.fields)))

@@ -382,6 +382,11 @@ class LocalRunner:
     # -- write path (reference TableWriterOperator + finishInsert) ----------
     def _writable(self, name, user: str = ""):
         catalog = self.session.catalog if len(name) < 3 else name[-3]
+        if len(name) == 2 and self.session.catalogs.exists(name[0]):
+            # two-part name whose qualifier names a mounted catalog:
+            # catalog.table with the default schema (matches the read
+            # path's catalog-first resolution)
+            catalog = name[0]
         self.access_control.check_can_access_catalog(user, catalog)
         if self.roles.enforce:
             self.roles.check_table_privilege(user, catalog, name[-1],

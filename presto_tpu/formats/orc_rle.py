@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.prefix import prefix_sum
 from ..batch import bucket_capacity
 
 # 5-bit width code -> bit width (ORC spec "Direct" width encoding)
@@ -317,7 +318,7 @@ def _expand_runs(stream_u8: jnp.ndarray, table: Tuple[jnp.ndarray, ...],
     sign = jnp.where(db >= 0, 1, -1).astype(jnp.int64)
     dmag = jnp.where(width > 0, raw, jnp.abs(db))
     contrib = jnp.where((kind == K_DELTA) & (i >= 2), sign * dmag, 0)
-    cum = jnp.cumsum(contrib)
+    cum = prefix_sum(contrib)
     run_first = jnp.clip(jnp.take(out_start, r), 0, n_cap)
     cum_before_run = jnp.take(
         jnp.concatenate([jnp.zeros(1, jnp.int64), cum]), run_first)

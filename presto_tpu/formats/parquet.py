@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.prefix import prefix_sum
 from .. import types as T
 from ..batch import Batch, Column, Schema, bucket_capacity
 from . import thrift_compact as tc
@@ -527,7 +528,7 @@ def _assemble_column(col: ParquetColumn, parts, dict_values, dict_vocab,
     # scatter present values to row slots: row j takes the k-th value
     # where k = rank of j among present rows
     presj = jnp.asarray(present_all)
-    rank = jnp.cumsum(presj.astype(jnp.int32)) - 1
+    rank = prefix_sum(presj.astype(jnp.int32)) - 1
     gathered = jnp.take(flat.astype(out_dtype),
                         jnp.clip(rank, 0, flat.shape[0] - 1), axis=0)
     data = jnp.where(presj, gathered, jnp.zeros_like(gathered))

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .prefix import prefix_sum
 from .. import types as T
 from ..batch import Batch, Column, Schema
 from ..types import Type
@@ -200,7 +201,7 @@ def _boundary_groups(s_keys, s_mask):
         diff = diff | (op != jnp.roll(op, 1))
     first = jnp.zeros_like(s_mask).at[0].set(True)
     boundary = s_mask & (diff | first)
-    group_id = jnp.maximum(jnp.cumsum(boundary.astype(jnp.int64)) - 1, 0)
+    group_id = jnp.maximum(prefix_sum(boundary.astype(jnp.int64)) - 1, 0)
     num_groups = jnp.sum(boundary.astype(jnp.int64))
     return boundary, group_id, num_groups
 
@@ -319,7 +320,7 @@ class _SegReducers:
                     max_rows_per_group=self.n_rows)
             if x.dtype == jnp.float64 and pallas_supported():
                 n = x.shape[0]
-                csum = jnp.cumsum(x)
+                csum = prefix_sum(x)
                 prev = jnp.clip(self.starts - 1, 0, n - 1)
                 ends = jnp.concatenate(
                     [jnp.clip(self.starts[1:] - 1, 0, n - 1),

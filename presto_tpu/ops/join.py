@@ -24,6 +24,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from .prefix import prefix_sum
 from .. import types as T
 from ..batch import Batch, Column, Schema
 
@@ -413,7 +414,7 @@ def build_match_mask(
     add = (jnp.zeros(n + 1, dtype=jnp.int32)
            .at[jnp.where(live, lo, n)].add(inc)
            .at[jnp.where(live, hi, n)].add(-inc))
-    covered = (jnp.cumsum(add[:n]) > 0) & slive
+    covered = (prefix_sum(add[:n]) > 0) & slive
     return jnp.zeros(n, dtype=bool).at[perm].set(covered)
 
 

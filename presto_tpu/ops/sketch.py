@@ -191,7 +191,7 @@ def qd_estimate(counts: jnp.ndarray, p: float):
     total = jnp.sum(counts, axis=-1)
     k = jnp.clip(jnp.ceil(p * total.astype(jnp.float64)).astype(jnp.int64),
                  1, jnp.maximum(total, 1))
-    cum = jnp.cumsum(counts, axis=-1)
+    cum = prefix_sum(counts, axis=counts.ndim - 1)
     bin_idx = jnp.argmax(cum >= k[..., None], axis=-1)
     reps = jnp.asarray(qd_rep_values())
     return jnp.take(reps, bin_idx, axis=0), total > 0

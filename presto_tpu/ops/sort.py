@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .prefix import prefix_sum
 from .. import types as T
 from ..batch import Batch, Column, Schema
 
@@ -122,7 +123,7 @@ def sort_batch(batch: Batch, keys: Sequence[SortKey]) -> Batch:
 
 def limit(batch: Batch, n: int) -> Batch:
     """Keep the first n live rows (in current physical order)."""
-    live_rank = jnp.cumsum(batch.row_mask.astype(jnp.int64))
+    live_rank = prefix_sum(batch.row_mask.astype(jnp.int64))
     keep = batch.row_mask & (live_rank <= n)
     return Batch(batch.schema, batch.columns, keep)
 

@@ -29,6 +29,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from .prefix import prefix_sum
 from .. import types as T
 from ..batch import Batch, Column, Schema
 from ..types import Type
@@ -259,7 +260,7 @@ def evaluate_window(
     oend = jnp.minimum(onext, live_n) - 1                         # inclusive
 
     row_in_part = idx - pstart                                    # 0-based
-    dense = jnp.cumsum(oboundary.astype(jnp.int64))               # global
+    dense = prefix_sum(oboundary.astype(jnp.int64))               # global
     dense_at_pstart = jnp.take(dense, jnp.maximum(pstart, 0))
 
     # first-order-key context for offset RANGE frames: raw sorted values,
@@ -444,7 +445,7 @@ def _one_window(spec, s_cols, batch, mask, idx, pstart, pend, psize,
             val = jnp.take(inv, jnp.clip(val, 0, inv.shape[0] - 1), axis=0)
         return val, cnt > 0
     # sum / count / avg
-    csum = jnp.cumsum(x)
+    csum = prefix_sum(x)
     if explicit:
         base = jnp.where(fs > 0,
                          jnp.take(csum, jnp.clip(fs - 1, 0, cap - 1),
@@ -479,7 +480,7 @@ def _agg_frame_end(spec, frame_end, pend):
 
 def _running_count(valid_in, pstart, upto):
     cap = valid_in.shape[0]
-    csum = jnp.cumsum(valid_in.astype(jnp.int64))
+    csum = prefix_sum(valid_in.astype(jnp.int64))
     base = jnp.where(pstart > 0,
                      jnp.take(csum, jnp.maximum(pstart - 1, 0), axis=0), 0)
     return jnp.take(csum, jnp.clip(upto, 0, cap - 1), axis=0) - base
@@ -488,7 +489,7 @@ def _running_count(valid_in, pstart, upto):
 def _frame_count(valid_in, fs, fe):
     """Valid-row count over explicit [fs, fe] frames (0 when empty)."""
     cap = valid_in.shape[0]
-    csum = jnp.cumsum(valid_in.astype(jnp.int64))
+    csum = prefix_sum(valid_in.astype(jnp.int64))
     base = jnp.where(fs > 0,
                      jnp.take(csum, jnp.clip(fs - 1, 0, cap - 1), axis=0),
                      0)

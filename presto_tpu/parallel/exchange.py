@@ -12,11 +12,12 @@ All functions here are *collective*: they must run inside ``shard_map``
 over the mesh axis they name. Host-side orchestration (which stage runs
 where) lives in exec/; these are the data-plane moves.
 
-v1 wire-cost note: `repartition_by_hash` ships each shard's full batch to
-every peer with per-destination masks (cost n*C rows, same as all-gather).
-A quota-compacted variant (sort by destination, send C/n-sized chunks) cuts
-this to ~C once batch compaction moves on-device; the masked form is the
-correctness baseline.
+Wire cost: the default exchange is ``repartition_by_hash_compact`` —
+rows sort by destination on device and exactly ``quota`` slots ship to
+each peer, so a shard moves ~C rows per exchange (n*quota with quota
+sized to the max per-(src,dst) count). The masked ``repartition_by_hash``
+(n*C cost) remains as the correctness baseline and for callers without a
+quota readback.
 """
 from __future__ import annotations
 

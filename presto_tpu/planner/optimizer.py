@@ -257,32 +257,12 @@ def _coerce_ref(idx: int, t: T.Type, to: T.Type) -> ir.Expr:
 
 
 def _estimate_rows(node: PlanNode, session: Session) -> float:
-    if isinstance(node, TableScanNode):
-        conn = session.catalogs.get(node.catalog)
-        stats = conn.metadata.table_stats(node.table)
-        return stats.row_count or 1e9
-    if isinstance(node, FilterNode):
-        return 0.25 * _estimate_rows(node.child, session)
-    if isinstance(node, (ProjectNode, SortNode)):
-        return _estimate_rows(node.child, session)
-    if isinstance(node, AggregationNode) and not node.group_indices:
-        return 1.0        # global aggregate: exactly one row
-    if isinstance(node, (AggregationNode, DistinctNode)):
-        return max(1.0, 0.1 * _estimate_rows(node.child, session))
-    if isinstance(node, (TopNNode, LimitNode)):
-        return min(node.count, _estimate_rows(node.child, session))
-    if isinstance(node, JoinNode):
-        return max(_estimate_rows(node.left, session),
-                   _estimate_rows(node.right, session))
-    if isinstance(node, SemiJoinNode):
-        return 0.5 * _estimate_rows(node.source, session)
-    if isinstance(node, UnionNode):
-        return sum(_estimate_rows(c, session) for c in node.children)
-    if isinstance(node, ValuesNode):
-        return float(len(node.rows))
-    if node.children:
-        return _estimate_rows(node.children[0], session)
-    return 1e6
+    """Row estimate via the stats calculus (planner/stats.py): scan
+    statistics propagated through filter selectivities (range/NDV math),
+    join containment, and group NDV products — the reference's
+    cost/StatsCalculator.java role."""
+    from .stats import StatsCalculator
+    return StatsCalculator(session).rows(node)
 
 
 def _plan_join_graph(join: JoinNode, extra_preds: List[ir.Expr],
@@ -760,39 +740,13 @@ _PUSHABLE_AGG_FNS = ("sum", "count", "count_star", "min", "max", "avg")
 
 def _column_distinct(node: PlanNode, idx: int,
                      session: Session) -> Optional[float]:
-    """Upper-bound distinct-count estimate for one output column, walked
-    down to a scan's connector statistics (the narrow slice of the
-    reference's stats calculus — cost/StatsCalculator.java — the eager-
-    aggregation gate needs). Filters and joins never grow a column's
-    distinct count, so passing estimates through them stays an upper
-    bound; None = unknown."""
-    if isinstance(node, TableScanNode):
-        conn = session.catalogs.get(node.catalog)
-        stats = conn.metadata.table_stats(node.table)
-        cs = stats.columns.get(node.columns[idx])
-        return float(cs.distinct_count) \
-            if cs is not None and cs.distinct_count is not None else None
-    if isinstance(node, FilterNode):
-        # discount by the same selectivity factor _estimate_rows applies
-        # to the filtered ROWS: comparing an undiscounted distinct count
-        # against discounted rows would systematically veto pushes on
-        # filtered probe sides (dropping rows drops distinct values too,
-        # roughly proportionally for non-key predicates)
-        d = _column_distinct(node.child, idx, session)
-        return 0.25 * d if d is not None else None
-    if isinstance(node, ProjectNode):
-        e = node.exprs[idx]
-        if isinstance(e, ir.InputRef):
-            return _column_distinct(node.child, e.index, session)
-        return None
-    if isinstance(node, JoinNode):
-        nl = len(node.left.fields)
-        if idx < nl:
-            return _column_distinct(node.left, idx, session)
-        return _column_distinct(node.right, idx - nl, session)
-    if isinstance(node, SemiJoinNode):
-        return _column_distinct(node.source, idx, session)
-    return None
+    """Distinct-count estimate for one output column via the stats
+    calculus (NDV propagated from scan statistics, capped by filtered
+    row counts) — the eager-aggregation gate's input."""
+    from .stats import StatsCalculator
+    calc = StatsCalculator(session)
+    d = calc.estimate(node).column(idx).distinct
+    return min(d, calc.rows(node)) if d is not None else None
 
 
 def _push_partial_agg_through_join(node: PlanNode,

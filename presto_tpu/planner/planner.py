@@ -318,7 +318,15 @@ class _Planner:
             catalog, schema, table = (self.session.catalog,
                                       self.session.schema, name[0])
         elif len(name) == 2:
-            catalog, schema, table = self.session.catalog, name[0], name[1]
+            if self.session.catalogs.exists(name[0]):
+                # two-part qualifier naming a mounted catalog resolves
+                # catalog-first (catalog.table in its default schema) —
+                # same rule as the write path (_writable), so the same
+                # name reads and writes one table
+                catalog, schema, table = name[0], "default", name[1]
+            else:
+                catalog, schema, table = (self.session.catalog, name[0],
+                                          name[1])
         else:
             catalog, schema, table = name[-3], name[-2], name[-1]
         view_key = (catalog, schema, table)

@@ -465,3 +465,8 @@ class PrestoTpuServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+
+
+#: the protocol-facing name (reference dispatcher/QueuedStatementResource
+#: serves POST /v1/statement); PrestoTpuServer remains the historical alias
+StatementServer = PrestoTpuServer

@@ -22,3 +22,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compile cache shared across test processes/runs: the
+# suite's wall-clock is dominated by kernel compiles (lax.sort at 2^17
+# costs tens of seconds per variant on XLA:CPU), and the same shapes
+# recur run over run (reference discipline: LocalQueryRunner reuse,
+# presto-main/.../testing/LocalQueryRunner.java:210).
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache_cpu"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
