@@ -92,6 +92,8 @@ def _rows(table: str, sf: float) -> int:
         return max(1, int(300 * max(sf, 1) ** 0.5))
     if table == "time_dim":
         return 86_400        # one row per second of day (spec)
+    if table in EXT_ROWS:
+        return EXT_ROWS[table](sf)
     raise KeyError(table)
 
 
@@ -107,27 +109,42 @@ _SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
         ("ss_quantity", T.INTEGER), ("ss_wholesale_cost", T.DOUBLE),
         ("ss_list_price", T.DOUBLE), ("ss_sales_price", T.DOUBLE),
         ("ss_ext_sales_price", T.DOUBLE), ("ss_coupon_amt", T.DOUBLE),
-        ("ss_net_paid", T.DOUBLE), ("ss_net_profit", T.DOUBLE),
+        ("ss_ext_discount_amt", T.DOUBLE),
+        ("ss_ext_wholesale_cost", T.DOUBLE),
+        ("ss_ext_list_price", T.DOUBLE), ("ss_ext_tax", T.DOUBLE),
+        ("ss_net_paid", T.DOUBLE), ("ss_net_paid_inc_tax", T.DOUBLE),
+        ("ss_net_profit", T.DOUBLE),
     ],
     "date_dim": [
         ("d_date_sk", T.BIGINT), ("d_date", T.DATE),
         ("d_year", T.INTEGER), ("d_moy", T.INTEGER),
         ("d_dom", T.INTEGER), ("d_qoy", T.INTEGER),
-        ("d_day_name", T.varchar(9)),
+        ("d_day_name", T.varchar(9)), ("d_dow", T.INTEGER),
+        ("d_month_seq", T.INTEGER), ("d_week_seq", T.INTEGER),
+        ("d_quarter_name", T.varchar(6)),
     ],
     "item": [
         ("i_item_sk", T.BIGINT), ("i_item_id", T.varchar(16)),
         ("i_brand_id", T.INTEGER), ("i_brand", T.varchar(50)),
         ("i_manufact_id", T.INTEGER), ("i_manager_id", T.INTEGER),
         ("i_category_id", T.INTEGER), ("i_category", T.varchar(50)),
-        ("i_current_price", T.DOUBLE),
+        ("i_current_price", T.DOUBLE), ("i_class_id", T.INTEGER),
+        ("i_class", T.varchar(50)), ("i_item_desc", T.varchar(200)),
+        ("i_manufact", T.varchar(50)), ("i_color", T.varchar(20)),
+        ("i_product_name", T.varchar(50)), ("i_size", T.varchar(20)),
+        ("i_units", T.varchar(10)), ("i_wholesale_cost", T.DOUBLE),
     ],
     "store": [
         ("s_store_sk", T.BIGINT), ("s_store_id", T.varchar(16)),
         ("s_store_name", T.varchar(50)), ("s_city", T.varchar(60)),
         ("s_county", T.varchar(30)), ("s_state", T.varchar(2)),
         ("s_zip", T.varchar(10)), ("s_number_employees", T.INTEGER),
-        ("s_gmt_offset", T.DOUBLE),
+        ("s_gmt_offset", T.DOUBLE), ("s_company_id", T.INTEGER),
+        ("s_company_name", T.varchar(50)), ("s_market_id", T.INTEGER),
+        ("s_street_number", T.varchar(10)),
+        ("s_street_name", T.varchar(60)),
+        ("s_street_type", T.varchar(15)),
+        ("s_suite_number", T.varchar(10)),
     ],
     "customer_demographics": [
         ("cd_demo_sk", T.BIGINT), ("cd_gender", T.varchar(1)),
@@ -146,13 +163,24 @@ _SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
         ("c_current_addr_sk", T.BIGINT),
         ("c_first_name", T.varchar(20)), ("c_last_name", T.varchar(30)),
         ("c_preferred_cust_flag", T.varchar(1)),
-        ("c_birth_year", T.INTEGER),
+        ("c_birth_year", T.INTEGER), ("c_salutation", T.varchar(10)),
+        ("c_birth_country", T.varchar(20)), ("c_birth_day", T.INTEGER),
+        ("c_birth_month", T.INTEGER),
+        ("c_email_address", T.varchar(50)), ("c_login", T.varchar(13)),
+        ("c_first_sales_date_sk", T.BIGINT),
+        ("c_first_shipto_date_sk", T.BIGINT),
+        ("c_last_review_date_sk", T.BIGINT),
     ],
     "customer_address": [
         ("ca_address_sk", T.BIGINT), ("ca_address_id", T.varchar(16)),
         ("ca_city", T.varchar(60)), ("ca_county", T.varchar(30)),
         ("ca_state", T.varchar(2)), ("ca_zip", T.varchar(10)),
         ("ca_country", T.varchar(20)), ("ca_gmt_offset", T.DOUBLE),
+        ("ca_location_type", T.varchar(20)),
+        ("ca_street_number", T.varchar(10)),
+        ("ca_street_name", T.varchar(60)),
+        ("ca_street_type", T.varchar(15)),
+        ("ca_suite_number", T.varchar(10)),
     ],
     "household_demographics": [
         ("hd_demo_sk", T.BIGINT), ("hd_income_band_sk", T.BIGINT),
@@ -173,6 +201,11 @@ _SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
     ],
 }
 
+from .tpcds_ext import (  # noqa: E402
+    EXT_PRIMARY_KEYS, EXT_ROWS, EXT_SCHEMAS, ExtGen,
+)
+_SCHEMAS.update(EXT_SCHEMAS)
+
 TABLES = tuple(_SCHEMAS)
 
 _DAY_NAMES = ("Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
@@ -180,7 +213,7 @@ _DAY_NAMES = ("Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
 _BRANDS = tuple(f"Brand#{i}" for i in range(1, 1001))
 
 
-class _Gen:
+class _Gen(ExtGen):
     """Column generators keyed by 1-based surrogate row keys."""
 
     def __init__(self, sf: float):
@@ -255,6 +288,17 @@ class _Gen:
             elif c == "ss_net_profit":
                 out[c] = (np.round(ext_sales - coupon
                                    - wholesale * qty, 2), None)
+            elif c == "ss_ext_discount_amt":
+                out[c] = (np.round((list_price - sales_price) * qty, 2),
+                          None)
+            elif c == "ss_ext_wholesale_cost":
+                out[c] = (np.round(wholesale * qty, 2), None)
+            elif c == "ss_ext_list_price":
+                out[c] = (np.round(list_price * qty, 2), None)
+            elif c == "ss_ext_tax":
+                out[c] = (np.round(ext_sales * 0.05, 2), None)
+            elif c == "ss_net_paid_inc_tax":
+                out[c] = (np.round((ext_sales - coupon) * 1.05, 2), None)
             else:
                 raise KeyError(c)
         return out
@@ -288,7 +332,7 @@ class _Gen:
                 # 1900-01-01 was a Monday
                 out[c] = ((days % 7).astype(np.int32), _DAY_NAMES)
             else:
-                raise KeyError(c)
+                out[c] = self.ext_column("date_dim", c, key)
         return out
 
     # ---- item ----
@@ -316,7 +360,7 @@ class _Gen:
             elif c == "i_current_price":
                 out[c] = (_money(key, 225, 0.09, 99.99), None)
             else:
-                raise KeyError(c)
+                out[c] = self.ext_column("item", c, key)
         return out
 
     # ---- store ----
@@ -356,7 +400,7 @@ class _Gen:
                 out[c] = (np.where(_h(key, 237) % _U64(2) == 0,
                                    -5.0, -6.0), None)
             else:
-                raise KeyError(c)
+                out[c] = self.ext_column("store", c, key)
         return out
 
     # ---- customer_demographics (exact cross-product, spec encoding) ----
@@ -433,7 +477,7 @@ class _Gen:
                 out[c] = (_randint(key, 247, 1924, 1992).astype(np.int32),
                           None)
             else:
-                raise KeyError(c)
+                out[c] = self.ext_column("customer", c, key)
         return out
 
     # ---- customer_address ----
@@ -465,7 +509,7 @@ class _Gen:
                 out[c] = (np.where(_h(key, 255) % _U64(2) == 0,
                                    -5.0, -6.0), None)
             else:
-                raise KeyError(c)
+                out[c] = self.ext_column("customer_address", c, key)
         return out
 
     # ---- household_demographics (cross-product, spec encoding) ----
@@ -595,6 +639,7 @@ class _Metadata(ConnectorMetadata):
         "household_demographics": ("hd_demo_sk",),
         "promotion": ("p_promo_sk",),
         "time_dim": ("t_time_sk",),
+        **EXT_PRIMARY_KEYS,
     }
 
     def table_stats(self, table: TableHandle) -> TableStats:

@@ -24,19 +24,43 @@ class QueryCompletedEvent:
     create_time: float = 0.0    # epoch seconds
 
 
+@dataclasses.dataclass(frozen=True)
+class SplitCompletedEvent:
+    """Per-split completion (reference event/SplitMonitor.java +
+    spi/eventlistener/SplitCompletedEvent.java)."""
+    query_id: str
+    table: str
+    split: int
+    wall_ms: float
+    batches: int
+
+
 class EventListenerManager:
     def __init__(self) -> None:
         self._listeners: List[Callable[[QueryCompletedEvent], None]] = []
+        self._split_listeners: List[
+            Callable[[SplitCompletedEvent], None]] = []
 
     def register(self,
                  listener: Callable[[QueryCompletedEvent], None]) -> None:
         self._listeners.append(listener)
+
+    def register_split_listener(
+            self, listener: Callable[[SplitCompletedEvent], None]) -> None:
+        self._split_listeners.append(listener)
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
         for listener in self._listeners:
             try:
                 listener(event)
             except Exception:   # listeners must not break queries
+                pass
+
+    def split_completed(self, event: SplitCompletedEvent) -> None:
+        for listener in self._split_listeners:
+            try:
+                listener(event)
+            except Exception:
                 pass
 
 

@@ -438,14 +438,28 @@ class _Executor:
         if lifespan is not None:
             # grouped execution: only this bucket's splits this pass
             splits = lifespan
+        import time as _time
+        t_query0 = _time.perf_counter()
+
+        def record_split(i: int, t0: float, batches: int) -> None:
+            # per-split completion record (reference event/SplitMonitor)
+            if self.stats is not None:
+                self.stats.record_split(
+                    node.table.table, i, t0 - t_query0,
+                    _time.perf_counter() - t0, batches)
+
         if n_threads <= 1 or len(splits) <= 1:
-            for split in splits:
+            for i, split in enumerate(splits):
+                t0 = _time.perf_counter()
+                nb = 0
                 src = conn.page_source(split, list(node.columns),
                                        pushdown=current_pushdown(),
                                        rows_per_batch=self.rows_per_batch)
                 for b in src.batches():
                     self._check_cancel()
+                    nb += 1
                     yield b
+                record_split(i, t0, nb)
             return
 
         DONE = object()
@@ -473,13 +487,17 @@ class _Executor:
                 except _queue.Empty:
                     return
                 try:
+                    t0 = _time.perf_counter()
+                    nb = 0
                     src = conn.page_source(
                         splits[i], list(node.columns),
                         pushdown=current_pushdown(),
                         rows_per_batch=self.rows_per_batch)
                     for b in src.batches():
+                        nb += 1
                         if not put(queues[i], b):
                             return
+                    record_split(i, t0, nb)
                 except BaseException as e:  # surfaced on the consumer side
                     put(queues[i], e)
                     return

@@ -52,6 +52,8 @@ class LocalRunner:
         self.rows_per_batch = rows_per_batch
         self.query_log = catalogs.get("system").query_log
         self._query_seq = 0
+        #: query id -> live StatsCollector (the /v1/query/{id} surface)
+        self.live_stats: Dict[str, object] = {}
         import threading
         self._state_lock = threading.Lock()
 
@@ -68,17 +70,31 @@ class LocalRunner:
         import time as _time
         from ..connectors.system import QueryLogEntry
         from ..events import completed_event
+        from ..exec.stats import StatsCollector
+        from ..events import SplitCompletedEvent
         stmt = parse_statement(sql)
         with self._state_lock:
             self._query_seq += 1
             qid = f"q_{self._query_seq:06d}"
             entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0)
             self.query_log.append(entry)
+            # live per-query stats (wall/batches per node + split events),
+            # served by GET /v1/query/{id} while the query runs
+            # (reference server/QueryResource.java live stage stats)
+            stats = StatsCollector(count_rows=False)
+            self.live_stats[qid] = stats
+            if len(self.live_stats) > 200:
+                running = {e.query_id for e in self.query_log
+                           if e.state == "RUNNING"}
+                for old in list(self.live_stats)[:-100]:
+                    if old not in running:   # keep live queries visible
+                        del self.live_stats[old]
         t0 = _time.perf_counter()
         error: Optional[str] = None
         try:
             out = self._execute_stmt(stmt, properties, user,
-                                     cancel_event=cancel_event)
+                                     cancel_event=cancel_event,
+                                     stats=stats)
             entry.state = "FINISHED"
             return out
         except Exception as e:
@@ -90,6 +106,10 @@ class LocalRunner:
             with self._state_lock:
                 if len(self.query_log) > 1000:
                     del self.query_log[:-500]
+            for s in stats.splits:
+                self.events.split_completed(SplitCompletedEvent(
+                    qid, s["table"], s["split"], s["wallMs"],
+                    s["batches"]))
             self.events.query_completed(completed_event(
                 qid, sql.strip(), user, entry.state, t0, error))
 
@@ -103,7 +123,8 @@ class LocalRunner:
     # -- statement dispatch ---------------------------------------------------
     def _execute_stmt(self, stmt: A.Node,
                       properties: Optional[Dict[str, object]] = None,
-                      user: str = "", cancel_event=None) -> QueryResult:
+                      user: str = "", cancel_event=None,
+                      stats=None) -> QueryResult:
         import dataclasses as _dc
         session = self.session
         secured = bool(self.access_control.catalog_rules)
@@ -122,6 +143,7 @@ class LocalRunner:
                 self._check_select_privileges(plan, user)
             try:
                 return execute_plan(plan, session, self.rows_per_batch,
+                                    stats=stats,
                                     cancel_event=cancel_event)
             finally:
                 if session is not self.session:

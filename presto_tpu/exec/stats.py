@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 
 @dataclasses.dataclass
@@ -37,6 +37,34 @@ class StatsCollector:
         self.by_node: Dict[object, NodeStats] = {}
         self.total_wall_s: float = 0.0
         self.planning_s: float = 0.0
+        #: per-split completion records from table scans (the reference's
+        #: event/SplitMonitor.java split-completion events): dicts with
+        #: table, split, wall_ms, batches, started_at
+        self.splits: List[Dict] = []
+
+    def record_split(self, table: str, split_no: int, started_at: float,
+                     wall_s: float, batches: int) -> None:
+        self.splits.append({
+            "table": table, "split": split_no,
+            "startMs": round(started_at * 1e3, 1),
+            "wallMs": round(wall_s * 1e3, 1), "batches": batches})
+
+    def snapshot(self) -> List[Dict]:
+        """JSON-able per-node stats, root-last plan order — the live
+        per-stage surface behind GET /v1/query/{id} (reference
+        server/QueryResource.java per-stage stats)."""
+        out = []
+        # copy: the executor thread grows by_node while the live REST
+        # endpoint snapshots it
+        for node, st in list(self.by_node.items()):
+            out.append({
+                "node": type(node).__name__.replace("Node", ""),
+                "wallMs": round(st.wall_s * 1e3, 1),
+                "batches": st.batches,
+                "rows": st.rows if self.count_rows else None,
+                "capacity": st.capacity,
+            })
+        return out
 
     def stats_for(self, node) -> Optional[NodeStats]:
         return self.by_node.get(node)

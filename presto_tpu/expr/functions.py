@@ -246,6 +246,34 @@ def cast_val(v: Val, to: Type) -> Val:
         return Val(data.astype(jnp.int64) * 86_400_000_000, v.valid, to)
     if isinstance(to, T.DateType) and isinstance(f, T.TimestampType):
         return Val((data // 86_400_000_000).astype(jnp.int32), v.valid, to)
+    if isinstance(to, T.DateType) and f.is_string \
+            and isinstance(v.dictionary, tuple):
+        # dictionary-string -> date: parse each distinct VALUE host-side
+        # (the vocabulary is static at trace time), then one device
+        # gather maps codes to epoch days. Unparseable values raise the
+        # row-error channel like the reference's failing DATE cast
+        # (reference operator/scalar/DateTimeFunctions castToDate).
+        import datetime as _dt
+        from ..errors import INVALID_FUNCTION_ARGUMENT
+        days, ok = [], []
+        for s in v.dictionary:
+            try:
+                days.append((_dt.date.fromisoformat(s.strip())
+                             - _dt.date(1970, 1, 1)).days)
+                ok.append(True)
+            except ValueError:
+                days.append(0)
+                ok.append(False)
+        table = jnp.asarray(days + [0], dtype=jnp.int32)
+        okt = jnp.asarray(ok + [False])
+        codes = jnp.clip(data.astype(jnp.int32), 0, len(days))
+        parsed_ok = jnp.take(okt, codes, axis=0)
+        err = jnp.where(v.valid & ~parsed_ok,
+                        jnp.int32(INVALID_FUNCTION_ARGUMENT),
+                        jnp.int32(0))
+        return Val(jnp.take(table, codes, axis=0),
+                   v.valid & parsed_ok, to,
+                   err=merge_err(v.err, err))
     raise NotImplementedError(f"cast {f.display()} -> {to.display()}")
 
 

@@ -468,11 +468,12 @@ class _Planner:
         else:
             replacements = {}
         if window_calls:
-            if agg_calls or spec.group_by:
-                raise AnalysisError(
-                    "window functions over aggregated queries are not "
-                    "supported yet")
-            node, win_repl = self._plan_windows(node, scope, window_calls)
+            # windows over aggregated queries evaluate AFTER grouping
+            # (reference QueryPlanner.window over the aggregation plan):
+            # the agg replacements map sum(x)-style window inputs to the
+            # aggregation's output columns
+            node, win_repl = self._plan_windows(node, scope, window_calls,
+                                                replacements)
             scope = Scope(node.fields)
             replacements.update(win_repl)
 
@@ -1078,17 +1079,21 @@ class _Planner:
 
     # -- windows --------------------------------------------------------------
     def _plan_windows(self, node: PlanNode, scope: Scope,
-                      window_calls: List[A.WindowFunction]):
+                      window_calls: List[A.WindowFunction],
+                      agg_replacements: Optional[Dict] = None):
         """One WindowNode per distinct (PARTITION BY, ORDER BY) window;
         shared windows evaluate together (reference plan/WindowNode.java
-        groups functions under one window)."""
+        groups functions under one window). ``agg_replacements`` resolves
+        group-aggregate subexpressions inside window specs against the
+        aggregation output (windows over aggregated queries)."""
         from .plan import WindowFnSpec, WindowNode
         replacements: Dict[A.Expression, ir.Expr] = {}
         groups: Dict[Tuple, List[A.WindowFunction]] = {}
         for w in window_calls:
             groups.setdefault((w.partition_by, w.order_by), []).append(w)
         for (partition_by, order_by), wins in groups.items():
-            analyzer = ExpressionAnalyzer(Scope(node.fields))
+            analyzer = ExpressionAnalyzer(Scope(node.fields),
+                                          agg_replacements or {})
             base = len(node.fields)
             extra_exprs: List[ir.Expr] = []
             extra_fields: List[Field] = []
@@ -1406,7 +1411,13 @@ def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:
 
     def visit(n):
         if isinstance(n, A.WindowFunction):
-            return True  # sum(x) OVER (...) is a window, not a group agg
+            # the window call itself is not a group agg, but group aggs
+            # may appear INSIDE it: avg(sum(x)) over (order by sum(y))
+            # runs sum() in GROUP BY and avg() over the grouped rows
+            # (reference AggregationAnalyzer's windowed-aggregate rules)
+            _walk_ast(list(n.call.args) + list(n.partition_by)
+                      + [s.key for s in n.order_by], visit)
+            return True
         if isinstance(n, A.FunctionCall):
             fn = _FUNCTION_ALIASES.get(n.name, n.name)
             if fn in AGGREGATE_FUNCTIONS or n.is_star and fn == "count":

@@ -212,7 +212,10 @@ _UI_HTML = """<!doctype html>
 <div class="muted" id="meta"></div>
 <table><thead><tr><th>id</th><th>state</th><th>elapsed</th><th>query</th>
 </tr></thead><tbody id="rows"></tbody></table>
+<h1 id="dtitle" style="display:none">detail</h1>
+<div id="detail"></div>
 <script>
+function esc(s){return s.replace(/&/g,'&amp;').replace(/</g,'&lt;');}
 async function refresh(){
   const r = await fetch('/v1/query');
   const qs = await r.json();
@@ -220,10 +223,29 @@ async function refresh(){
     qs.length + ' queries \\u00b7 refreshed ' +
     new Date().toLocaleTimeString();
   document.getElementById('rows').innerHTML = qs.map(q =>
-    '<tr><td>'+q.queryId+'</td><td class="'+q.state+'">'+q.state+
-    '</td><td>'+q.elapsedMs+'ms</td><td class="sql">'+
-    q.query.replace(/&/g,'&amp;').replace(/</g,'&lt;')+
-    '</td></tr>').join('');
+    '<tr><td><a href="#" style="color:#8cf" onclick="show(\\''+q.queryId+
+    '\\');return false">'+q.queryId+'</a></td><td class="'+q.state+'">'+
+    q.state+'</td><td>'+q.elapsedMs+'ms</td><td class="sql">'+
+    esc(q.query)+'</td></tr>').join('');
+}
+async function show(id){
+  // per-node timeline: proportional wall-time bars + split completions
+  // (the reference webapp's stage/timeline pages)
+  const q = await (await fetch('/v1/query/'+id)).json();
+  const mx = Math.max(1, ...q.nodes.map(n=>n.wallMs));
+  document.getElementById('dtitle').style.display='block';
+  document.getElementById('dtitle').textContent =
+    id+' \\u2014 '+q.state+' ('+q.elapsedMs+'ms)';
+  document.getElementById('detail').innerHTML =
+    '<table><thead><tr><th>operator</th><th>wall</th><th>batches</th>'+
+    '<th></th></tr></thead><tbody>'+
+    q.nodes.map(n=>'<tr><td>'+esc(n.node)+'</td><td>'+n.wallMs+
+      'ms</td><td>'+n.batches+'</td><td><div style="background:#48f;'+
+      'height:.6rem;width:'+Math.round(240*n.wallMs/mx)+
+      'px"></div></td></tr>').join('')+'</tbody></table>'+
+    (q.splits.length ? '<p class="muted">'+q.splits.length+
+      ' splits: '+q.splits.map(s=>esc(s.table)+'#'+s.split+' '+
+      s.wallMs+'ms').join(' \\u00b7 ')+'</p>' : '');
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
@@ -305,6 +327,25 @@ class _Handler(BaseHTTPRequestHandler):
                             "query": e.query,
                             "elapsedMs": round(e.elapsed_ms, 1)})
             self._reply(200, out)
+            return
+        if self.path.startswith("/v1/query/"):
+            # live per-query detail: per-node wall/batches + split
+            # timeline, updated WHILE the query runs (reference
+            # server/QueryResource.java + webapp timeline page)
+            qid = self.path[len("/v1/query/"):].strip("/")
+            entry = next((e for e in self._srv.runner.query_log
+                          if e.query_id == qid), None)
+            if entry is None:
+                self._reply(404, {"error": f"unknown query {qid!r}"})
+                return
+            stats = self._srv.runner.live_stats.get(qid)
+            doc = {"queryId": entry.query_id, "state": entry.state,
+                   "query": entry.query,
+                   "elapsedMs": round(entry.elapsed_ms, 1),
+                   "nodes": stats.snapshot() if stats is not None else [],
+                   "splits": list(stats.splits) if stats is not None
+                   else []}
+            self._reply(200, doc)
             return
         if self.path.rstrip("/") in ("/ui", ""):
             body = _UI_HTML.encode()

@@ -723,10 +723,28 @@ class _Parser:
 
     def _primary_relation(self) -> A.Relation:
         if self.accept_op("("):
-            if self.at_kw("select", "with", "values") or self.at_op("("):
-                q = self.query()
-                self.expect_op(")")
-                return A.SubqueryRelation(q)
+            # disambiguate subquery vs parenthesized join tree (the
+            # reference grammar's aliasedRelation '(' relation ')' branch
+            # vs subquery, SqlBase.g4). A leading SELECT usually means a
+            # subquery, but '((select ...) t JOIN ...)' is a relation —
+            # try the query parse and backtrack if the close paren
+            # doesn't follow.
+            j = 0
+            while self.peek(j).kind == "OP" and self.peek(j).text == "(":
+                j += 1
+            t = self.peek(j)
+            starts_query = (t.kind == "KEYWORD"
+                            and t.text in ("select", "with", "values"))
+            if self.at_kw("select", "with", "values") or starts_query:
+                mark = self.i
+                try:
+                    q = self.query()
+                    if self.at_op(")"):
+                        self.next()
+                        return A.SubqueryRelation(q)
+                except SqlSyntaxError:
+                    pass
+                self.i = mark            # a join tree follows: relation
             rel = self._relation()
             self.expect_op(")")
             return rel

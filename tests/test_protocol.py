@@ -146,3 +146,53 @@ def test_cancel():
         assert q.state == "FAILED"       # completion must not overwrite
     finally:
         srv.stop()
+
+
+def test_query_detail_stats_endpoint():
+    """GET /v1/query/{id} returns per-node wall/batches and split events
+    (reference server/QueryResource.java + event/SplitMonitor.java)."""
+    import json
+    import urllib.request
+
+    from presto_tpu.exec.runner import LocalRunner
+    from presto_tpu.server.protocol import StatementServer
+
+    srv = StatementServer(LocalRunner(tpch_sf=0.001))
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/statement",
+            data=b"select count(*) from lineitem where l_quantity > 10")
+        doc = json.loads(urllib.request.urlopen(req).read())
+        while "nextUri" in doc:
+            doc = json.loads(urllib.request.urlopen(doc["nextUri"]).read())
+        qs = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/query").read())
+        qid = qs[0]["queryId"]
+        detail = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/query/{qid}").read())
+        assert detail["state"] == "FINISHED"
+        names = [n["node"] for n in detail["nodes"]]
+        assert "TableScan" in names
+        scan = next(n for n in detail["nodes"] if n["node"] == "TableScan")
+        assert scan["batches"] >= 1 and scan["wallMs"] >= 0
+        assert detail["splits"] and detail["splits"][0]["table"] == "lineitem"
+        missing = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/query/nope")
+        try:
+            urllib.request.urlopen(missing)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
+
+
+def test_split_completed_events():
+    from presto_tpu.exec.runner import LocalRunner
+
+    r = LocalRunner(tpch_sf=0.001)
+    seen = []
+    r.events.register_split_listener(seen.append)
+    r.execute("select count(*) from orders")
+    assert seen and seen[0].table == "orders" and seen[0].batches >= 1

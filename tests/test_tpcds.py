@@ -240,3 +240,62 @@ from tpcds_queries import Q as TPCDS_QUERIES
     TPCDS_QUERIES, ids=[t[0] for t in TPCDS_QUERIES])
 def test_tpcds_query(runner, oracle, name, sql, oracle_sql):
     compare(runner, oracle, sql, oracle_sql)
+
+
+def test_extension_tables_against_oracle():
+    """The extension tables (catalog/web channels, returns, inventory,
+    small dims) agree with a SQLite oracle over the same generated data
+    (same contract as the base suite; reference AbstractTestQueries per
+    connector)."""
+    import sqlite3
+
+    from presto_tpu.exec.runner import LocalRunner
+
+    r = LocalRunner(catalog="tpcds", tpch_sf=0.001)
+    conn = r.session.catalogs.get("tpcds")
+    db = sqlite3.connect(":memory:")
+    for table, cols in (
+            ("catalog_sales", ["cs_item_sk", "cs_sold_date_sk",
+                               "cs_quantity", "cs_ext_sales_price",
+                               "cs_net_profit", "cs_order_number"]),
+            ("web_sales", ["ws_item_sk", "ws_ext_sales_price",
+                           "ws_web_site_sk", "ws_order_number"]),
+            ("store_returns", ["sr_item_sk", "sr_return_amt",
+                               "sr_ticket_number", "sr_return_quantity"]),
+            ("inventory", ["inv_item_sk", "inv_warehouse_sk",
+                           "inv_quantity_on_hand"]),
+            ("warehouse", ["w_warehouse_sk", "w_warehouse_name",
+                           "w_state"]),
+            ("income_band", ["ib_income_band_sk", "ib_lower_bound",
+                             "ib_upper_bound"])):
+        from presto_tpu.connectors.spi import TableHandle
+        th = TableHandle("tpcds", "default", table)
+        rows = []
+        for split in conn.split_manager.splits(th, 1):
+            for b in conn.page_source(split, cols).batches():
+                rows.extend(b.to_pylist())
+        db.execute(f"create table {table} ({', '.join(cols)})")
+        db.executemany(
+            f"insert into {table} values ({', '.join('?' * len(cols))})",
+            [tuple(v.item() if hasattr(v, "item") else v for v in row)
+             for row in rows])
+    db.commit()
+
+    checks = [
+        ("select count(*), sum(cs_quantity), round(sum(cs_ext_sales_price), 2) from catalog_sales",),
+        ("select count(*) from catalog_sales cs join store_returns sr on cs_item_sk = sr_item_sk and cs_order_number = sr_ticket_number",),
+        ("select w_state, sum(inv_quantity_on_hand) from inventory join warehouse on inv_warehouse_sk = w_warehouse_sk group by w_state order by 1",),
+        ("select ib_income_band_sk from income_band where ib_lower_bound >= 20000 and ib_upper_bound <= 60000 order by 1",),
+        ("select count(distinct ws_order_number) from web_sales where ws_ext_sales_price > 500",),
+    ]
+    for (sql,) in checks:
+        got = [tuple(x.item() if hasattr(x, "item") else x for x in row)
+               for row in r.execute(sql).rows]
+        want = [tuple(row) for row in db.execute(sql).fetchall()]
+        assert len(got) == len(want), (sql, got, want)
+        for g, w in zip(got, want):
+            for gv, wv in zip(g, w):
+                if isinstance(gv, float):
+                    assert abs(gv - wv) <= 1e-6 * max(abs(wv), 1.0), (sql, g, w)
+                else:
+                    assert gv == wv, (sql, g, w)
