@@ -240,7 +240,8 @@ def cast_val(v: Val, to: Type) -> Val:
             return Val(data.astype(to.storage_dtype), v.valid, to)
     if isinstance(to, T.BooleanType) and T.is_numeric(f):
         return Val(data != 0, v.valid, to)
-    if isinstance(to, T.VarcharType) and f.is_string:
+    if isinstance(to, T.VarcharType) and f.is_string \
+            and not isinstance(f, T.VarbinaryType):
         return Val(data, v.valid, to, v.dictionary)
     if isinstance(to, T.TimestampType) and isinstance(f, T.DateType):
         return Val(data.astype(jnp.int64) * 86_400_000_000, v.valid, to)
@@ -258,10 +259,13 @@ def cast_val(v: Val, to: Type) -> Val:
         days, ok = [], []
         for s in v.dictionary:
             try:
-                days.append((_dt.date.fromisoformat(s.strip())
+                # lenient y-m-d split like the reference's date parse:
+                # '2002-2-01' is a valid DATE literal (unpadded fields)
+                y, m, d = (int(p) for p in s.strip().split("-"))
+                days.append((_dt.date(y, m, d)
                              - _dt.date(1970, 1, 1)).days)
                 ok.append(True)
-            except ValueError:
+            except (ValueError, TypeError):
                 days.append(0)
                 ok.append(False)
         table = jnp.asarray(days + [0], dtype=jnp.int32)
@@ -463,28 +467,26 @@ def _long_decimal_arith(op: str, a: Val, b: Val, out, valid) -> Val:
         res, orr = I.rescale(prod, s_out - (sa + sb))
         fits = I.fits_decimal(res, out.precision) & ~(oa | ob | om | orr)
     elif op == "div":
-        # the short-division kernel needs |unscaled divisor| < 2^31:
-        # any <= 9-digit decimal or sub-bigint integer qualifies, as
-        # does a compile-time constant that happens to fit
+        # general int128/int128 division (float-estimate + exact
+        # correction, ops/int128.py divmod_abs); the base-2^32 short
+        # kernel stays for small divisors where it's cheaper
+        num, on = _dec_limbs(a, s_out + sb)
         small_type = (isinstance(b.type, T.DecimalType)
                       and not b.type.is_long and b.type.precision <= 9) \
             or (T.is_integral(b.type)
                 and not isinstance(b.type, T.BigintType))
-        small_literal = False
-        if b.literal is not None and not _is_long_dec(b.type):
-            unscaled = (b.type.to_storage(b.literal)
-                        if isinstance(b.type, T.DecimalType)
-                        else int(b.literal))
-            small_literal = abs(unscaled) <= 2 ** 31
-        if not (small_type or small_literal):
-            raise NotImplementedError(
-                "long decimal division needs a divisor with unscaled "
-                "value under 2^31 (cast the divisor down or use DOUBLE)")
-        num, on = _dec_limbs(a, s_out + sb)
-        db = b.data.astype(jnp.int64)
-        zero = db == 0
-        q = I.div_round_half_up(num, jnp.abs(jnp.where(zero, 1, db)))
-        q = I.where(db < 0, I.neg(q), q)
+        if small_type:
+            db = b.data.astype(jnp.int64)
+            zero = db == 0
+            q = I.div_round_half_up(num, jnp.abs(jnp.where(zero, 1, db)))
+            q = I.where(db < 0, I.neg(q), q)
+        else:
+            den, od = _dec_limbs(b, sb)
+            on = on | od
+            zero = I.is_zero(den)
+            safe = I.where(zero, I.from_i64(
+                jnp.ones(num.shape[:-1], dtype=jnp.int64)), den)
+            q = I.div_round_half_up_wide(num, safe)
         err = flag_err(valid & zero, E.DIVISION_BY_ZERO)
         fits = I.fits_decimal(q, out.precision) & ~on & ~zero
         err = err | flag_err(valid & ~zero & ~fits,
@@ -847,6 +849,13 @@ def _vocab_transform(fn):
 
 
 register("lower")(_vocab_transform(lambda s: s.lower()))
+# varbinary bridge (reference operator/scalar/VarbinaryFunctions.java):
+# the dictionary plan carries bytes vocabularies the same way as strings
+register("to_utf8")(_vocab_transform(
+    lambda s: s.encode("utf-8") if isinstance(s, str) else s))
+register("from_utf8")(_vocab_transform(
+    lambda s: s.decode("utf-8", "replace")
+    if isinstance(s, (bytes, bytearray)) else s))
 register("upper")(_vocab_transform(lambda s: s.upper()))
 register("trim")(_vocab_transform(lambda s: s.strip()))
 # SQL substr is 1-based
@@ -1565,6 +1574,10 @@ def infer_call_type(name: str, arg_types: List[Type]) -> Type:
         return T.VARCHAR
     if name == "length":
         return T.BIGINT
+    if name == "to_utf8":
+        return T.VARBINARY
+    if name == "from_utf8":
+        return T.VARCHAR
     if name in _EXTERNAL_SIGNATURES:
         return _EXTERNAL_SIGNATURES[name](list(arg_types))
     raise KeyError(f"unknown function {name!r}")

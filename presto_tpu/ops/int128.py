@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -252,6 +253,61 @@ def div_round_half_up(x: jnp.ndarray, d) -> jnp.ndarray:
     return jnp.where(neg_in[..., None], neg(q), q)
 
 
+# -- wide division (int128 / int128) -----------------------------------------
+
+def divmod_abs(x: jnp.ndarray, d: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Nonnegative x divided by positive d, BOTH int128 limb tiles:
+    (quotient, remainder). Float-estimated quotient with exact integer
+    correction — each round's floor(to_f64(r)/to_f64(d)) estimate is
+    exact-rational to ~2^-52 relative (better on TPU's double-double),
+    and the exact mul/sub shrink the residual by that factor per round:
+    2^127 -> 2^75 -> 2^23 -> O(d) over three rounds, then a bounded
+    +-3d fix-up lands r in [0, d). Replaces a Knuth long division whose
+    per-digit carries would need 96-bit intermediates (reference
+    UnscaledDecimal128Arithmetic.divide works digitwise in Java)."""
+    df = jnp.maximum(to_f64(d), 1.0)
+    one = from_i64(jnp.ones(x.shape[:-1], dtype=jnp.int64))
+
+    # lax loops, not Python unrolling: the unrolled 4x estimate/correct
+    # chain sends XLA's algebraic simplifier into its circular-
+    # simplification bailout and (observed under
+    # --xla_force_host_platform_device_count) miscompiles the arithmetic;
+    # a fori_loop body compiles once and stays out of that path
+    def estimate(_, qr):
+        q, r = qr
+        e128 = from_f64(jnp.floor(to_f64(r) / df))
+        prod, _ = mul(e128, d)
+        return add(q, e128), sub(r, prod)
+
+    q, r = jax.lax.fori_loop(0, 4, estimate, (jnp.zeros_like(x), x))
+
+    def fixup(_, qr):
+        q, r = qr
+        neg_r = is_neg(r)
+        q = where(neg_r, sub(q, one), q)
+        r = where(neg_r, add(r, d), r)
+        ge = le(d, r) & ~is_neg(r)
+        q = where(ge, add(q, one), q)
+        r = where(ge, sub(r, d), r)
+        return q, r
+
+    return jax.lax.fori_loop(0, 3, fixup, (q, r))
+
+
+def div_round_half_up_wide(x: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """Signed int128 x / int128 d (|d| >= 1), rounding half up away from
+    zero — the general long-decimal division kernel."""
+    neg_out = is_neg(x) ^ is_neg(d)
+    da = abs_(d)
+    q, r = divmod_abs(abs_(x), da)
+    # 2r >= d without overflowing: r >= d - r
+    bump = le(sub(da, r), r)
+    q = where(bump, add(q, from_i64(
+        jnp.ones(q.shape[:-1], dtype=jnp.int64))), q)
+    return where(neg_out, neg(q), q)
+
+
 # -- base-10 rescale --------------------------------------------------------
 
 _P9 = 10 ** 9
@@ -288,14 +344,25 @@ def rescale(x: jnp.ndarray, delta: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
 # -- float conversion -------------------------------------------------------
 
 def to_f64(x: jnp.ndarray) -> jnp.ndarray:
-    lo_u = (lo(x) ^ SIGN64).astype(jnp.float64) + jnp.float64(2.0 ** 63)
-    return hi(x).astype(jnp.float64) * jnp.float64(2.0 ** 64) + lo_u
+    # lo as two 32-bit halves: the obvious (lo ^ SIGN64) + 2^63 form
+    # catastrophically cancels for small magnitudes (4 - 2^63 rounds to
+    # -2^63 exactly at f64 precision, so adding 2^63 back returns 0)
+    l = lo(x)
+    lo_low = (l & MASK32).astype(jnp.float64)
+    lo_high = ((l >> 32) & MASK32).astype(jnp.float64) * jnp.float64(2.0 ** 32)
+    return (hi(x).astype(jnp.float64) * jnp.float64(2.0 ** 64)
+            + lo_high + lo_low)
 
 
 def from_f64(v: jnp.ndarray) -> jnp.ndarray:
     """Round-to-nearest f64 -> int128 (|v| must be < 2**127; f64 only
     carries 53 significant bits, so low bits of huge values are zeros)."""
     v = jnp.round(v)
+    # small magnitudes convert exactly through one i64 cast — the limb
+    # split below goes through frac = v + 2**64 for negative v, whose
+    # ulp (4096) would wipe the low bits (-2357 became -2048)
+    small = jnp.abs(v) < 2.0 ** 62
+    direct = from_i64(jnp.where(small, v, 0.0).astype(jnp.int64))
     h = jnp.floor(v / (2.0 ** 64))
     frac = v - h * (2.0 ** 64)
     # the quotient rounds, so frac can fall outside [0, 2^64) by an ulp
@@ -305,7 +372,7 @@ def from_f64(v: jnp.ndarray) -> jnp.ndarray:
                      jnp.where(frac >= 2.0 ** 64, frac - 2.0 ** 64, frac))
     l_signed = jnp.where(frac >= 2.0 ** 63,
                          frac - 2.0 ** 64, frac).astype(jnp.int64)
-    return pack(h.astype(jnp.int64), l_signed)
+    return where(small, direct, pack(h.astype(jnp.int64), l_signed))
 
 
 # -- exact row sums via digit decomposition ---------------------------------

@@ -1260,6 +1260,19 @@ class _Planner:
             elif k in by_ast:
                 idx = by_ast[k]
             else:
+                # SQL lets ORDER BY expressions reference SELECT aliases
+                # (reference StatementAnalyzer orderBy scope): substitute
+                # alias identifiers with their select expressions before
+                # analyzing (q36-style 'case when lochierarchy = 0 ...');
+                # source columns of the same name take precedence
+                def resolves_in_input(name: str) -> bool:
+                    try:
+                        analyzer.scope.resolve(name)
+                        return True
+                    except Exception:
+                        return False
+                k = _subst_select_aliases(k, by_alias, select_items,
+                                          resolves_in_input)
                 e = analyzer.analyze(k)
                 if isinstance(e, ir.InputRef) and isinstance(
                         project, ProjectNode):
@@ -1404,6 +1417,45 @@ def _walk_ast(exprs: Sequence[A.Expression], visit) -> None:
     for e in exprs:
         if e is not None:
             walk(e)
+
+
+def _subst_select_aliases(k, by_alias, select_items, resolves_in_input):
+    """Replace SELECT-alias identifiers inside an expression with their
+    select expressions (no descent into subquery bodies). SQL scoping:
+    a source column of the same name WINS over the alias (the reference
+    resolves ORDER BY expression identifiers against the source relation
+    first), so only identifiers that do NOT resolve in the input scope
+    substitute. Dereference member names (x.field) are not free
+    identifiers and never substitute."""
+    def sub(n):
+        if isinstance(n, (A.ScalarSubquery, A.InSubquery, A.Exists)):
+            return n
+        if isinstance(n, A.Identifier) and n.name in by_alias \
+                and not resolves_in_input(n.name):
+            return select_items[by_alias[n.name]].value
+        if isinstance(n, A.DereferenceExpression):
+            if isinstance(n.base, A.Identifier):
+                return n      # qualified column ref: both parts are names
+            base = sub(n.base)
+            return (dataclasses.replace(n, base=base)
+                    if base is not n.base else n)
+        if dataclasses.is_dataclass(n) and not isinstance(n, type):
+            changed = {}
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if isinstance(v, tuple):
+                    nv = tuple(sub(x) if dataclasses.is_dataclass(x)
+                               and not isinstance(x, type) else x
+                               for x in v)
+                    if nv != v:
+                        changed[f.name] = nv
+                elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+                    nv = sub(v)
+                    if nv is not v:
+                        changed[f.name] = nv
+            return dataclasses.replace(n, **changed) if changed else n
+        return n
+    return sub(k)
 
 
 def _collect_aggs(exprs: Sequence[A.Expression]) -> List[A.FunctionCall]:

@@ -93,6 +93,18 @@ class Scope:
             i for i, f in enumerate(self.fields)
             if f.name == name and (qualifier is None or f.relation == qualifier)
         ]
+        if not matches:
+            # identifiers match case-insensitively (the reference engine
+            # lowercases unquoted identifiers and resolves quoted ones
+            # case-insensitively too — its own TPC-DS SQL aliases "YEAR"
+            # and references "year")
+            low = name.lower()
+            lq = qualifier.lower() if qualifier else None
+            matches = [
+                i for i, f in enumerate(self.fields)
+                if f.name.lower() == low
+                and (lq is None or (f.relation or "").lower() == lq)
+            ]
         if len(matches) == 1:
             return matches[0]
         if len(matches) > 1:

@@ -1177,7 +1177,10 @@ class _Parser:
 
     def _postfix(self, e: A.Expression) -> A.Expression:
         while True:
-            if self.at_op(".") and self.peek(1).kind in ("IDENT", "QIDENT"):
+            if self.at_op(".") and (
+                    self.peek(1).kind in ("IDENT", "QIDENT")
+                    or (self.peek(1).kind == "KEYWORD"
+                        and self.peek(1).text in NON_RESERVED)):
                 self.next()
                 e = A.DereferenceExpression(e, A.Identifier(self.identifier()))
                 continue

@@ -333,6 +333,30 @@ class CharType(Type):
 
 
 @dataclasses.dataclass(frozen=True)
+class VarbinaryType(Type):
+    """Binary strings, dictionary-encoded like varchar: int32 codes into
+    a host-side vocabulary of bytes values (reference
+    spi/type/VarbinaryType.java; the device representation reuses the
+    string plan — binary payloads are metadata-heavy, compute-light)."""
+
+    name: ClassVar[str] = "varbinary"
+
+    @property
+    def storage_dtype(self):
+        return jnp.int32
+
+    @property
+    def is_string(self) -> bool:
+        return True
+
+    def display(self) -> str:
+        return "varbinary"
+
+    def null_storage(self):
+        return -1
+
+
+@dataclasses.dataclass(frozen=True)
 class ArrayType(Type):
     """ARRAY(T): padded dense device representation (reference
     spi/type/ArrayType.java + block/ArrayBlock.java's offsets+values,
@@ -471,6 +495,7 @@ REAL = RealType()
 DATE = DateType()
 TIMESTAMP = TimestampType()
 VARCHAR = VarcharType()
+VARBINARY = VarbinaryType()
 UNKNOWN = UnknownType()
 
 
@@ -547,6 +572,12 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         e = common_super_type(a.element, b.element)
         return ArrayType(e) if e is not None else None
     if a.is_string and b.is_string:
+        # varbinary never unifies with character strings (the reference
+        # rejects varchar<->varbinary comparison/coercion at analysis)
+        if isinstance(a, VarbinaryType) != isinstance(b, VarbinaryType):
+            return None
+        if isinstance(a, VarbinaryType):
+            return VARBINARY
         return VARCHAR
     if isinstance(a, DateType) and isinstance(b, TimestampType):
         return TIMESTAMP
@@ -601,6 +632,7 @@ def parse_type(text: str) -> Type:
         "date": DATE,
         "timestamp": TIMESTAMP,
         "varchar": VARCHAR,
+        "varbinary": VARBINARY,
         "unknown": UNKNOWN,
     }
     if s in simple:

@@ -322,3 +322,42 @@ def test_oracle_random_sums(runner):
         assert rows[0][0] == want_sum, (rows[0][0], want_sum)
         assert rows[0][1] == min(vals).quantize(Decimal("0.01"))
         assert rows[0][2] == max(vals).quantize(Decimal("0.01"))
+
+
+def test_wide_division_exact(runner):
+    """General int128 division (float-estimate + exact correction,
+    ops/int128.py divmod_abs) against python Decimal, including the
+    small-divisor and small-value shapes that exposed the to_f64/
+    from_f64 precision bugs."""
+    import decimal as _d
+    from decimal import ROUND_HALF_UP
+
+    _d.getcontext().prec = 60
+    cases = [
+        ("12345678901234567890123456.78", "decimal(38,2)",
+         "987654321098765.4", "decimal(16,1)"),
+        ("99999999999999999999.99", "decimal(22,2)", "-3.7",
+         "decimal(16,1)"),
+        ("9955911909542365299945990106.63", "decimal(38,2)", "3.00",
+         "decimal(18,2)"),
+        ("0.04", "decimal(38,2)", "400000000000000000.0",
+         "decimal(19,1)"),
+    ]
+    for a, ta, b, tb in cases:
+        got = runner.execute(
+            f"select cast('{a}' as {ta}) / cast('{b}' as {tb})"
+        ).rows[0][0]
+        scale = -got.as_tuple().exponent
+        want = (Decimal(a) / Decimal(b)).quantize(
+            Decimal(1).scaleb(-scale), rounding=ROUND_HALF_UP)
+        assert got == want, (a, b, got, want)
+
+
+def test_long_decimal_to_double_small_values(runner):
+    """cast(decimal(38,s) as double) of SMALL magnitudes: the old
+    to_f64 catastrophically cancelled (4.00 came back 0.0)."""
+    rows = runner.execute(
+        "select cast(cast('4.00' as decimal(38,2)) as double), "
+        "cast(cast('-7.25' as decimal(20,2)) as double), "
+        "cast(cast('0.01' as decimal(38,2)) as double)").rows
+    assert rows[0] == (4.0, -7.25, 0.01)
