@@ -1001,16 +1001,35 @@ class _Executor:
             close_bufs()
             return None
 
-        fn = fused_pipeline(tuple(stages))
+        # split after the first join: star chains put the most selective
+        # join first (greedy join order), so compacting its output before
+        # the remaining joins shrinks their gather work by the chain's
+        # selectivity (q27: 0.1% of lanes survive the cd join, so joins
+        # 2..4 run over thousands of rows instead of 2^20). The adaptive
+        # compactor pays one liveness sync per checked batch and disables
+        # itself when the stream doesn't shrink >=4x, so non-selective
+        # chains lose only one readback.
+        first_join = next(i for i, st in enumerate(stages)
+                          if isinstance(st, JoinStage))
+        head, tail = stages[:first_join + 1], stages[first_join + 1:]
+        assert tail, "fused chains carry >= 2 joins (_try_fused_chain)"
+        fn1 = fused_pipeline(tuple(head))
+        fn2 = fused_pipeline(tuple(tail))
         preps_t, builds_t, dyns_t = tuple(preps), tuple(builds), tuple(dyns)
+        mid_compact = self._compactor()
         compact = self._compactor()
 
         def stream() -> Iterator[Batch]:
             try:
                 for probe in self.run(source):
-                    out, err = fn(probe, preps_t, builds_t, dyns_t)
+                    out, err = fn1(probe, preps_t[:1], builds_t[:1],
+                                   dyns_t[:1])
                     if err is not None:
                         self.error_flags.append(err)
+                    out, err2 = fn2(mid_compact(out), preps_t[1:],
+                                    builds_t[1:], dyns_t[1:])
+                    if err2 is not None:
+                        self.error_flags.append(err2)
                     yield compact(out)
             finally:
                 close_bufs()

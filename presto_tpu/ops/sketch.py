@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .prefix import prefix_sum
+
 #: default standard error of the reference's approx_distinct (reference
 #: ApproximateCountDistinctAggregations.java DEFAULT_STANDARD_ERROR)
 DEFAULT_STANDARD_ERROR = 0.023

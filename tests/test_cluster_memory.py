@@ -44,12 +44,20 @@ def test_heartbeat_reports_query_memory(cluster):
 
     t = threading.Thread(target=snoop, daemon=True)
     t.start()
-    runner.execute(
-        "select l_orderkey, count(*) c from lineitem "
-        "group by 1 order by c desc limit 5")
-    time.sleep(0.1)
+    # under load (xdist peers) the snoop thread can get starved past a
+    # single short query's lifetime — retry the query until a heartbeat
+    # with live reservations was observed
+    for _ in range(5):
+        runner.execute(
+            "select l_orderkey, count(*) c from lineitem "
+            "group by 1 order by c desc limit 5")
+        time.sleep(0.1)
+        if max(list(seen.values()) or [0]) > 0:
+            break
+    # snapshot: the snoop thread keeps inserting while we assert
+    peak = max(list(seen.values()) or [0])
     assert seen, "no queryMemory payload observed during execution"
-    assert max(seen.values()) > 0
+    assert peak > 0
 
 
 def test_kill_biggest_query_under_pressure(cluster):
