@@ -114,7 +114,17 @@ def main(argv=None) -> int:
                     help="password for HTTP basic authentication")
     ap.add_argument("--sf", type=float, default=0.01,
                     help="tpch scale factor for the embedded server")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="enable span tracing and write a Chrome-trace "
+                         "(chrome://tracing / Perfetto) JSON file on "
+                         "exit; in-process spans only — point a remote "
+                         "worker at the same trace with "
+                         "PRESTO_TPU_TRACE=1")
     args = ap.parse_args(argv)
+
+    if args.trace_out:
+        from .obs.trace import TRACER
+        TRACER.enable(True)
 
     embedded = None
     url = args.server
@@ -152,6 +162,12 @@ def main(argv=None) -> int:
                                   output_format=args.output_format)
         return 0
     finally:
+        if args.trace_out:
+            from .obs.trace import TRACER, write_chrome_trace
+            write_chrome_trace(args.trace_out, TRACER.export())
+            print(f"wrote trace to {args.trace_out} "
+                  "(open in chrome://tracing or ui.perfetto.dev)",
+                  file=sys.stderr)
         if embedded is not None:
             embedded.stop()
 

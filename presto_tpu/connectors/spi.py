@@ -71,6 +71,14 @@ class PageSource:
 class ConnectorMetadata:
     """Catalog surface (reference spi/connector/ConnectorMetadata.java)."""
 
+    def list_schemas(self) -> List[str]:
+        """Schemas this catalog exposes. Most connectors here flatten
+        schemas into one namespace; the default advertises just
+        "default". The planner consults this to resolve two-part names
+        the reference way (``x.y`` = schema ``x`` in the session catalog
+        when that schema exists, catalog-first only as a fallback)."""
+        return ["default"]
+
     def list_tables(self, schema: Optional[str] = None) -> List[str]:
         raise NotImplementedError
 

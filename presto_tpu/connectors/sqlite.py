@@ -49,6 +49,14 @@ def _affinity_type(decl: str) -> T.Type:
     return T.DOUBLE
 
 
+def _q(ident: str) -> str:
+    """Quote an identifier for foreign SQL, doubling embedded double
+    quotes — identifiers can't be parameterized, so this is the one
+    escaping path for table/column names in every statement this
+    connector renders (page source, stats, DDL, insert)."""
+    return '"' + str(ident).replace('"', '""') + '"'
+
+
 class _Meta(ConnectorMetadata):
     def __init__(self, conn: "SqliteConnector"):
         self._conn = conn
@@ -75,7 +83,7 @@ class _Splits(ConnectorSplitManager):
         partitioned reads; SQLite exposes a dense-ish integer rowid)."""
         db = self._conn._db()
         row = db.execute(
-            f'select min(rowid), max(rowid) from "{table.table}"'
+            f'select min(rowid), max(rowid) from {_q(table.table)}'
         ).fetchone()
         lo, hi = row if row and row[0] is not None else (None, None)
         if lo is None:
@@ -98,7 +106,7 @@ class _SqlitePageSource(PageSource):
         self._columns = list(columns)
         self._schema = schema
         self._rows_per_batch = rows_per_batch
-        sel = ", ".join(f'"{c}"' for c in self._columns) or "1"
+        sel = ", ".join(_q(c) for c in self._columns) or "1"
         where, params = [], []
         if rowid_lo is not None:
             where.append("rowid between ? and ?")
@@ -113,12 +121,12 @@ class _SqlitePageSource(PageSource):
                     or self._schema.type_of(name).is_string:
                 continue
             if lo is not None:
-                where.append(f'"{name}" >= ?')
+                where.append(f'{_q(name)} >= ?')
                 params.append(lo)
             if hi is not None:
-                where.append(f'"{name}" <= ?')
+                where.append(f'{_q(name)} <= ?')
                 params.append(hi)
-        sql = f'select {sel} from "{table}"'
+        sql = f'select {sel} from {_q(table)}'
         if where:
             sql += " where " + " and ".join(where)
         self._sql, self._params = sql, params
@@ -179,6 +187,11 @@ class SqliteConnector(Connector):
         self._meta = _Meta(self)
         self._split_mgr = _Splits(self)
         self._schema_cache: Dict[str, Schema] = {}
+        # TableStats are full-scan-priced (count(*) + per-column
+        # min/max/distinct); cache per table, invalidated by this
+        # connector's own writes (ADVICE r5 — planning must not re-scan
+        # sqlite per optimizer estimate)
+        self._stats_cache: Dict[str, TableStats] = {}
 
     def _db(self) -> sqlite3.Connection:
         db = getattr(self._local, "db", None)
@@ -198,18 +211,25 @@ class SqliteConnector(Connector):
         got = self._schema_cache.get(table)
         if got is None:
             info = self._db().execute(
-                f'pragma table_info("{table}")').fetchall()
+                f'pragma table_info({_q(table)})').fetchall()
             if not info:
                 raise KeyError(f"sqlite table {table!r} not found")
             got = Schema([(r[1], _affinity_type(r[2])) for r in info])
             self._schema_cache[table] = got
         return got
 
+    def _invalidate(self, table: str) -> None:
+        self._schema_cache.pop(table, None)
+        self._stats_cache.pop(table, None)
+
     def _stats(self, table: str) -> TableStats:
+        got = self._stats_cache.get(table)
+        if got is not None:
+            return got
         db = self._db()
         try:
             n = db.execute(
-                f'select count(*) from "{table}"').fetchone()[0]
+                f'select count(*) from {_q(table)}').fetchone()[0]
         except sqlite3.Error:
             return TableStats()
         cols: Dict[str, ColumnStats] = {}
@@ -218,11 +238,14 @@ class SqliteConnector(Connector):
             if f.type.is_string:
                 continue
             lo, hi, d = db.execute(
-                f'select min("{f.name}"), max("{f.name}"),'
-                f' count(distinct "{f.name}") from "{table}"').fetchone()
+                f'select min({_q(f.name)}), max({_q(f.name)}),'
+                f' count(distinct {_q(f.name)}) from {_q(table)}'
+            ).fetchone()
             cols[f.name] = ColumnStats(distinct_count=float(d),
                                        min_value=lo, max_value=hi)
-        return TableStats(row_count=float(n), columns=cols)
+        got = TableStats(row_count=float(n), columns=cols)
+        self._stats_cache[table] = got
+        return got
 
     def page_source(self, split: Split, columns: Sequence[str],
                     pushdown=None, rows_per_batch: int = 1 << 17
@@ -243,14 +266,14 @@ class SqliteConnector(Connector):
         decl = {T.BIGINT: "INTEGER", T.INTEGER: "INTEGER",
                 T.BOOLEAN: "BOOLEAN", T.DOUBLE: "REAL", T.DATE: "DATE"}
         cols = ", ".join(
-            f'"{f.name}" '
+            f'{_q(f.name)} '
             + ("TEXT" if f.type.is_string
                else decl.get(f.type, "REAL"))
             for f in schema.fields)
         ine = "if not exists " if if_not_exists else ""
-        self._db().execute(f'create table {ine}"{name}" ({cols})')
+        self._db().execute(f'create table {ine}{_q(name)} ({cols})')
         self._db().commit()
-        self._schema_cache.pop(name, None)
+        self._invalidate(name)
 
     def append(self, name: str, batch: Batch) -> int:
         import datetime
@@ -276,17 +299,18 @@ class SqliteConnector(Connector):
 
         ph = ", ".join("?" for _ in batch.schema.fields)
         self._db().executemany(
-            f'insert into "{name}" values ({ph})',
+            f'insert into {_q(name)} values ({ph})',
             [tuple(conv(v) for v in r) for r in rows])
         self._db().commit()
+        self._stats_cache.pop(name, None)
         return len(rows)
 
     def drop_table(self, name: str, if_exists: bool = False) -> None:
         if not if_exists and name not in self.tables:
             raise KeyError(f"sqlite table {name!r} not found")
-        self._db().execute(f'drop table if exists "{name}"')
+        self._db().execute(f'drop table if exists {_q(name)}')
         self._db().commit()
-        self._schema_cache.pop(name, None)
+        self._invalidate(name)
 
 
 def connector_factory(props: Dict[str, str]) -> SqliteConnector:

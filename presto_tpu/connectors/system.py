@@ -14,8 +14,20 @@ namespace like the rest of the engine's two-level names):
 - ``tables``    (table_catalog, table_name)
 - ``columns``   (table_catalog, table_name, column_name, ordinal,
                  data_type)
-- ``queries``   (query_id, state, query, elapsed_ms) — the runner's log
+- ``queries``   (query_id, state, query, elapsed_ms, user, error,
+                 create_time) — the runner's log (reference
+                 system.runtime.queries)
+- ``tasks``     (task_id, query_id, stage_id, task_partition, node_id,
+                 state, elapsed_ms) — worker tasks from the process-wide
+                 obs registry (reference system.runtime.tasks)
+- ``metrics``   (name, kind, value) — the obs metrics registry
+                 (the reference's JMX connector role: engine metrics as
+                 a SQL table)
 - ``nodes``     (node_id, coordinator, state)
+
+These double as the ``system.runtime.*`` names: the engine flattens
+schemas, so ``system.runtime.queries`` and ``system.default.queries``
+are the same table.
 """
 from __future__ import annotations
 
@@ -38,7 +50,12 @@ _SCHEMAS: Dict[str, List] = {
                 ("column_name", V), ("ordinal", T.BIGINT),
                 ("data_type", V)],
     "queries": [("query_id", V), ("state", V), ("query", V),
-                ("elapsed_ms", T.DOUBLE)],
+                ("elapsed_ms", T.DOUBLE), ("user", V), ("error", V),
+                ("create_time", T.DOUBLE)],
+    "tasks": [("task_id", V), ("query_id", V), ("stage_id", T.BIGINT),
+              ("task_partition", T.BIGINT), ("node_id", V), ("state", V),
+              ("elapsed_ms", T.DOUBLE)],
+    "metrics": [("name", V), ("kind", V), ("value", T.DOUBLE)],
     "nodes": [("node_id", V), ("coordinator", T.BOOLEAN), ("state", V)],
 }
 
@@ -49,6 +66,9 @@ class QueryLogEntry:
     state: str
     query: str
     elapsed_ms: float
+    user: str = ""
+    error: Optional[str] = None
+    create_time: float = 0.0
 
 
 class _Metadata(ConnectorMetadata):
@@ -139,8 +159,25 @@ class SystemConnector(Connector):
                                     f.type.display()))
             return out
         if table == "queries":
-            return [(q.query_id, q.state, q.query, q.elapsed_ms)
+            return [(q.query_id, q.state, q.query, q.elapsed_ms,
+                     q.user, q.error, q.create_time)
                     for q in self.query_log]
+        if table == "tasks":
+            from ..obs.metrics import TASKS
+            out = []
+            for t in TASKS.snapshot():
+                out.append((t.get("task_id", ""),
+                            t.get("query_id", ""),
+                            int(t.get("stage_id", 0)),
+                            int(t.get("partition", 0)),
+                            t.get("node_id", ""),
+                            t.get("state", ""),
+                            float(t.get("elapsed_ms", 0.0))))
+            return out
+        if table == "metrics":
+            from ..obs.metrics import REGISTRY
+            return [(m["name"], m["kind"], float(m["value"]))
+                    for m in REGISTRY.snapshot()]
         if table == "nodes":
             import jax
             return [(f"device-{d.id}", d.id == 0, "active")

@@ -32,6 +32,8 @@ import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from ..connectors.spi import Split
+from ..obs.metrics import REGISTRY
+from ..obs.trace import TRACER
 from ..planner import codec
 from ..planner.fragmenter import (
     FragmentedPlan, OutputSpec, PlanFragment, fragment_plan,
@@ -267,6 +269,16 @@ class ClusterRunner:
             raise QueryFailedError("no active workers")
         self._seq += 1
         qid = f"cq_{self._seq:06d}"
+        REGISTRY.counter("cluster_queries_total").inc()
+        with TRACER.span("query", query_id=qid, mode="cluster",
+                         workers=len(workers)):
+            return self._schedule_and_collect(fp, init_values, workers,
+                                              qid)
+
+    def _schedule_and_collect(self, fp: FragmentedPlan,
+                              init_values: List[object],
+                              workers: List[str],
+                              qid: str) -> QueryResult:
         # task counts per fragment
         consumer_of: Dict[int, int] = {}
         for f in fp.fragments:
@@ -299,34 +311,59 @@ class ClusterRunner:
                     for fid in node.fragment_ids
                 }
                 urls: List[str] = []
-                if f.partitioning == "source":
-                    assignment = splits_for[f.id]
-                    part = 0
-                    for w, splits in zip(workers, assignment):
-                        if not splits:
-                            continue
+                with TRACER.span("stage", query_id=qid, stage_id=f.id,
+                                 partitioning=f.partitioning):
+                    # tasks created inside the stage span: their wire
+                    # trace context parents them under this stage
+                    if f.partitioning == "source":
+                        assignment = splits_for[f.id]
+                        part = 0
+                        for w, splits in zip(workers, assignment):
+                            if not splits:
+                                continue
+                            urls.append(self._create_task(
+                                w, qid, f, part, n_buffers, splits,
+                                sources, init_values))
+                            part += 1
+                    elif f.partitioning == "fixed":
+                        for part, w in enumerate(workers):
+                            urls.append(self._create_task(
+                                w, qid, f, part, n_buffers, [], sources,
+                                init_values))
+                    else:
                         urls.append(self._create_task(
-                            w, qid, f, part, n_buffers, splits, sources,
-                            init_values))
-                        part += 1
-                elif f.partitioning == "fixed":
-                    for part, w in enumerate(workers):
-                        urls.append(self._create_task(
-                            w, qid, f, part, n_buffers, [], sources,
-                            init_values))
-                else:
-                    urls.append(self._create_task(
-                        workers[0], qid, f, 0, n_buffers, [], sources,
-                        init_values))
+                            workers[0], qid, f, 0, n_buffers, [],
+                            sources, init_values))
                 task_urls[f.id] = urls
                 all_tasks.extend(urls)
             return self._collect(fp, task_urls, all_tasks)
         finally:
+            self._harvest_spans(all_tasks)
             for u in all_tasks:
                 try:
                     self._request(u, method="DELETE")
                 except Exception:
                     pass
+
+    def _harvest_spans(self, all_tasks: List[str]) -> None:
+        """Pull each task's spans (its share of this query's trace) back
+        to the coordinator so distributed traces stitch; the tracer
+        dedupes by span id, so in-process workers sharing the ring are
+        harmless."""
+        if not TRACER.enabled:
+            return
+        # one fetch per distinct WORKER: a task's span export is the
+        # worker's whole share of the trace, so per-task fetches would
+        # download K duplicate copies for import_spans to throw away
+        by_worker: Dict[str, str] = {}
+        for u in all_tasks:
+            by_worker.setdefault(u.split("/v1/task/")[0], u)
+        for u in by_worker.values():
+            try:
+                st = self._request(f"{u}?spans=1", retries=0, timeout=5)
+            except Exception:
+                continue
+            TRACER.import_spans(st.get("spans") or [])
 
     def _assign_splits(self, f: PlanFragment,
                        workers: List[str]) -> List[List[Split]]:
@@ -365,6 +402,11 @@ class ClusterRunner:
             "init_values": codec.encode(list(init_values)),
             "rows_per_batch": self.rows_per_batch,
         }
+        ctx = TRACER.context()
+        if ctx is not None:
+            # span context over the wire (the stage span is current):
+            # the worker's task span joins this trace
+            doc["trace"] = ctx
         self._request(f"{worker}/v1/task/{task_id}", method="PUT",
                       body=doc)
         return f"{worker}/v1/task/{task_id}"

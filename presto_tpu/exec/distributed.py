@@ -32,13 +32,20 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:                                    # jax >= 0.6: top-level export,
+    from jax import shard_map           # replication check is check_vma
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except ImportError:                     # jax 0.4.x: experimental module,
+    from jax.experimental.shard_map import shard_map  # kwarg check_rep
+    _SHARD_MAP_CHECK_KW = "check_rep"
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import types as T
 from ..batch import Batch, Column, Schema, bucket_capacity, concat_batches
 from ..expr import ir
 from ..expr.compiler import compile_filter, compile_projection
+from ..obs.metrics import REGISTRY
+from ..obs.trace import TRACER
 from ..ops.aggregation import AggSpec, global_aggregate, grouped_aggregate
 from ..ops.join import (
     build_match_mask, expand_join, lookup_join, match_count_max,
@@ -96,13 +103,14 @@ class DistributedExecutor(_Executor):
                      else tuple(P(self.axis) for _ in range(n_out)))
         return jax.jit(shard_map(
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False))
+            **{_SHARD_MAP_CHECK_KW: False}))
 
     def _shard_live_max(self, batch: Batch) -> int:
         """Max live rows on any shard (host sync) — sizes compactions."""
         per = self._smap(
             lambda b: jnp.sum(b.row_mask, keepdims=True).astype(jnp.int64), 1)
-        counts = np.asarray(jax.device_get(per(batch)))
+        with TRACER.span("device-sync", what="shard-live-max"):
+            counts = np.asarray(jax.device_get(per(batch)))
         return int(counts.max()) if counts.size else 0
 
     def _replicate_device(self, batch: Batch) -> Batch:
@@ -127,14 +135,16 @@ class DistributedExecutor(_Executor):
         fns: Dict[int, object] = {}
 
         def repart(batch: Batch) -> Batch:
-            quota = bucket_capacity(
-                max(int(np.asarray(jax.device_get(counts_fn(batch))).max()),
-                    1))
+            with TRACER.span("device-sync", what="exchange-quota"):
+                quota = bucket_capacity(
+                    max(int(np.asarray(
+                        jax.device_get(counts_fn(batch))).max()), 1))
             fn = fns.get(quota)
             if fn is None:
                 fn = fns[quota] = self._smap(
                     lambda b, _q=quota: repartition_by_hash_compact(
                         b, keys, self.axis, self.n, _q), 1)
+            REGISTRY.counter("exchange_repartitions_total").inc()
             return fn(batch)
         return repart
 
@@ -833,6 +843,7 @@ class DistributedRunner:
         self.mesh = make_mesh(n_devices)
         self.rows_per_batch = rows_per_batch
         self._optimize = optimize
+        self._seq = 0
 
     def execute(self, sql: str) -> QueryResult:
         from ..sql import ast as A
@@ -843,13 +854,21 @@ class DistributedRunner:
             raise NotImplementedError(
                 "DistributedRunner serves queries; use LocalRunner for "
                 "session statements")
-        plan = self._optimize(plan_query(stmt, self.session), self.session)
-        from .local import run_init_plans
-        ex = DistributedExecutor(self.session, self.rows_per_batch, self.mesh)
-        run_init_plans(ex, plan)
-        root = plan.root
-        batches = list(ex.run(root.child))
-        ex.check_errors()
-        rows = [r for b in batches for r in b.to_pylist()]
+        self._seq += 1
+        qid = f"dq_{self._seq:06d}"
+        with TRACER.span("query", query_id=qid,
+                         mode="spmd", shards=self.mesh.devices.size):
+            with TRACER.span("plan"):
+                plan = self._optimize(plan_query(stmt, self.session),
+                                      self.session)
+            from .local import run_init_plans
+            ex = DistributedExecutor(self.session, self.rows_per_batch,
+                                     self.mesh)
+            run_init_plans(ex, plan)
+            root = plan.root
+            batches = list(ex.run(root.child))
+            ex.check_errors()
+            with TRACER.span("device-sync", what="result-gather"):
+                rows = [r for b in batches for r in b.to_pylist()]
         return QueryResult(names=[f.name for f in root.fields],
                            types=[f.type for f in root.fields], rows=rows)

@@ -32,6 +32,7 @@ from ..ops.jitcache import (
     lookup_join_jit, match_count_max_jit, prepare_build_jit,
     prepare_direct_jit, semi_join_mask_jit,
 )
+from ..obs.trace import TRACER
 from ..ops.join import expand_join, semi_join_mask
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
 from ..planner.plan import (
@@ -312,7 +313,8 @@ class _Executor:
         import numpy as np
 
         from ..errors import QueryError
-        codes = np.asarray(jnp.stack(self.error_flags))
+        with TRACER.span("device-sync", what="error-flags"):
+            codes = np.asarray(jnp.stack(self.error_flags))
         self.error_flags = []
         code = int(codes.max())
         if code:
@@ -362,6 +364,11 @@ class _Executor:
         it = m(node)
         if self.stats is not None:
             it = self.stats.wrap(node, it)
+        if TRACER.enabled:
+            # operator span: first batch to exhaustion, inclusive of
+            # children (the printer/Chrome viewer nests them by time)
+            it = TRACER.wrap_iter(
+                "op:" + type(node).__name__.replace("Node", ""), it)
         return it
 
     def _run_memoized(self, node: PlanNode, m) -> Iterator[Batch]:
@@ -376,6 +383,10 @@ class _Executor:
         it = m(node)
         if self.stats is not None:
             it = self.stats.wrap(node, it)
+        if TRACER.enabled:
+            it = TRACER.wrap_iter(
+                "op:" + type(node).__name__.replace("Node", ""), it,
+                memoized=True)
         out: List[Batch] = []
         for b in it:
             if not ctx.pool.try_reserve(batch_device_bytes(b), ctx):
@@ -555,7 +566,8 @@ class _Executor:
             # paying 10x their kernel time in compaction syncs)
             if not state["check"] or b.capacity <= (1 << 17):
                 return b
-            tgt = bucket_capacity(b.host_count())
+            with TRACER.span("device-sync", what="compaction-liveness"):
+                tgt = bucket_capacity(b.host_count())
             if tgt * 4 <= b.capacity:
                 return b.compact(tgt, check=False)
             state["check"] = False
@@ -1395,7 +1407,9 @@ class _Executor:
         from ..ops.jitcache import build_summary_jit
         int_flags = tuple(isinstance(build.columns[k].type, _DYN_TYPES)
                           for k in keys)
-        return np.asarray(build_summary_jit(build, tuple(keys), int_flags))
+        with TRACER.span("device-sync", what="build-summary"):
+            return np.asarray(
+                build_summary_jit(build, tuple(keys), int_flags))
 
     @staticmethod
     def _summary_bounds(summary, out_keys):
@@ -1438,7 +1452,8 @@ class _Executor:
         the chunked skew path (most probe batches never touch the hot
         key), so those fall back to the per-batch match_count_max sync."""
         from ..ops.jitcache import max_multiplicity_jit
-        m = int(max_multiplicity_jit(prepared))
+        with TRACER.span("device-sync", what="build-multiplicity"):
+            m = int(max_multiplicity_jit(prepared))
         return m if m <= self.SKEW_MATCH_LIMIT else None
 
     def _probe_batches(self, node: JoinNode, probe: Batch, build: Batch,

@@ -17,6 +17,8 @@ from ..batch import Batch
 from ..connectors.memory import MemoryConnector
 from ..connectors.spi import CatalogManager, TableHandle
 from ..connectors.tpch import TpchConnector
+from ..obs.metrics import REGISTRY, attach_event_listeners
+from ..obs.trace import TRACER
 from ..sql import ast as A
 from ..sql.ast import count_parameters, substitute_parameters
 from ..sql.parser import parse_statement
@@ -46,6 +48,9 @@ class LocalRunner:
         from ..server.security import AccessControl
         self.transactions = TransactionManager()
         self.events = EventListenerManager()
+        # metrics sink: query/split completion events feed the
+        # process-wide registry (system.runtime.metrics)
+        attach_event_listeners(self.events)
         self.access_control = AccessControl()    # allow-all until rules set
         from ..server.security import RoleManager
         self.roles = RoleManager()               # enforce=False by default
@@ -76,7 +81,8 @@ class LocalRunner:
         with self._state_lock:
             self._query_seq += 1
             qid = f"q_{self._query_seq:06d}"
-            entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0)
+            entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0,
+                                  user=user, create_time=_time.time())
             self.query_log.append(entry)
             # live per-query stats (wall/batches per node + split events),
             # served by GET /v1/query/{id} while the query runs
@@ -91,10 +97,12 @@ class LocalRunner:
                         del self.live_stats[old]
         t0 = _time.perf_counter()
         error: Optional[str] = None
+        REGISTRY.counter("queries_started_total").inc()
         try:
-            out = self._execute_stmt(stmt, properties, user,
-                                     cancel_event=cancel_event,
-                                     stats=stats)
+            with TRACER.span("query", query_id=qid, user=user):
+                out = self._execute_stmt(stmt, properties, user,
+                                         cancel_event=cancel_event,
+                                         stats=stats)
             entry.state = "FINISHED"
             return out
         except Exception as e:
@@ -103,15 +111,33 @@ class LocalRunner:
             raise
         finally:
             entry.elapsed_ms = (_time.perf_counter() - t0) * 1e3
+            entry.error = error
             with self._state_lock:
                 if len(self.query_log) > 1000:
                     del self.query_log[:-500]
+            self._feed_metrics(stats)
             for s in stats.splits:
                 self.events.split_completed(SplitCompletedEvent(
                     qid, s["table"], s["split"], s["wallMs"],
                     s["batches"]))
             self.events.query_completed(completed_event(
                 qid, sql.strip(), user, entry.state, t0, error))
+
+    def _feed_metrics(self, stats) -> None:
+        """Fold one query's per-node stats and memory-pool stats into the
+        process-wide registry (batches/rows per operator kind, spill
+        bytes, pool high-water mark)."""
+        for node, st in list(stats.by_node.items()):
+            kind = type(node).__name__.replace("Node", "").lower()
+            REGISTRY.counter(f"operator_batches_total.{kind}").inc(
+                st.batches)
+            REGISTRY.counter(f"operator_seconds_total.{kind}").inc(
+                st.wall_s)
+            if st.rows:
+                REGISTRY.counter(f"operator_rows_total.{kind}").inc(
+                    st.rows)
+        # memory_pool_peak_bytes is fed at reservation time (memory.py
+        # _POOL_PEAK) — the pool, not the query, owns that gauge
 
     def plan(self, sql: str, optimized: bool = True) -> LogicalPlan:
         stmt = parse_statement(sql)
@@ -138,7 +164,8 @@ class LocalRunner:
                 session, catalogs=catalogs,
                 properties={**session.properties, **(properties or {})})
         if isinstance(stmt, A.Query):
-            plan = optimize(plan_query(stmt, session), session)
+            with TRACER.span("plan"):
+                plan = optimize(plan_query(stmt, session), session)
             if self.roles.enforce:
                 self._check_select_privileges(plan, user)
             try:
@@ -172,6 +199,7 @@ class LocalRunner:
                 return QueryResult(["Query Plan"], [T.VARCHAR],
                                    [(line,) for line in doc.split("\n")])
             stats = None
+            trace_spans = None
             if stmt.analyze:
                 # EXPLAIN ANALYZE: run the query with per-operator stats,
                 # draining batches without materializing client rows
@@ -180,10 +208,14 @@ class LocalRunner:
                 stats = StatsCollector(count_rows=True)
                 stats.planning_s = _time.perf_counter() - t0
                 t1 = _time.perf_counter()
-                execute_plan(plan, session, self.rows_per_batch,
-                             stats=stats, collect_rows=False,
-                             cancel_event=cancel_event)
+                with TRACER.span("explain-analyze") as sp:
+                    execute_plan(plan, session, self.rows_per_batch,
+                                 stats=stats, collect_rows=False,
+                                 cancel_event=cancel_event)
                 stats.total_wall_s = _time.perf_counter() - t1
+                tid = getattr(sp, "trace_id", None)
+                if TRACER.enabled and tid is not None:
+                    trace_spans = TRACER.export(tid)
             if stmt.type == "distributed":
                 if stmt.format != "text":
                     raise ValueError(
@@ -201,6 +233,9 @@ class LocalRunner:
                 text = plan_graphviz(plan)
             else:
                 text = print_plan(plan, stats)
+                if trace_spans:
+                    from ..planner.printer import format_trace_summary
+                    text += "\n" + format_trace_summary(trace_spans)
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.split("\n")])
         if isinstance(stmt, A.ShowCatalogs):
@@ -403,11 +438,15 @@ class LocalRunner:
 
     # -- write path (reference TableWriterOperator + finishInsert) ----------
     def _writable(self, name, user: str = ""):
+        from ..planner.planner import _schema_exists
         catalog = self.session.catalog if len(name) < 3 else name[-3]
-        if len(name) == 2 and self.session.catalogs.exists(name[0]):
-            # two-part name whose qualifier names a mounted catalog:
-            # catalog.table with the default schema (matches the read
-            # path's catalog-first resolution)
+        if len(name) == 2 and self.session.catalogs.exists(name[0]) \
+                and not _schema_exists(self.session, name[0]):
+            # two-part name whose qualifier names a mounted catalog (and
+            # no session-catalog schema shadows it): catalog.table with
+            # the default schema (matches the read path's resolution in
+            # planner.plan_table, so the same name reads and writes one
+            # table)
             catalog = name[0]
         self.access_control.check_can_access_catalog(user, catalog)
         if self.roles.enforce:

@@ -34,10 +34,19 @@ from ..batch import (
     unify_dictionaries, vocab_column,
 )
 from ..memory import QueryMemoryPool, batch_device_bytes
+from ..obs.metrics import REGISTRY
+from ..obs.trace import TRACER
 from ..ops.aggregation import AggSpec
 from ..ops.jitcache import grouped_aggregate_jit as grouped_aggregate
 from ..ops.sort import SortKey, sort_batch
 from ..parallel.exchange import hash_partition_ids
+
+#: process-wide spill metrics (per-query figures live on the pool's
+#: MemoryStats; these are the fleet view behind system.runtime.metrics)
+_SPILL_DEVICE_BYTES = REGISTRY.counter("spill_device_bytes_total")
+_SPILL_HOST_BYTES = REGISTRY.counter("spill_host_staged_bytes_total")
+_SPILL_DISK_BYTES = REGISTRY.counter("spill_disk_bytes_total")
+_SPILL_REVOCATIONS = REGISTRY.counter("spill_revocations_total")
 
 
 @dataclasses.dataclass
@@ -176,6 +185,7 @@ class HostPartitionStore:
             self.chunks.append(ch)
             nb = _chunk_host_bytes(ch)
             self.host_bytes += nb
+            _SPILL_HOST_BYTES.inc(nb)
             pool = self.pool
             if pool is not None:
                 # the staging budget is QUERY-wide (reference
@@ -186,14 +196,18 @@ class HostPartitionStore:
                 if (pool.disk_threshold is not None
                         and pool.host_staged_bytes > pool.disk_threshold):
                     self._flush_to_disk()
-        return batch_device_bytes(batch)
+        nb_dev = batch_device_bytes(batch)
+        _SPILL_DEVICE_BYTES.inc(nb_dev)
+        return nb_dev
 
     def _flush_to_disk(self) -> None:
-        self._file = SpillFile(
-            None if self.pool is None else self.pool.spill_dir)
-        for ch in self.chunks:
-            self._flush_chunk(ch)
-        self.chunks = []
+        with TRACER.span("spill-to-disk", chunks=len(self.chunks),
+                         host_bytes=self.host_bytes):
+            self._file = SpillFile(
+                None if self.pool is None else self.pool.spill_dir)
+            for ch in self.chunks:
+                self._flush_chunk(ch)
+            self.chunks = []
         if self.pool is not None:
             self.pool.host_staged_bytes -= self.host_bytes
         self.host_bytes = 0
@@ -209,6 +223,7 @@ class HostPartitionStore:
                            [v[rows] for v in ch.valids],
                            ch.dicts, compress=True)
             self._frags[p].append(self._file.append(page))
+            _SPILL_DISK_BYTES.inc(len(page))
             if self.pool is not None:
                 self.pool.stats.disk_spilled_bytes += len(page)
 
@@ -302,12 +317,15 @@ class SpillableBuildBuffer:
         return n
 
     def _spill_all(self) -> int:
-        freed = 0
-        for b in self.device:
-            freed += self._stage(b)
-        self.device = []
-        self.spilled = True
-        return freed
+        _SPILL_REVOCATIONS.inc()
+        with TRACER.span("spill-revoke", buffer="join-build",
+                         batches=len(self.device)):
+            freed = 0
+            for b in self.device:
+                freed += self._stage(b)
+            self.device = []
+            self.spilled = True
+            return freed
 
     def finish(self):
         # once the build is handed to the prober, revoking can no longer
@@ -392,12 +410,15 @@ class AggSpillBuffer:
         return n
 
     def _spill_all(self) -> int:
-        freed = 0
-        for b in self.device:
-            freed += self._stage(b)
-        self.device = []
-        self.spilled = True
-        return freed
+        _SPILL_REVOCATIONS.inc()
+        with TRACER.span("spill-revoke", buffer="hash-agg",
+                         batches=len(self.device)):
+            freed = 0
+            for b in self.device:
+                freed += self._stage(b)
+            self.device = []
+            self.spilled = True
+            return freed
 
     def results(self, final: bool = True) -> Iterator[Batch]:
         """Final rows (default) or merged partial states (``final=False``,
@@ -473,12 +494,15 @@ class SortSpillBuffer:
         return n
 
     def _spill_all(self) -> int:
-        freed = 0
-        for b in self.device:
-            freed += self._stage(b)
-        self.device = []
-        self.spilled = True
-        return freed
+        _SPILL_REVOCATIONS.inc()
+        with TRACER.span("spill-revoke", buffer="order-by",
+                         batches=len(self.device)):
+            freed = 0
+            for b in self.device:
+                freed += self._stage(b)
+            self.device = []
+            self.spilled = True
+            return freed
 
     def results(self, rows_per_batch: int) -> Iterator[Batch]:
         with self.ctx.pool.lock:

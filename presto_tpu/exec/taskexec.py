@@ -22,6 +22,13 @@ import threading
 import time
 from typing import Callable, List, Optional, TypeVar
 
+from ..obs.metrics import REGISTRY
+from ..obs.trace import TRACER
+
+_DEVICE_SECONDS = REGISTRY.counter("scheduler_device_seconds_total")
+_QUANTA = REGISTRY.counter("scheduler_quanta_total")
+_WAIT_SECONDS = REGISTRY.histogram("scheduler_wait_seconds")
+
 #: level thresholds in cumulative device seconds (reference
 #: MultilevelSplitQueue.LEVEL_THRESHOLD_SECONDS = {0, 1, 10, 60, 300})
 LEVEL_THRESHOLDS = (0.0, 1.0, 10.0, 60.0, 300.0)
@@ -92,6 +99,7 @@ class DeviceScheduler:
         this task's turn; account its wall time as device time."""
         if handle is None:
             return fn()
+        t_wait = time.perf_counter()
         with self._cv:
             self._waiting.append(handle)
             while not self._eligible(handle):
@@ -100,10 +108,18 @@ class DeviceScheduler:
             self._running = handle
             self._running_depth += 1
         t0 = time.perf_counter()
+        _WAIT_SECONDS.observe(t0 - t_wait)
+        span = (TRACER.span("quantum", task=handle.name,
+                            level=handle.level)
+                if TRACER.enabled else None)
         try:
             return fn()
         finally:
             dt = time.perf_counter() - t0
+            if span is not None:
+                span.finish()
+            _DEVICE_SECONDS.inc(dt)
+            _QUANTA.inc()
             with self._cv:
                 handle.device_seconds += dt
                 handle.quanta += 1

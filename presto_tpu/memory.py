@@ -22,7 +22,13 @@ import dataclasses
 import threading
 from typing import Callable, List, Optional
 
+from .obs.metrics import REGISTRY
+
 UNLIMITED = 1 << 62
+
+#: process-wide high-water mark across every query pool (the per-query
+#: peak lives on MemoryStats; this is the fleet view)
+_POOL_PEAK = REGISTRY.gauge("memory_pool_peak_bytes")
 
 
 def batch_device_bytes(batch) -> int:
@@ -91,8 +97,9 @@ class QueryMemoryPool:
                 return False
             self.reserved += n
             ctx.bytes += n
-            self.stats.peak_bytes = max(self.stats.peak_bytes,
-                                        self.reserved)
+            if self.reserved > self.stats.peak_bytes:
+                self.stats.peak_bytes = self.reserved
+                _POOL_PEAK.max_update(self.reserved)
             return True
 
     def reserve(self, n: int, ctx: "OperatorMemoryContext") -> None:

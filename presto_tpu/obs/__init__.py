@@ -1,0 +1,21 @@
+"""Observability: span tracing + process-wide metrics.
+
+The reproduction's counterpart of the reference's observability stack —
+OperatorStats/QueryInfo over REST, event/SplitMonitor.java, and the JMX
+connector that turns engine metrics into SQL tables (reference
+presto-main/.../connector/jmx/) — reshaped for a device runtime:
+
+- ``obs.trace``   context-propagated spans (query -> stage -> task ->
+                  operator -> device-sync/compile) with a Chrome-trace
+                  (Perfetto) JSON exporter and wire-carriable span
+                  context for distributed stitching;
+- ``obs.metrics`` process-wide counters/gauges/histograms fed by direct
+                  instrumentation and by an EventListenerManager sink,
+                  queryable as ``system.runtime.metrics``.
+
+Both are always importable and safe when idle: the tracer is OFF by
+default (a disabled ``span()`` returns a shared no-op and records
+nothing), and metric updates are single dict/number operations.
+"""
+from .trace import TRACER, Span, chrome_trace, write_chrome_trace  # noqa: F401
+from .metrics import REGISTRY, TASKS, attach_event_listeners  # noqa: F401

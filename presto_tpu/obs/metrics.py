@@ -1,0 +1,217 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+The role of the reference's JMX-exposed engine metrics (reference
+presto-main/.../connector/jmx/ makes them queryable as SQL tables;
+QueryManagerStats/SqlTaskManager counters feed them): named metrics
+created on demand, updated from direct instrumentation (executor, spill
+buffers, jit cache, exchange buffers, device scheduler) and from an
+EventListenerManager sink (query/split completion), and surfaced as the
+``system.runtime.metrics`` table.
+
+Updates are deliberately tiny — one lock-guarded number update — so the
+registry can stay always-on; nothing here touches the device.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+_INF = float("inf")
+
+
+class Counter:
+    """Monotonic counter (``*_total`` names by convention)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-write or high-water value."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def max_update(self, v: float) -> None:
+        with self._lock:
+            if v > self.value:
+                self.value = float(v)
+
+
+class Histogram:
+    """Count/sum/min/max summary (no buckets: the consumers are SQL and
+    EXPLAIN output, not a quantile store)."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = _INF
+        self.max = -_INF
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+
+class MetricsRegistry:
+    """Name -> metric, created on first use; one per process
+    (``REGISTRY``)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = self._metrics[name] = cls(name)
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} is {type(m).__name__}, "
+                f"not {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> List[Dict]:
+        """JSON-able rows, one per scalar: histograms flatten to
+        ``name.count/sum/min/max`` — the ``system.runtime.metrics``
+        surface."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: List[Dict] = []
+        for name, m in metrics:
+            if isinstance(m, Counter):
+                out.append({"name": name, "kind": "counter",
+                            "value": m.value})
+            elif isinstance(m, Gauge):
+                out.append({"name": name, "kind": "gauge",
+                            "value": m.value})
+            elif isinstance(m, Histogram):
+                out.append({"name": f"{name}.count", "kind": "histogram",
+                            "value": float(m.count)})
+                out.append({"name": f"{name}.sum", "kind": "histogram",
+                            "value": m.sum})
+                if m.count:
+                    out.append({"name": f"{name}.min",
+                                "kind": "histogram", "value": m.min})
+                    out.append({"name": f"{name}.max",
+                                "kind": "histogram", "value": m.max})
+        return out
+
+    def reset(self) -> None:
+        """Zero every metric IN PLACE (tests). Instrumentation sites
+        cache metric objects at module import (spill/taskexec/worker),
+        so clearing the dict would orphan those references — values
+        reset, identities survive."""
+        with self._lock:
+            for m in self._metrics.values():
+                if isinstance(m, (Counter, Gauge)):
+                    m.value = 0.0
+                elif isinstance(m, Histogram):
+                    m.count, m.sum = 0, 0.0
+                    m.min, m.max = _INF, -_INF
+
+
+#: the process-wide registry
+REGISTRY = MetricsRegistry()
+
+
+# -- task registry (system.runtime.tasks) ------------------------------------
+
+class TaskRegistry:
+    """Bounded registry of worker-task states: the feed of the
+    ``system.runtime.tasks`` table (reference SqlTaskManager's task
+    info map behind server/TaskResource.java)."""
+
+    def __init__(self, max_tasks: int = 1000):
+        self._tasks: "OrderedDict[str, Dict]" = OrderedDict()
+        self._max = max_tasks
+        self._lock = threading.Lock()
+
+    def update(self, task_id: str, **fields) -> None:
+        with self._lock:
+            t = self._tasks.get(task_id)
+            if t is None:
+                t = self._tasks[task_id] = {
+                    "task_id": task_id, "created": time.time()}
+                while len(self._tasks) > self._max:
+                    self._tasks.popitem(last=False)
+            t.update(fields)
+
+    def snapshot(self) -> List[Dict]:
+        with self._lock:
+            return [dict(t) for t in self._tasks.values()]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tasks.clear()
+
+
+TASKS = TaskRegistry()
+
+
+# -- EventListenerManager sink -----------------------------------------------
+
+def attach_event_listeners(events,
+                           registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    """Register metrics-feeding listeners on an EventListenerManager:
+    query-completion state counters + latency histogram, split
+    completion counters — the sink half of the metrics story (the other
+    half is direct instrumentation)."""
+    reg = registry if registry is not None else REGISTRY
+
+    def on_query_completed(ev) -> None:
+        state = str(getattr(ev, "state", "unknown")).lower()
+        reg.counter(f"queries_{state}_total").inc()
+        reg.histogram("query_seconds").observe(
+            getattr(ev, "elapsed_ms", 0.0) / 1e3)
+
+    def on_split_completed(ev) -> None:
+        reg.counter("splits_completed_total").inc()
+        reg.counter("split_batches_total").inc(
+            getattr(ev, "batches", 0) or 0)
+        reg.histogram("split_seconds").observe(
+            (getattr(ev, "wall_ms", 0.0) or 0.0) / 1e3)
+
+    events.register(on_query_completed)
+    events.register_split_listener(on_split_completed)

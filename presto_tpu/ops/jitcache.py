@@ -13,20 +13,82 @@ per column for generator connectors) simply retraces that one call.
 """
 from __future__ import annotations
 
-import functools
+import threading
+import time
 from typing import Optional, Sequence
 
 import jax
 
+from ..obs.metrics import REGISTRY
+from ..obs.trace import TRACER
 from .aggregation import AggSpec, global_aggregate, grouped_aggregate
 
+_JIT_HITS = REGISTRY.counter("jit_cache_hits_total")
+_JIT_MISSES = REGISTRY.counter("jit_cache_misses_total")
+_JIT_COMPILES = REGISTRY.counter("jit_compile_total")
+_JIT_COMPILE_S = REGISTRY.counter("jit_compile_seconds_total")
 
-@functools.lru_cache(maxsize=None)
-def _grouped(group_indices, aggs, mode, output_capacity):
+
+class _TimedEntry:
+    """Jitted callable whose FIRST invocation is timed as a compile
+    (jax.jit compiles lazily on first call; later shape buckets retrace
+    silently — this records the dominant first-trace cost without
+    touching every dispatch)."""
+
+    __slots__ = ("name", "fn", "first", "_lock")
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self.fn = fn
+        self.first = True
+        self._lock = threading.Lock()
+
+    def __call__(self, *args):
+        if self.first:
+            # one-shot flip under a lock: concurrent first calls (a
+            # fixed stage starts every task at once) must count ONE
+            # compile, not N
+            with self._lock:
+                timed, self.first = self.first, False
+            if timed:
+                t0 = time.perf_counter()
+                with TRACER.span(f"jit-compile:{self.name}"):
+                    out = self.fn(*args)
+                _JIT_COMPILES.inc()
+                _JIT_COMPILE_S.inc(time.perf_counter() - t0)
+                return out
+        return self.fn(*args)
+
+
+def _entry_cache(name: str, factory):
+    """lru_cache replacement for the jit entry points: per-(static-args)
+    memo plus cache-hit/miss counters and compile spans — the metrics
+    feed the reference exposes from PageFunctionCompiler's cache stats."""
+    cache = {}
+    lock = threading.Lock()
+
+    def get(*key):
+        fn = cache.get(key)
+        if fn is None:
+            with lock:
+                fn = cache.get(key)
+                if fn is None:
+                    _JIT_MISSES.inc()
+                    fn = cache[key] = _TimedEntry(name, factory(*key))
+                    return fn
+        _JIT_HITS.inc()
+        return fn
+    return get
+
+
+def _grouped_factory(group_indices, aggs, mode, output_capacity):
     def run(batch):
         return grouped_aggregate(batch, group_indices, aggs, mode,
                                  output_capacity)
     return jax.jit(run)
+
+
+_grouped = _entry_cache("grouped_aggregate", _grouped_factory)
 
 
 def grouped_aggregate_jit(batch, group_indices: Sequence[int],
@@ -36,11 +98,13 @@ def grouped_aggregate_jit(batch, group_indices: Sequence[int],
                     output_capacity)(batch)
 
 
-@functools.lru_cache(maxsize=None)
-def _global(aggs, mode):
+def _global_factory(aggs, mode):
     def run(batch):
         return global_aggregate(batch, aggs, mode)
     return jax.jit(run)
+
+
+_global = _entry_cache("global_aggregate", _global_factory)
 
 
 def global_aggregate_jit(batch, aggs: Sequence[AggSpec],
@@ -59,19 +123,20 @@ from .join import (  # noqa: E402
 )
 
 
-@functools.lru_cache(maxsize=None)
-def _prepare(key_cols):
-    return jax.jit(lambda b: prepare_build(b, key_cols))
+_prepare = _entry_cache(
+    "prepare_build",
+    lambda key_cols: jax.jit(lambda b: prepare_build(b, key_cols)))
 
 
 def prepare_build_jit(build, key_cols):
     return _prepare(tuple(key_cols))(build)
 
 
-@functools.lru_cache(maxsize=None)
-def _lookup(pkeys, bkeys, payload, names, jt):
-    return jax.jit(lambda p, b, prep: lookup_join(
-        p, b, pkeys, bkeys, payload, names, jt, prepared=prep))
+_lookup = _entry_cache(
+    "lookup_join",
+    lambda pkeys, bkeys, payload, names, jt: jax.jit(
+        lambda p, b, prep: lookup_join(
+            p, b, pkeys, bkeys, payload, names, jt, prepared=prep)))
 
 
 def lookup_join_jit(probe, build, probe_keys, build_keys, payload,
@@ -80,11 +145,12 @@ def lookup_join_jit(probe, build, probe_keys, build_keys, payload,
                    tuple(payload_names), join_type)(probe, build, prepared)
 
 
-@functools.lru_cache(maxsize=None)
-def _expand(pkeys, bkeys, payload, names, jt, max_matches):
-    return jax.jit(lambda p, b, prep: expand_join(
-        p, b, pkeys, bkeys, payload, names, jt, max_matches,
-        prepared=prep))
+_expand = _entry_cache(
+    "expand_join",
+    lambda pkeys, bkeys, payload, names, jt, max_matches: jax.jit(
+        lambda p, b, prep: expand_join(
+            p, b, pkeys, bkeys, payload, names, jt, max_matches,
+            prepared=prep)))
 
 
 def expand_join_jit(probe, build, probe_keys, build_keys, payload,
@@ -94,10 +160,10 @@ def expand_join_jit(probe, build, probe_keys, build_keys, payload,
                    max_matches)(probe, build, prepared)
 
 
-@functools.lru_cache(maxsize=None)
-def _match_count(pkeys, bkeys):
-    return jax.jit(lambda p, b, prep: match_count_max(
-        p, b, pkeys, bkeys, prepared=prep))
+_match_count = _entry_cache(
+    "match_count_max",
+    lambda pkeys, bkeys: jax.jit(lambda p, b, prep: match_count_max(
+        p, b, pkeys, bkeys, prepared=prep)))
 
 
 def match_count_max_jit(probe, build, probe_keys, build_keys, prepared):
@@ -114,10 +180,10 @@ from .join import max_multiplicity  # noqa: E402
 max_multiplicity_jit = jax.jit(max_multiplicity)
 
 
-@functools.lru_cache(maxsize=None)
-def _match_mask(pkeys, bkeys):
-    return jax.jit(lambda p, b, prep: build_match_mask(
-        p, b, pkeys, bkeys, prepared=prep))
+_match_mask = _entry_cache(
+    "build_match_mask",
+    lambda pkeys, bkeys: jax.jit(lambda p, b, prep: build_match_mask(
+        p, b, pkeys, bkeys, prepared=prep)))
 
 
 def build_match_mask_jit(probe, build, probe_keys, build_keys, prepared):
@@ -125,20 +191,21 @@ def build_match_mask_jit(probe, build, probe_keys, build_keys, prepared):
                        tuple(build_keys))(probe, build, prepared)
 
 
-@functools.lru_cache(maxsize=None)
-def _key_ranks(key_cols):
-    return jax.jit(lambda b, prep: build_key_ranks(
-        b, key_cols, prepared=prep))
+_key_ranks = _entry_cache(
+    "build_key_ranks",
+    lambda key_cols: jax.jit(lambda b, prep: build_key_ranks(
+        b, key_cols, prepared=prep)))
 
 
 def build_key_ranks_jit(build, key_cols, prepared):
     return _key_ranks(tuple(key_cols))(build, prepared)
 
 
-@functools.lru_cache(maxsize=None)
-def _semi(skeys, fkeys, negated, null_aware):
-    return jax.jit(lambda p, b, prep: semi_join_mask(
-        p, b, skeys, fkeys, negated, null_aware, prepared=prep))
+_semi = _entry_cache(
+    "semi_join_mask",
+    lambda skeys, fkeys, negated, null_aware: jax.jit(
+        lambda p, b, prep: semi_join_mask(
+            p, b, skeys, fkeys, negated, null_aware, prepared=prep)))
 
 
 def semi_join_mask_jit(probe, build, probe_keys, build_keys,
@@ -147,9 +214,9 @@ def semi_join_mask_jit(probe, build, probe_keys, build_keys,
                  null_aware)(probe, build, prepared)
 
 
-@functools.lru_cache(maxsize=None)
-def _compact(capacity):
-    return jax.jit(lambda b: b.compact(capacity, check=False))
+_compact = _entry_cache(
+    "compact",
+    lambda capacity: jax.jit(lambda b: b.compact(capacity, check=False)))
 
 
 def compact_jit(batch, capacity: int):
@@ -161,17 +228,17 @@ def compact_jit(batch, capacity: int):
 from .join import prepare_direct  # noqa: E402
 
 
-@functools.lru_cache(maxsize=None)
-def _prepare_direct(key_cols, size):
-    return jax.jit(lambda b, lo0: prepare_direct(b, key_cols, lo0, size))
+_prepare_direct = _entry_cache(
+    "prepare_direct",
+    lambda key_cols, size: jax.jit(
+        lambda b, lo0: prepare_direct(b, key_cols, lo0, size)))
 
 
 def prepare_direct_jit(build, key_cols, lo0, size: int):
     return _prepare_direct(tuple(key_cols), size)(build, lo0)
 
 
-@functools.lru_cache(maxsize=None)
-def _build_summary(key_cols, int_flags):
+def _build_summary_factory(key_cols, int_flags):
     import jax.numpy as jnp
 
     def run(b):
@@ -192,6 +259,9 @@ def _build_summary(key_cols, int_flags):
     return jax.jit(run)
 
 
+_build_summary = _entry_cache("build_summary", _build_summary_factory)
+
+
 def build_summary_jit(build, key_cols, int_flags):
     """One fused device reduction for everything the executor needs to
     know about a drained join build: [live_count, (lo, hi) per key].
@@ -205,10 +275,11 @@ def build_summary_jit(build, key_cols, int_flags):
 from .join import expand_match_origins, unique_match_build_mask  # noqa: E402
 
 
-@functools.lru_cache(maxsize=None)
-def _unique_match_build(pkeys, bkeys):
-    return jax.jit(lambda p, b, s, prep: unique_match_build_mask(
-        p, b, pkeys, bkeys, s, prepared=prep))
+_unique_match_build = _entry_cache(
+    "unique_match_build_mask",
+    lambda pkeys, bkeys: jax.jit(
+        lambda p, b, s, prep: unique_match_build_mask(
+            p, b, pkeys, bkeys, s, prepared=prep)))
 
 
 def unique_match_build_mask_jit(probe, build, probe_keys, build_keys,
@@ -217,10 +288,11 @@ def unique_match_build_mask_jit(probe, build, probe_keys, build_keys,
         probe, build, survived, prepared)
 
 
-@functools.lru_cache(maxsize=None)
-def _expand_origins(pkeys, bkeys, k):
-    return jax.jit(lambda p, b, prep: expand_match_origins(
-        p, b, pkeys, bkeys, k, prepared=prep))
+_expand_origins = _entry_cache(
+    "expand_match_origins",
+    lambda pkeys, bkeys, k: jax.jit(
+        lambda p, b, prep: expand_match_origins(
+            p, b, pkeys, bkeys, k, prepared=prep)))
 
 
 def expand_match_origins_jit(probe, build, probe_keys, build_keys,

@@ -40,6 +40,7 @@ BROADCAST_ROW_LIMIT = 2_000_000
 
 def optimize(plan: LogicalPlan, session: Session) -> LogicalPlan:
     from .rules import iterative_optimize
+    from .stats import StatsCalculator
 
     def pipeline(node: PlanNode) -> PlanNode:
         # iterative simplify/merge/push rules to a fixpoint (reference
@@ -53,8 +54,16 @@ def optimize(plan: LogicalPlan, session: Session) -> LogicalPlan:
                          True):
             node = _push_partial_agg_through_join(node, session)
         return _attach_scan_pushdown(node)
-    root = pipeline(plan.root)
-    init = [pipeline(p) for p in plan.init_plans]
+    # one memoized StatsCalculator for the whole pass: join ordering,
+    # distribution choice, and the eager-agg gate all estimate the same
+    # subtrees, and connector table_stats can be full-scan priced
+    # (sqlite) — per-call calculators re-derived everything (ADVICE r5)
+    token = _PASS_CALC.set(StatsCalculator(session))
+    try:
+        root = pipeline(plan.root)
+        init = [pipeline(p) for p in plan.init_plans]
+    finally:
+        _PASS_CALC.reset(token)
     return LogicalPlan(root, init)
 
 
@@ -256,13 +265,29 @@ def _coerce_ref(idx: int, t: T.Type, to: T.Type) -> ir.Expr:
     return r if t == to else ir.cast(r, to)
 
 
+import contextvars
+
+#: the optimization pass's shared StatsCalculator (set by optimize());
+#: estimates outside a pass fall back to a throwaway calculator
+_PASS_CALC: contextvars.ContextVar = contextvars.ContextVar(
+    "presto_tpu_stats_calc", default=None)
+
+
+def _stats_calc(session: Session):
+    calc = _PASS_CALC.get()
+    if calc is not None and calc.session is session:
+        return calc
+    from .stats import StatsCalculator
+    return StatsCalculator(session)
+
+
 def _estimate_rows(node: PlanNode, session: Session) -> float:
     """Row estimate via the stats calculus (planner/stats.py): scan
     statistics propagated through filter selectivities (range/NDV math),
     join containment, and group NDV products — the reference's
-    cost/StatsCalculator.java role."""
-    from .stats import StatsCalculator
-    return StatsCalculator(session).rows(node)
+    cost/StatsCalculator.java role. Memoized across the optimization
+    pass via _PASS_CALC."""
+    return _stats_calc(session).rows(node)
 
 
 def _plan_join_graph(join: JoinNode, extra_preds: List[ir.Expr],
@@ -743,8 +768,7 @@ def _column_distinct(node: PlanNode, idx: int,
     """Distinct-count estimate for one output column via the stats
     calculus (NDV propagated from scan statistics, capped by filtered
     row counts) — the eager-aggregation gate's input."""
-    from .stats import StatsCalculator
-    calc = StatsCalculator(session)
+    calc = _stats_calc(session)
     d = calc.estimate(node).column(idx).distinct
     return min(d, calc.rows(node)) if d is not None else None
 

@@ -62,6 +62,21 @@ class Session:
     last_memory_stats: object = None
 
 
+def _schema_exists(session: "Session", schema: str) -> bool:
+    """True when the session catalog exposes ``schema`` (or a view is
+    registered under it) — the gate for reference-style schema-first
+    two-part name resolution (ADVICE r5: a schema named like a mounted
+    catalog must not be silently shadowed)."""
+    try:
+        conn = session.catalogs.get(session.catalog)
+        if schema in conn.metadata.list_schemas():
+            return True
+    except Exception:
+        pass
+    return any(k[0] == session.catalog and k[1] == schema
+               for k in session.views)
+
+
 def bool_property(session: "Session", name: str, default: bool) -> bool:
     """Session properties arrive as strings from SET SESSION / HTTP
     headers; parse the usual spellings instead of trusting truthiness.
@@ -318,13 +333,17 @@ class _Planner:
             catalog, schema, table = (self.session.catalog,
                                       self.session.schema, name[0])
         elif len(name) == 2:
-            if self.session.catalogs.exists(name[0]):
-                # two-part qualifier naming a mounted catalog resolves
-                # catalog-first (catalog.table in its default schema) —
-                # same rule as the write path (_writable), so the same
-                # name reads and writes one table
+            if (self.session.catalogs.exists(name[0])
+                    and not _schema_exists(self.session, name[0])):
+                # the qualifier names a mounted catalog AND no schema of
+                # the session catalog shadows it: resolve catalog-first
+                # (catalog.table in its default schema) — same rule as
+                # the write path (_writable), so the same name reads and
+                # writes one table
                 catalog, schema, table = name[0], "default", name[1]
             else:
+                # reference semantics (StatementAnalyzer name
+                # resolution): x.y is schema x in the session catalog
                 catalog, schema, table = (self.session.catalog, name[0],
                                           name[1])
         else:

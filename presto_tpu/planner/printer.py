@@ -117,6 +117,27 @@ def print_plan(plan: LogicalPlan, stats=None) -> str:
     return "\n".join(lines)
 
 
+def format_trace_summary(spans) -> str:
+    """Trace section appended to EXPLAIN ANALYZE when the tracer is on:
+    spans aggregated by name (count, total/max ms), compile and
+    device-sync work called out the way the reference's query stats
+    separate blocked/compile time from operator wall."""
+    agg = {}
+    for s in spans:
+        name = s.get("name", "?")
+        dur = (float(s.get("end", 0.0)) - float(s.get("start", 0.0)))
+        st = agg.setdefault(name, [0, 0.0, 0.0])
+        st[0] += 1
+        st[1] += dur
+        st[2] = max(st[2], dur)
+    lines = ["Trace (spans by name):"]
+    for name in sorted(agg, key=lambda n: -agg[n][1]):
+        n, total, peak = agg[name]
+        lines.append(f"  {name:<32} x{n:<5} total "
+                     f"{total * 1e3:,.1f}ms, max {peak * 1e3:,.1f}ms")
+    return "\n".join(lines)
+
+
 def _label(n: PlanNode) -> str:
     cols = ", ".join(f"{f.name}:{f.type.display()}" for f in n.fields)
     if isinstance(n, TableScanNode):

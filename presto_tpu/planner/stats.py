@@ -160,15 +160,20 @@ class StatsCalculator:
 
     def __init__(self, session):
         self.session = session
-        self._memo: Dict[int, PlanEstimate] = {}
+        # memo holds the node alongside its estimate: entries are keyed
+        # by id(), and keeping the reference pins the node so a
+        # garbage-collected node's id can't be reused by a new node
+        # within the same (now pass-long-lived) calculator
+        self._memo: Dict[int, tuple] = {}
 
     def estimate(self, node: PlanNode) -> PlanEstimate:
         key = id(node)
         got = self._memo.get(key)
-        if got is None:
-            got = self._compute(node)
-            self._memo[key] = got
-        return got
+        if got is not None and got[0] is node:
+            return got[1]
+        est = self._compute(node)
+        self._memo[key] = (node, est)
+        return est
 
     def rows(self, node: PlanNode) -> float:
         return self.estimate(node).rows

@@ -36,6 +36,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from ..batch import Batch
 from ..connectors.spi import CatalogManager, Split
 from ..exec import local as local_exec
+from ..obs.metrics import REGISTRY, TASKS
+from ..obs.trace import TRACER
 from ..exec.pages import deserialize_page, serialize_page, \
     serialize_partitioned
 from ..planner import codec
@@ -43,6 +45,10 @@ from ..planner.planner import Session
 from ..sql.analyzer import AnalysisError
 
 PAGES_CONTENT_TYPE = "application/x-presto-tpu-pages"
+
+_EXCHANGE_SENT_BYTES = REGISTRY.counter("exchange_sent_bytes_total")
+_EXCHANGE_SENT_PAGES = REGISTRY.counter("exchange_sent_pages_total")
+_EXCHANGE_RECV_BYTES = REGISTRY.counter("exchange_received_bytes_total")
 
 _query_handles: Dict[str, list] = {}
 _query_handles_lock = threading.Lock()
@@ -96,6 +102,8 @@ class OutputBuffer:
         self.cond = threading.Condition()
 
     def add(self, buffer_id: int, page: bytes) -> None:
+        _EXCHANGE_SENT_BYTES.inc(len(page))
+        _EXCHANGE_SENT_PAGES.inc()
         with self.cond:
             self.pages[buffer_id].append(
                 (self.next_token[buffer_id], page))
@@ -103,6 +111,8 @@ class OutputBuffer:
             self.cond.notify_all()
 
     def add_broadcast(self, page: bytes) -> None:
+        _EXCHANGE_SENT_BYTES.inc(len(page) * self.n)
+        _EXCHANGE_SENT_PAGES.inc(self.n)
         with self.cond:
             for b in range(self.n):
                 self.pages[b].append((self.next_token[b], page))
@@ -190,6 +200,7 @@ class ExchangeClient:
                     continue
                 deadline = time.monotonic() + self.timeout_s
                 for page in unframe_pages(body):
+                    _EXCHANGE_RECV_BYTES.inc(len(page))
                     self.queue.put(page)
                 if complete:
                     break
@@ -248,10 +259,17 @@ class Task:
     """One fragment execution (reference execution/SqlTask.java +
     TaskStateMachine states PLANNED/RUNNING/FINISHED/FAILED/ABORTED)."""
 
-    def __init__(self, task_id: str, doc: dict, catalogs: CatalogManager):
+    def __init__(self, task_id: str, doc: dict, catalogs: CatalogManager,
+                 node_id: str = ""):
         self.task_id = task_id
+        self.node_id = node_id
         self.state = "PLANNED"
         self.error: Optional[str] = None
+        #: wire-carried span context (coordinator trace/parent ids) so
+        #: this task's spans stitch into the query trace
+        self.trace_ctx = doc.get("trace")
+        self.started_at: Optional[float] = None
+        self.elapsed_ms = 0.0
         self.root = codec.decode(doc["fragment"])
         self.output_kind = doc["output"]["kind"]
         self.output_keys = list(doc["output"].get("keys", ()))
@@ -269,9 +287,36 @@ class Task:
         self.init_values = list(codec.decode(doc.get("init_values", [])))
         self.rows_per_batch = int(doc.get("rows_per_batch", 1 << 17))
         self._thread = threading.Thread(target=self._run, daemon=True)
+        self._register()
+
+    def _task_ids(self):
+        """(query_id, stage_id) parsed from 'qid.fid.part'."""
+        parts = self.task_id.split(".")
+        qid = parts[0]
+        fid = int(parts[1]) if len(parts) > 2 and parts[1].isdigit() else 0
+        return qid, fid
+
+    def _register(self) -> None:
+        qid, fid = self._task_ids()
+        TASKS.update(self.task_id, query_id=qid, stage_id=fid,
+                     partition=self.partition, node_id=self.node_id,
+                     state=self.state, elapsed_ms=self._elapsed_now())
+
+    def _elapsed_now(self) -> float:
+        """Live elapsed for RUNNING tasks; frozen value once terminal."""
+        if self.state == "RUNNING" and self.started_at is not None:
+            return (time.monotonic() - self.started_at) * 1e3
+        return self.elapsed_ms
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        if self.started_at is not None:
+            self.elapsed_ms = (time.monotonic() - self.started_at) * 1e3
+        self._register()
 
     def start(self) -> None:
-        self.state = "RUNNING"
+        self.started_at = time.monotonic()
+        self._set_state("RUNNING")
         self._thread.start()
 
     def _run(self) -> None:
@@ -279,52 +324,69 @@ class Task:
         # each other pages and must never serialize behind their own
         # query's scheduler turn (reference TaskExecutor groups splits
         # under a per-task TaskHandle the same way)
-        handle = _query_handle(self.task_id.split(".")[0])
+        qid, fid = self._task_ids()
+        handle = _query_handle(qid)
         try:
-            ex = _TaskExecutor(self.session, self.rows_per_batch,
-                               self.splits, self.sources, self.partition)
-            self.pool = ex.pool      # visible to /v1/info memory report
-            ex.init_values = self.init_values
-            ex.mark_shared([self.root])
-            # fair device scheduling across concurrent tasks: one quantum
-            # per produced batch (reference TaskExecutor time slicing)
-            it = ex.run(self.root)
-            sentinel = object()
-            while True:
-                batch = handle.scheduler.run_quantum(
-                    handle, lambda: next(it, sentinel))
-                if batch is sentinel:
-                    break
-                if batch.host_count() == 0:
-                    continue
-                if self.output_kind == "partition":
-                    pages = serialize_partitioned(
-                        batch, self.output_keys, self.buffer.n)
-                    for b, page in enumerate(pages):
-                        if page is not None:
-                            self.buffer.add(b, page)
-                elif self.output_kind == "broadcast":
-                    self.buffer.add_broadcast(serialize_page(batch))
-                else:   # single
-                    self.buffer.add(0, serialize_page(batch))
-            ex.check_errors()
+            with TRACER.task_span(self.trace_ctx, "task",
+                                  task_id=self.task_id, query_id=qid,
+                                  stage_id=fid,
+                                  partition=self.partition,
+                                  node_id=self.node_id):
+                ex = _TaskExecutor(self.session, self.rows_per_batch,
+                                   self.splits, self.sources,
+                                   self.partition)
+                self.pool = ex.pool  # visible to /v1/info memory report
+                ex.init_values = self.init_values
+                ex.mark_shared([self.root])
+                # fair device scheduling across concurrent tasks: one
+                # quantum per produced batch (reference TaskExecutor
+                # time slicing)
+                it = ex.run(self.root)
+                sentinel = object()
+                while True:
+                    batch = handle.scheduler.run_quantum(
+                        handle, lambda: next(it, sentinel))
+                    if batch is sentinel:
+                        break
+                    if batch.host_count() == 0:
+                        continue
+                    if self.output_kind == "partition":
+                        pages = serialize_partitioned(
+                            batch, self.output_keys, self.buffer.n)
+                        for b, page in enumerate(pages):
+                            if page is not None:
+                                self.buffer.add(b, page)
+                    elif self.output_kind == "broadcast":
+                        self.buffer.add_broadcast(serialize_page(batch))
+                    else:   # single
+                        self.buffer.add(0, serialize_page(batch))
+                ex.check_errors()
             self.buffer.finish()
-            self.state = "FINISHED"
+            self._set_state("FINISHED")
         except Exception as e:   # noqa: BLE001 - reported to coordinator
             self.error = f"{type(e).__name__}: {e}"
-            self.state = "FAILED"
+            self._set_state("FAILED")
             self.buffer.fail(self.error)
         finally:
-            _release_query_handle(self.task_id.split(".")[0])
+            _release_query_handle(qid)
 
     def abort(self) -> None:
         if self.state in ("PLANNED", "RUNNING"):
-            self.state = "ABORTED"
+            self._set_state("ABORTED")
             self.buffer.fail("task aborted")
 
-    def status(self) -> dict:
-        return {"taskId": self.task_id, "state": self.state,
-                "error": self.error}
+    def status(self, include_spans: bool = False) -> dict:
+        doc = {"taskId": self.task_id, "state": self.state,
+               "error": self.error,
+               "elapsedMs": round(self._elapsed_now(), 1)}
+        self._register()     # status polls refresh system.runtime.tasks
+        if include_spans and isinstance(self.trace_ctx, dict):
+            # span harvest: the coordinator pulls this worker's spans for
+            # the query's trace after completion and merges them into its
+            # own ring (dedup by span id — in-process workers share it)
+            doc["spans"] = TRACER.export(
+                trace_id=self.trace_ctx.get("traceId"))
+        return doc
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -355,7 +417,8 @@ class _Handler(BaseHTTPRequestHandler):
             if task is None:
                 self._json(404, {"error": "no such task"})
                 return
-            self._json(200, task.status())
+            self._json(200, task.status(
+                include_spans="spans=1" in self.path))
             return
         if (parts[:2] == ["v1", "task"] and len(parts) == 6
                 and parts[3] == "results"):
@@ -478,7 +541,7 @@ class WorkerServer:
         existing = self.tasks.get(task_id)
         if existing is not None:
             return existing
-        task = Task(task_id, doc, self.catalogs)
+        task = Task(task_id, doc, self.catalogs, node_id=self.node_id)
         self.tasks[task_id] = task
         task.start()
         return task

@@ -11,6 +11,10 @@ from presto_tpu.exec.runner import LocalRunner
 
 from tpch_queries import Q as TPCH_QUERIES
 
+# minutes of shard_map compiles even with a warm persistent cache: out
+# of the serial tier-1 time budget (run explicitly, or with xdist)
+pytestmark = pytest.mark.slow
+
 SF = 0.01
 
 #: every TPC-H query the suite carries runs on the mesh — parity with

@@ -12,6 +12,10 @@ import pytest
 from presto_tpu.exec.distributed import DistributedRunner
 from presto_tpu.exec.runner import LocalRunner
 
+# minutes of shard_map compiles even with a warm persistent cache: out
+# of the serial tier-1 time budget (run explicitly, or with xdist)
+pytestmark = pytest.mark.slow
+
 from tpcds_queries import Q as TPCDS_QUERIES
 from test_distributed import _norm
 

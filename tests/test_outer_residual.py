@@ -3,6 +3,8 @@ SQLite oracle: the filter gates matches but never drops probe rows, and
 a FULL join's unmatched-build tail counts only residual-surviving
 matches (reference operator/LookupJoinOperator.java +
 sql/gen/JoinFilterFunctionCompiler.java)."""
+import sqlite3
+
 import pytest
 
 from test_sql import compare, oracle, runner  # noqa: F401 (fixtures)
@@ -36,6 +38,11 @@ QUERIES = [
 
 @pytest.mark.parametrize("sql", QUERIES, ids=range(len(QUERIES)))
 def test_outer_residual_matches_oracle(runner, oracle, sql):
+    if "full outer" in sql and sqlite3.sqlite_version_info < (3, 39):
+        # the ORACLE can't check this one: sqlite grew FULL OUTER JOIN
+        # in 3.39 (the engine side is covered by
+        # test_outer_residual_distributed and test_full_outer.py)
+        pytest.skip("oracle sqlite < 3.39 lacks FULL OUTER JOIN")
     compare(runner, oracle, sql, rel=1e-9)
 
 
