@@ -91,6 +91,7 @@ def _stage(conn, table, cols, rows_per_batch, device: bool):
         mask_np = np.ones(cn, dtype=bool)
         host.append(tuple(arrays) + (mask_np,))
         n += cn
+    vocabs = dicts
     dev = []
     if device:
         # chunk the device copy at 2^23 rows: one 2^26-capacity batch made
@@ -107,7 +108,7 @@ def _stage(conn, table, cols, rows_per_batch, device: bool):
             dev.append(Batch.from_arrays(
                 schema, [a[lo:lo + cn] for a in arrays],
                 dictionaries=dicts, num_rows=cn))
-    return dev, host, n, schema
+    return dev, host, n, schema, vocabs
 
 
 def _time(fn):
@@ -115,6 +116,56 @@ def _time(fn):
     t0 = time.perf_counter()
     got = fn()
     return got, time.perf_counter() - t0
+
+
+#: proxy repetitions for the CURRENT config — set by main() per config:
+#: 1 when a pinned proxy time exists (the pin carries the ratio), else 3
+_PROXY_RUNS = 3
+
+
+def _time_proxy(fn):
+    """Warmup + best-of-N wall clock for the NumPy proxy. The proxy runs
+    on a SHARED host: a contention spike on one run used to swing
+    `vs_baseline` 2-3x between rounds (docs/perf.md) — min-of-N rejects
+    the spikes, and main() additionally pins the first clean measurement
+    in BASELINE_PROXY.json so later rounds' gate numbers move only when
+    the ENGINE moves."""
+    got, best = _time(fn)
+    for _ in range(max(0, _PROXY_RUNS - 1)):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return got, best
+
+
+_PROXY_PIN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BASELINE_PROXY.json")
+
+
+def _load_proxy_pins() -> dict:
+    try:
+        with open(_PROXY_PIN_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _pin_proxy_seconds(metric: str, measured: float) -> float:
+    """Proxy-seconds used for the gate ratio: the committed pin when one
+    exists (so the ratio can't swing with host contention), else the
+    fresh measurement — which is then written back so the NEXT run is
+    pinned. BENCH_REPIN=1 forces re-measurement to take over the pin."""
+    pins = _load_proxy_pins()
+    if metric in pins and not os.environ.get("BENCH_REPIN"):
+        return float(pins[metric])
+    pins[metric] = round(measured, 4)
+    try:
+        with open(_PROXY_PIN_PATH, "w") as f:
+            json.dump(pins, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    return measured
 
 
 # ---------------------------------------------------------------------------
@@ -131,8 +182,8 @@ def bench_q6(sf: float):
     import __graft_entry__ as ge
 
     conn = TpchConnector(sf=sf)
-    dev, host, total, _ = _stage(conn, "lineitem", ge._Q6_COLS, 1 << 20,
-                                 True)
+    dev, host, total, _, _ = _stage(conn, "lineitem", ge._Q6_COLS,
+                                    1 << 20, True)
 
     schema, pred, proj = ge._q6_exprs()
     filt = compile_filter(pred, schema)
@@ -164,7 +215,7 @@ def bench_q6(sf: float):
         return acc
 
     got, dev_s = _time(run_device)
-    want, np_s = _time(run_numpy)
+    want, np_s = _time_proxy(run_numpy)
     assert abs(got - want) <= 1e-8 * max(abs(want), 1.0), (got, want)
     return total, dev_s, np_s
 
@@ -185,8 +236,8 @@ def bench_q1(sf: float):
     from presto_tpu.ops.aggregation import AggSpec, grouped_aggregate
 
     conn = TpchConnector(sf=sf)
-    dev, host, total, schema = _stage(conn, "lineitem", _Q1_COLS, 1 << 20,
-                                      True)
+    dev, host, total, schema, _ = _stage(conn, "lineitem", _Q1_COLS,
+                                         1 << 20, True)
     rf_vocab = dev[0].columns[0].dictionary
     ls_vocab = dev[0].columns[1].dictionary
 
@@ -256,7 +307,7 @@ def bench_q1(sf: float):
         return sums
 
     out, dev_s = _time(run_device)
-    want, np_s = _time(run_numpy)
+    want, np_s = _time_proxy(run_numpy)
     got = {(rf_vocab.index(r[0]), ls_vocab.index(r[1])): r[2:]
            for r in out.to_pylist()}
     assert set(got) == set(want), (sorted(got), sorted(want))
@@ -299,10 +350,13 @@ def bench_q3(sf: float):
     c_cols = ["c_custkey", "c_mktsegment"]
     # lineitem beyond ~SF20 would not fit on one chip: stream from host
     li_device = sf <= 20
-    li_dev, li_host, n_li, li_schema = _stage(conn, "lineitem", li_cols,
-                                              1 << 20, li_device)
-    o_dev, o_host, n_o, _ = _stage(conn, "orders", o_cols, 1 << 20, True)
-    c_dev, c_host, n_c, _ = _stage(conn, "customer", c_cols, 1 << 20, True)
+    li_dev, li_host, n_li, li_schema, _ = _stage(conn, "lineitem",
+                                                 li_cols, 1 << 20,
+                                                 li_device)
+    o_dev, o_host, n_o, _, _ = _stage(conn, "orders", o_cols, 1 << 20,
+                                      True)
+    c_dev, c_host, n_c, _, _ = _stage(conn, "customer", c_cols, 1 << 20,
+                                      True)
     total = n_li + n_o + n_c
     seg_code = c_dev[0].columns[1].dictionary.index("BUILDING")
 
@@ -430,10 +484,83 @@ def bench_q3(sf: float):
                                        bdate[nz][order], bprio[nz][order])]
 
     got, dev_s = _time(run_device)
-    want, np_s = _time(run_numpy)
+    want, np_s = _time_proxy(run_numpy)
     assert len(got) == len(want), (got, want)
     for g, w in zip(got, want):
         assert g[0] == w[0] and abs(g[1] - w[1]) <= 1e-6 * abs(w[1]), (g, w)
+    return total, dev_s, np_s
+
+
+# ---------------------------------------------------------------------------
+# Q1 through the ENGINE SQL path: parse -> plan -> optimize -> execute.
+# The hand pipeline above proves the kernels; this config makes the
+# planner/executor overhead on TPC-H visible to the gate (VERDICT.md
+# weak point 2 — previously only the TPC-DS configs exercised it).
+# ---------------------------------------------------------------------------
+
+_TPCH_Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+  sum(l_extendedprice) as sum_base_price,
+  sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+  sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+  avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+  avg(l_discount) as avg_disc, count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+
+def bench_q1sql(sf: float):
+    from presto_tpu.connectors.spi import CatalogManager
+    from presto_tpu.connectors.tpch import TpchConnector
+    from presto_tpu.exec.runner import LocalRunner
+
+    conn = TpchConnector(sf=sf)
+    catalogs = CatalogManager()
+    catalogs.register("tpch", _CachingConnector(conn))
+    runner = LocalRunner(catalogs=catalogs, catalog="tpch",
+                         rows_per_batch=1 << 20)
+    _, host, total, _, vocabs = _stage(conn, "lineitem", _Q1_COLS,
+                                       1 << 20, False)
+    rf_vocab, ls_vocab = vocabs[0], vocabs[1]
+
+    def run_engine():
+        return runner.execute(_TPCH_Q1).rows
+
+    def run_numpy():
+        sums = {}
+        for (rf, ls, qty, price, disc, tax, ship, mask) in host:
+            m = mask & (ship <= D_Q1)
+            qty2, price2, disc2, tax2 = (np.round(c, 2)
+                                         for c in (qty, price, disc, tax))
+            for code_rf in range(len(rf_vocab)):
+                for code_ls in range(len(ls_vocab)):
+                    g = m & (rf == code_rf) & (ls == code_ls)
+                    if not g.any():
+                        continue
+                    dp = price2[g] * (1.0 - disc2[g])
+                    ch = dp * (1.0 + tax2[g])
+                    acc = sums.setdefault((code_rf, code_ls), np.zeros(6))
+                    acc += [qty2[g].sum(), price2[g].sum(), dp.sum(),
+                            ch.sum(), disc2[g].sum(), g.sum()]
+        rows = []
+        for (crf, cls_), a in sums.items():
+            n = a[5]
+            rows.append((rf_vocab[crf], ls_vocab[cls_], a[0], a[1], a[2],
+                         a[3], a[0] / n, a[1] / n, a[4] / n, int(n)))
+        rows.sort(key=lambda r: (r[0], r[1]))
+        return rows
+
+    got, dev_s = _time(run_engine)
+    want, np_s = _time_proxy(run_numpy)
+    assert len(got) == len(want), (got, want)
+    for g, w in zip(got, want):
+        assert (str(g[0]), str(g[1])) == (w[0], w[1]), (g, w)
+        for gv, wv in zip(g[2:9], w[2:9]):
+            assert abs(float(gv) - wv) <= 1e-6 * max(abs(wv), 1.0), (g, w)
+        assert int(g[9]) == w[9], (g, w)
     return total, dev_s, np_s
 
 
@@ -578,7 +705,7 @@ def bench_q55(sf: float):
         return rows
 
     got, dev_s = _time(run_engine)
-    want, np_s = _time(run_numpy)
+    want, np_s = _time_proxy(run_numpy)
     assert len(got) == len(want), (got[:3], want[:3])
     for g, w in zip(got, want):
         assert int(g[0]) == w[0] and str(g[1]) == w[1], (g, w)
@@ -674,7 +801,7 @@ def bench_q27(sf: float):
         return rows[:100]
 
     got, dev_s = _time(run_engine)
-    want, np_s = _time(run_numpy)
+    want, np_s = _time_proxy(run_numpy)
     assert len(got) == len(want), (len(got), len(want))
     for g, w in zip(got, want):
         assert (g[0], g[1], int(g[2])) == (w[0], w[1], w[2]), (g, w)
@@ -693,6 +820,7 @@ def main() -> None:
     sf_q6 = float(os.environ.get("BENCH_SF_Q6",
                                  os.environ.get("BENCH_SF", "10")))
     sf_q1 = float(os.environ.get("BENCH_SF_Q1", "10"))
+    sf_q1sql = float(os.environ.get("BENCH_SF_Q1SQL", "10"))
     sf_q3 = float(os.environ.get("BENCH_SF_Q3", "10"))
     # SF10 default for the TPC-DS macro configs (BASELINE config 4 names
     # SF100): at SF1 the ~100ms tunnel RTT and per-operator dispatch
@@ -730,9 +858,11 @@ def main() -> None:
         print(json.dumps(headline), flush=True)
 
     results = []
+    global _PROXY_RUNS
     for name, sf, fn, prefix in (
             ("q6", sf_q6, bench_q6, "tpch"),
             ("q1", sf_q1, bench_q1, "tpch"),
+            ("q1sql", sf_q1sql, bench_q1sql, "tpch"),
             ("q3", sf_q3, bench_q3, "tpch"),
             ("q55", sf_ds, bench_q55, "tpcds"),
             ("q27", sf_ds, bench_q27, "tpcds")):
@@ -743,6 +873,12 @@ def main() -> None:
             continue
         print(f"[bench] {name} sf={sf:g} starting at {elapsed:.0f}s",
               file=sys.stderr, flush=True)
+        metric = f"{prefix}_sf{sf:g}_{name}_rows_per_sec"
+        # pinned proxy: one measured run suffices (results still verify);
+        # unpinned — or re-pinning — runs best-of-3 to reject
+        # host-contention spikes before the value is frozen
+        _PROXY_RUNS = (1 if metric in _load_proxy_pins()
+                       and not os.environ.get("BENCH_REPIN") else 3)
         # per-config watchdog: one pathological compile/run must not eat
         # every later config's slot NOR push the whole process past the
         # driver's kill timeout (completed numbers stay reportable)
@@ -757,13 +893,17 @@ def main() -> None:
         finally:
             if alarm_ok:
                 signal.alarm(0)
+        pinned_s = _pin_proxy_seconds(metric, np_s)
         print(f"[bench] {name} done: {round(total / dev_s):,} rows/s "
-              f"(vs {np_s / dev_s:.2f})", file=sys.stderr, flush=True)
+              f"(vs {pinned_s / dev_s:.2f}, measured proxy {np_s:.2f}s, "
+              f"pinned {pinned_s:.2f}s)", file=sys.stderr, flush=True)
         results.append({
-            "metric": f"{prefix}_sf{sf:g}_{name}_rows_per_sec",
+            "metric": metric,
             "value": round(total / dev_s),
             "unit": "rows/s",
-            "vs_baseline": round(np_s / dev_s, 3),
+            "vs_baseline": round(pinned_s / dev_s, 3),
+            "proxy_s_pinned": round(pinned_s, 4),
+            "proxy_s_measured": round(np_s, 4),
         })
         emit(results)
 

@@ -643,11 +643,131 @@ class _Metadata(ConnectorMetadata):
     }
 
     def table_stats(self, table: TableHandle) -> TableStats:
+        """Row counts plus EXACT per-column min/max and distinct counts
+        for the generated key and low-cardinality columns. The
+        generators are stateless functions of the surrogate key, so
+        these bounds are true by construction (surrogate keys are dense
+        1..n; fact foreign keys are uniform over the referenced
+        domain) — which is exactly what lets the optimizer treat them
+        as HARD bounds for the dense scatter group-by
+        (optimizer._attach_group_bounds) and lets the greedy join order
+        rank dimensions by real selectivity instead of bare size."""
         t = table.table
         n = float(_rows(t, self.sf))
-        cols: Dict[str, ColumnStats] = {}
+
+        import math
+
+        def sk(lo: int, hi: int, d: Optional[float] = None,
+               draws: bool = False) -> ColumnStats:
+            # ``draws``: the column is n uniform draws from the domain
+            # (fact foreign keys), so publish the expected distinct count
+            # E[d] = domain * (1 - (1 - 1/domain)^n). Publishing the raw
+            # domain size would overstate NDV past the row count at small
+            # scale factors and trip the optimizer's near-unique
+            # heuristic (_key_unique's 0.999 * rows test) on foreign
+            # keys that DO repeat — a silently wrong unique-build join.
+            # Non-draw columns (dense surrogate ranges, calendar fields)
+            # publish their exact domain cardinality.
+            domain = float(d if d is not None else hi - lo + 1)
+            est = domain
+            if draws and domain > 1:
+                est = domain * -math.expm1(n * math.log1p(-1.0 / domain))
+            return ColumnStats(distinct_count=min(est, domain, n),
+                               min_value=lo, max_value=hi)
+
+        date_lo, date_hi = D_BASE_SK, D_BASE_SK + D_DAYS - 1
+        sales_days = SALES_D1 - SALES_D0
+        # one thunk per table so a stats call prices ONLY the requested
+        # table (planning a 5-table query calls this once per table per
+        # optimization pass; building all ten tables' ColumnStats each
+        # time was ~10x dead work, and sk()'s draw math uses THIS
+        # table's row count, so cross-table entries were wrong anyway)
+        per_table: Dict[str, object] = {
+            "store_sales": lambda: {
+                "ss_sold_date_sk": sk(D_BASE_SK + SALES_D0,
+                                      D_BASE_SK + SALES_D1 - 1,
+                                      sales_days, draws=True),
+                "ss_sold_time_sk": sk(0, 86_399, draws=True),
+                "ss_item_sk": sk(1, _rows("item", self.sf), draws=True),
+                "ss_customer_sk": sk(1, _rows("customer", self.sf),
+                                     draws=True),
+                "ss_cdemo_sk": sk(1, _rows("customer_demographics",
+                                           self.sf), draws=True),
+                "ss_hdemo_sk": sk(1, _rows("household_demographics",
+                                           self.sf), draws=True),
+                "ss_addr_sk": sk(1, _rows("customer_address", self.sf),
+                                 draws=True),
+                "ss_store_sk": sk(1, _rows("store", self.sf), draws=True),
+                "ss_promo_sk": sk(1, _rows("promotion", self.sf),
+                                  draws=True),
+                "ss_quantity": sk(1, 100, draws=True),
+            },
+            "date_dim": lambda: {
+                "d_date_sk": sk(date_lo, date_hi),
+                "d_year": sk(1900, 2100, 201),
+                "d_moy": sk(1, 12),
+                "d_dom": sk(1, 31),
+                "d_qoy": sk(1, 4),
+            },
+            "item": lambda: {
+                "i_item_sk": sk(1, _rows("item", self.sf)),
+                "i_brand_id": sk(1, 1000, min(1000.0, n)),
+                "i_brand": ColumnStats(distinct_count=min(1000.0, n)),
+                "i_manufact_id": sk(1, 1000, min(1000.0, n)),
+                "i_manager_id": sk(1, 100, min(100.0, n)),
+                "i_category_id": sk(1, len(CATEGORIES)),
+                "i_category": ColumnStats(
+                    distinct_count=float(len(CATEGORIES))),
+            },
+            "store": lambda: {
+                "s_store_sk": sk(1, _rows("store", self.sf)),
+                "s_state": ColumnStats(distinct_count=float(
+                    len(dict.fromkeys(STATES)))),
+            },
+            "customer_demographics": lambda: {
+                "cd_demo_sk": sk(1, _rows("customer_demographics",
+                                          self.sf)),
+                "cd_gender": ColumnStats(
+                    distinct_count=float(len(GENDERS))),
+                "cd_marital_status": ColumnStats(
+                    distinct_count=float(len(MARITAL))),
+                "cd_education_status": ColumnStats(
+                    distinct_count=float(len(EDUCATION))),
+                "cd_purchase_estimate": sk(500, 500 * CD_PURCHASE_MAX,
+                                           CD_PURCHASE_MAX),
+                "cd_credit_rating": ColumnStats(
+                    distinct_count=float(len(CREDIT_RATING))),
+                "cd_dep_count": sk(0, 6),
+            },
+            "customer": lambda: {
+                "c_customer_sk": sk(1, _rows("customer", self.sf)),
+                "c_current_cdemo_sk": sk(1, _rows(
+                    "customer_demographics", self.sf), draws=True),
+                "c_current_addr_sk": sk(1, _rows("customer_address",
+                                                 self.sf), draws=True),
+            },
+            "customer_address": lambda: {
+                "ca_address_sk": sk(1, _rows("customer_address",
+                                             self.sf)),
+            },
+            "household_demographics": lambda: {
+                "hd_demo_sk": sk(1, _rows("household_demographics",
+                                          self.sf)),
+            },
+            "promotion": lambda: {
+                "p_promo_sk": sk(1, _rows("promotion", self.sf)),
+            },
+            "time_dim": lambda: {
+                "t_time_sk": sk(0, 86_399),
+            },
+        }
+        thunk = per_table.get(t)
+        cols: Dict[str, ColumnStats] = dict(thunk()) if thunk else {}
+        schema_cols = {c for c, _ in _SCHEMAS.get(t, ())}
+        cols = {c: s for c, s in cols.items() if c in schema_cols}
         for pk in self._PRIMARY_KEYS.get(t, ()):
-            cols[pk] = ColumnStats(distinct_count=n)
+            if pk not in cols:
+                cols[pk] = ColumnStats(distinct_count=n)
         return TableStats(row_count=n, columns=cols,
                           primary_key=self._PRIMARY_KEYS.get(t, ()))
 

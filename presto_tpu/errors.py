@@ -15,12 +15,18 @@ DIVISION_BY_ZERO = 1
 NUMERIC_VALUE_OUT_OF_RANGE = 2
 INVALID_FUNCTION_ARGUMENT = 3
 GENERIC_USER_ERROR = 4
+# a group key fell outside the range its connector statistics promised
+# (stats-bounded dense grouping, optimizer._attach_group_bounds): the
+# dense slot code would be garbage, so the query fails loudly instead of
+# returning misgrouped rows
+STATS_BOUND_VIOLATION = 5
 
 ERROR_NAMES = {
     DIVISION_BY_ZERO: "DIVISION_BY_ZERO",
     NUMERIC_VALUE_OUT_OF_RANGE: "NUMERIC_VALUE_OUT_OF_RANGE",
     INVALID_FUNCTION_ARGUMENT: "INVALID_FUNCTION_ARGUMENT",
     GENERIC_USER_ERROR: "GENERIC_USER_ERROR",
+    STATS_BOUND_VIOLATION: "STATS_BOUND_VIOLATION",
 }
 
 

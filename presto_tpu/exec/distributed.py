@@ -61,7 +61,7 @@ from ..planner.plan import (
     OutputNode, PlanNode, ProjectNode, SemiJoinNode, SortNode,
     TableScanNode, TopNNode, UnionNode, ValuesNode,
 )
-from ..planner.planner import LogicalPlan, Session
+from ..planner.planner import LogicalPlan, Session, bool_property
 from .local import QueryResult, _Executor, _plan_schema
 
 
@@ -291,6 +291,8 @@ class DistributedExecutor(_Executor):
             yield self._global_agg(node, aggs)
             return
         key_idx = list(range(len(group)))
+        allow_dense = bool_property(self.session, "dense_grouping", True)
+        kb = tuple(node.key_bounds) if node.key_bounds else None
         # fragment steps (the optimizer's eager-aggregation rewrite
         # pre-splits some aggregations): PARTIAL consumes raw rows and
         # yields shard-local state, FINAL consumes state rows, SINGLE
@@ -299,11 +301,24 @@ class DistributedExecutor(_Executor):
         step = node.step
 
         partial_fn = self._smap(
-            lambda b: grouped_aggregate(b, group, aggs, mode="partial"), 1)
+            lambda b: grouped_aggregate(b, group, aggs, mode="partial",
+                                        key_bounds=kb,
+                                        allow_dense=allow_dense), 1)
         merge_fn = None
 
         state: Optional[Batch] = None
         for chunk in self.run(node.child):
+            if kb is not None and allow_dense and step != "final":
+                # sharded batches reduce to one replicated scalar; the
+                # flag joins the query's single end-of-run error sync.
+                # UNCONDITIONAL on this tier: per-shard dispatch depends
+                # on post-exchange quota capacities the host can't
+                # mirror, so bounds are enforced as hard invariants —
+                # an overclaimed bound fails LOUDLY here rather than
+                # risking a silent clamp in a later merge/final shard
+                from ..ops.jitcache import key_bounds_violation_jit
+                self.error_flags.append(
+                    key_bounds_violation_jit(chunk, group, kb))
             partial = (chunk if step == "final" else partial_fn(chunk))
             if state is None:
                 state = partial
@@ -312,7 +327,8 @@ class DistributedExecutor(_Executor):
                     merge_fn = self._smap(
                         lambda a, b: grouped_aggregate(
                             concat_batches([a, b]), key_idx, aggs,
-                            mode="merge"), 2)
+                            mode="merge", key_bounds=kb,
+                            allow_dense=allow_dense), 2)
                 merged = merge_fn(state, partial)
                 live = self._shard_live_max(merged)
                 cap = bucket_capacity(max(live, 1))
@@ -335,7 +351,9 @@ class DistributedExecutor(_Executor):
             return
         state = self._repartitioner(key_idx)(state)
         final_fn = self._smap(
-            lambda b: grouped_aggregate(b, key_idx, aggs, mode="final"), 1)
+            lambda b: grouped_aggregate(b, key_idx, aggs, mode="final",
+                                        key_bounds=kb,
+                                        allow_dense=allow_dense), 1)
         out = final_fn(state)
         if node.default_gids and step in ("single", "final") \
                 and out.host_count() == 0:
@@ -761,9 +779,17 @@ class DistributedExecutor(_Executor):
         if b is None:
             return
         cols = list(range(len(node.fields)))
+        allow_dense = bool_property(self.session, "dense_grouping", True)
+        kb = tuple(node.key_bounds) if node.key_bounds else None
+        if kb is not None and allow_dense:
+            # unconditional hard-invariant check — see _AggregationNode
+            from ..ops.jitcache import key_bounds_violation_jit
+            self.error_flags.append(key_bounds_violation_jit(b, cols, kb))
         b = self._repartitioner(cols)(b)
         fn = self._smap(
-            lambda x: grouped_aggregate(x, cols, [], mode="single"), 1)
+            lambda x: grouped_aggregate(x, cols, [], mode="single",
+                                        key_bounds=kb,
+                                        allow_dense=allow_dense), 1)
         yield fn(b)
 
     def _MarkDistinctNode(self, node) -> Iterator[Batch]:

@@ -38,7 +38,7 @@ from ..batch import Batch, Column, Schema
 from ..expr import ir
 from ..expr.compiler import Val, eval_expr, merge_err
 from .. import types as T  # noqa: F401  (type objects live in stage fields)
-from ..ops.join import lookup_join
+from ..ops.join import lookup_join, semi_join_mask
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,6 +75,57 @@ def _vals(batch: Batch):
     return inputs
 
 
+def _apply_stages(cur: Batch, stages, preps, builds, dyns, errs):
+    """Apply stages in order over a traced batch; joins consume
+    preps/builds/dyns positionally. Appends per-stage error scalars to
+    ``errs``; returns the resulting batch."""
+    ji = 0
+    for st in stages:
+        if isinstance(st, JoinStage):
+            if st.dyn_keys:
+                keep = cur.row_mask
+                b = dyns[ji]
+                for j, ki in enumerate(st.dyn_keys):
+                    c = cur.columns[ki]
+                    keep = keep & c.validity & (c.data >= b[j, 0]) \
+                        & (c.data <= b[j, 1])
+                cur = Batch(cur.schema, cur.columns, keep)
+            out = lookup_join(cur, builds[ji], st.lkeys, st.rkeys,
+                              st.payload, st.names, st.join_type,
+                              prepared=preps[ji])
+            cur = Batch(Schema(list(st.out_fields)), out.columns,
+                        out.row_mask)
+            ji += 1
+        elif isinstance(st, FilterStage):
+            p = eval_expr(st.pred, _vals(cur))
+            keep = cur.row_mask & p.valid & p.data
+            if p.err is not None:
+                errs.append(jnp.max(jnp.where(cur.row_mask, p.err,
+                                              jnp.int32(0))))
+            cur = Batch(cur.schema, cur.columns, keep)
+        else:  # ProjectStage
+            outs = [eval_expr(e, _vals(cur)) for e in st.exprs]
+            cols = [Column(o.type, o.data, o.valid & cur.row_mask,
+                           o.dictionary) for o in outs]
+            row_errs = merge_err(*[o.err for o in outs])
+            if row_errs is not None:
+                errs.append(jnp.max(jnp.where(cur.row_mask, row_errs,
+                                              jnp.int32(0))))
+            cur = Batch(Schema([(n, e.type) for n, e in
+                                zip(st.out_names, st.exprs)]),
+                        cols, cur.row_mask)
+    return cur
+
+
+def _merge_errs(errs) -> Optional[jnp.ndarray]:
+    if not errs:
+        return None
+    err = errs[0]
+    for e in errs[1:]:
+        err = jnp.maximum(err, e)
+    return err
+
+
 @functools.lru_cache(maxsize=None)
 def fused_pipeline(stages: Tuple[object, ...]):
     """jitted fn(probe, preps, builds, dyns) -> (Batch, err_or_None).
@@ -87,48 +138,53 @@ def fused_pipeline(stages: Tuple[object, ...]):
     """
 
     def run(probe: Batch, preps, builds, dyns):
-        cur = probe
         errs = []
-        ji = 0
-        for st in stages:
-            if isinstance(st, JoinStage):
-                if st.dyn_keys:
-                    keep = cur.row_mask
-                    b = dyns[ji]
-                    for j, ki in enumerate(st.dyn_keys):
-                        c = cur.columns[ki]
-                        keep = keep & c.validity & (c.data >= b[j, 0]) \
-                            & (c.data <= b[j, 1])
-                    cur = Batch(cur.schema, cur.columns, keep)
-                out = lookup_join(cur, builds[ji], st.lkeys, st.rkeys,
-                                  st.payload, st.names, st.join_type,
-                                  prepared=preps[ji])
-                cur = Batch(Schema(list(st.out_fields)), out.columns,
-                            out.row_mask)
-                ji += 1
-            elif isinstance(st, FilterStage):
-                p = eval_expr(st.pred, _vals(cur))
-                keep = cur.row_mask & p.valid & p.data
-                if p.err is not None:
-                    errs.append(jnp.max(jnp.where(cur.row_mask, p.err,
-                                                  jnp.int32(0))))
-                cur = Batch(cur.schema, cur.columns, keep)
-            else:  # ProjectStage
-                outs = [eval_expr(e, _vals(cur)) for e in st.exprs]
-                cols = [Column(o.type, o.data, o.valid & cur.row_mask,
-                               o.dictionary) for o in outs]
-                row_errs = merge_err(*[o.err for o in outs])
-                if row_errs is not None:
-                    errs.append(jnp.max(jnp.where(cur.row_mask, row_errs,
-                                                  jnp.int32(0))))
-                cur = Batch(Schema([(n, e.type) for n, e in
-                                    zip(st.out_names, st.exprs)]),
-                            cols, cur.row_mask)
-        err: Optional[jnp.ndarray] = None
-        if errs:
-            err = errs[0]
-            for e in errs[1:]:
-                err = jnp.maximum(err, e)
-        return cur, err
+        cur = _apply_stages(probe, stages, preps, builds, dyns, errs)
+        return cur, _merge_errs(errs)
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=None)
+def fused_prefilter(stages: Tuple[object, ...],
+                    pre_keys: Tuple[int, ...],
+                    semi_keys: Optional[Tuple[Tuple[int, ...],
+                                              Tuple[int, ...]]]):
+    """jitted fn(probe, pre_bounds, semi_build, semi_prep)
+    -> (Batch, err_or_None, live_count).
+
+    The selectivity-first head of a fused join chain: ALL the chain's
+    hoistable dynamic-filter key bounds (``pre_keys`` index the SOURCE
+    schema; ``pre_bounds`` is the aligned [m, 2] i64 traced array) are
+    evaluated on the raw source batch, then the source-side
+    filter/project stages run, then — when the first join is inner —
+    its key-membership mask (``semi_keys`` = (lkeys, rkeys)) gates the
+    lanes WITHOUT gathering any payload. Payload gathers happen in the
+    tail pipeline, after the executor compacts the surviving lanes — so
+    a selective first join no longer gathers its build columns for all
+    2^20 lanes per batch.
+
+    ``live_count`` is a TRACED scalar (no readback here): the executor
+    stacks a window of counts and syncs them in one RTT
+    (exec/local.py:_run_fused_chain), amortizing the per-batch
+    compaction liveness readback."""
+
+    def run(probe: Batch, pre_bounds, semi_build, semi_prep):
+        keep = probe.row_mask
+        for j, ki in enumerate(pre_keys):
+            c = probe.columns[ki]
+            keep = keep & c.validity & (c.data >= pre_bounds[j, 0]) \
+                & (c.data <= pre_bounds[j, 1])
+        cur = Batch(probe.schema, probe.columns, keep)
+        errs = []
+        cur = _apply_stages(cur, stages, (), (), (), errs)
+        if semi_keys is not None:
+            lkeys, rkeys = semi_keys
+            m = semi_join_mask(cur, semi_build, list(lkeys), list(rkeys),
+                               negated=False, null_aware=False,
+                               prepared=semi_prep)
+            cur = Batch(cur.schema, cur.columns, cur.row_mask & m)
+        count = jnp.sum(cur.row_mask.astype(jnp.int32))
+        return cur, _merge_errs(errs), count
 
     return jax.jit(run)

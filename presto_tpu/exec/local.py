@@ -29,10 +29,25 @@ from ..ops.aggregation import AggSpec
 from ..ops.jitcache import global_aggregate_jit as global_aggregate, grouped_aggregate_jit as grouped_aggregate
 from ..ops.jitcache import (
     build_key_ranks_jit, build_match_mask_jit, expand_join_jit,
-    lookup_join_jit, match_count_max_jit, prepare_build_jit,
-    prepare_direct_jit, semi_join_mask_jit,
+    key_bounds_violation_jit, lookup_join_jit, match_count_max_jit,
+    prepare_build_jit, prepare_direct_jit, semi_join_mask_jit,
 )
+from ..obs.metrics import REGISTRY
 from ..obs.trace import TRACER
+
+#: grouped-aggregation kernel dispatch, per operator (first batch decides
+#: and the plan is shape-stable): dense composite-code path (broadcast or
+#: scatter — no sort) vs the sort-segment path. The trace-level signal
+#: the stats-bounded grouping tests assert on.
+_AGG_DENSE_SELECTED = REGISTRY.counter("agg_dense_path_selected_total")
+_AGG_SORT_SELECTED = REGISTRY.counter("agg_sort_path_selected_total")
+
+#: fused-chain lane accounting: capacities entering the chain (source)
+#: vs entering the tail's payload gathers (post mask + compaction). The
+#: ratio IS the gather-lane reduction the selectivity-first head buys —
+#: the observable the q27-shaped star-chain tests assert on.
+_FUSED_SOURCE_LANES = REGISTRY.counter("fused_source_lanes_total")
+_FUSED_TAIL_LANES = REGISTRY.counter("fused_tail_lanes_total")
 from ..ops.join import expand_join, semi_join_mask
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
 from ..planner.plan import (
@@ -699,15 +714,49 @@ class _Executor:
         yield Batch(_plan_schema(node), list(b.columns) + [mark_col],
                     b.row_mask)
 
+    def _grouped_partial_fn(self, group, aggs, kb):
+        """Per-batch partial aggregation with the stats-bounds contract:
+        record which kernel the grouping takes (once — the dispatch is
+        shape-stable across an operator's batches), and when static key
+        bounds are in play, append the device-side violation scalar to the
+        error channel so a connector overclaiming its statistics fails the
+        query instead of silently misgrouping (one sync per query)."""
+        from ..ops.aggregation import dense_path_selected
+        allow = bool_property(self.session, "dense_grouping", True)
+        seen = {}
+
+        def partial(b: Batch) -> Batch:
+            # per-batch dispatch mirror: only batches that actually take
+            # the dense path clamp out-of-bounds keys, so only those
+            # batches owe a violation flag — the sort path groups any
+            # key correctly and must not fail on overclaimed stats
+            dense = allow and dense_path_selected(b, group, aggs,
+                                                  key_bounds=kb)
+            if not seen:
+                seen["done"] = True
+                (_AGG_DENSE_SELECTED if dense
+                 else _AGG_SORT_SELECTED).inc()
+            if dense and kb is not None:
+                self.error_flags.append(
+                    key_bounds_violation_jit(b, group, kb))
+            return grouped_aggregate(b, group, aggs, mode="partial",
+                                     key_bounds=kb, allow_dense=allow)
+        return partial
+
     def _DistinctNode(self, node: DistinctNode) -> Iterator[Batch]:
         from .spill import AggSpillBuffer
         cols = list(range(len(node.fields)))
-        buf = AggSpillBuffer(self.pool, "distinct", cols, [],
-                             self.spill_partitions)
+        kb = tuple(node.key_bounds) if node.key_bounds else None
+        buf = AggSpillBuffer(
+            self.pool, "distinct", cols, [], self.spill_partitions,
+            key_bounds=kb,
+            allow_dense=bool_property(self.session, "dense_grouping",
+                                      True),
+            error_sink=self.error_flags.append)
+        partial = self._grouped_partial_fn(cols, [], kb)
         try:
             for b in self.run(node.child):
-                buf.add_partial(grouped_aggregate(b, cols, [],
-                                                  mode="partial"))
+                buf.add_partial(partial(b))
             yield from buf.results()
         finally:
             buf.close()
@@ -791,8 +840,13 @@ class _Executor:
         from .local_exchange import parallel_drivers
         from .spill import AggSpillBuffer
         key_idx = list(range(len(group)))
-        buf = AggSpillBuffer(self.pool, "hash-agg", key_idx, aggs,
-                             self.spill_partitions)
+        kb = tuple(node.key_bounds) if node.key_bounds else None
+        buf = AggSpillBuffer(
+            self.pool, "hash-agg", key_idx, aggs, self.spill_partitions,
+            key_bounds=kb,
+            allow_dense=bool_property(self.session, "dense_grouping",
+                                      True),
+            error_sink=self.error_flags.append)
         concurrency = int(self.session.properties.get(
             "task_concurrency", 1))
         try:
@@ -801,8 +855,7 @@ class _Executor:
             else:
                 partials = parallel_drivers(
                     self.run(node.child),
-                    lambda b: grouped_aggregate(b, group, aggs,
-                                                mode="partial"),
+                    self._grouped_partial_fn(group, aggs, kb),
                     concurrency)
             for p in partials:
                 buf.add_partial(p)
@@ -980,10 +1033,21 @@ class _Executor:
         """Drain + prepare every build in the chain (bottom-up), push all
         dynamic-filter bounds to the source scan BEFORE it starts (the
         generic path can only push the bottom join's bounds), then stream
-        the probe source through the fused program."""
-        from .fused import (FilterStage, JoinStage, ProjectStage,
-                            fused_pipeline)
-        from .spill import HostPartitionStore, SpillableBuildBuffer
+        the probe source through the fused programs.
+
+        Selectivity-first execution: the HEAD program applies every
+        hoistable key-bounds mask plus the first join's membership mask
+        over the raw source lanes — no payload gathers — and carries the
+        surviving-lane count as a traced scalar. The executor syncs a
+        WINDOW of those counts in one readback, compacts each surviving
+        batch to its live bucket, and only then runs the TAIL program
+        (all the joins' payload gathers) over the compacted lanes. The
+        greedy join order already put the most selective join first
+        (planner selectivity ranking), so on a q27-shaped star chain the
+        payload gathers touch ~1% of the source lanes instead of all
+        2^20, and the per-probe-batch liveness RTT is amortized to
+        1/window."""
+        from .fused import JoinStage, fused_pipeline, fused_prefilter
 
         order = list(reversed(nodes))
         # current-schema index -> source-schema index (for scan pushdown)
@@ -997,6 +1061,7 @@ class _Executor:
         builds: List[Batch] = []
         dyns: List[jnp.ndarray] = []
         bufs: List = []
+        pre_rows: List[Tuple[int, int, int]] = []
 
         def close_bufs() -> None:
             for bf in bufs:
@@ -1005,7 +1070,7 @@ class _Executor:
         try:
             ok = self._drain_fused_builds(
                 order, src_map, scan_target, dyn_enabled, stages, preps,
-                builds, dyns, bufs)
+                builds, dyns, bufs, pre_rows)
         except BaseException:
             close_bufs()
             raise
@@ -1013,45 +1078,105 @@ class _Executor:
             close_bufs()
             return None
 
-        # split after the first join: star chains put the most selective
-        # join first (greedy join order), so compacting its output before
-        # the remaining joins shrinks their gather work by the chain's
-        # selectivity (q27: 0.1% of lanes survive the cd join, so joins
-        # 2..4 run over thousands of rows instead of 2^20). The adaptive
-        # compactor pays one liveness sync per checked batch and disables
-        # itself when the stream doesn't shrink >=4x, so non-selective
-        # chains lose only one readback.
         first_join = next(i for i, st in enumerate(stages)
                           if isinstance(st, JoinStage))
-        head, tail = stages[:first_join + 1], stages[first_join + 1:]
-        assert tail, "fused chains carry >= 2 joins (_try_fused_chain)"
-        fn1 = fused_pipeline(tuple(head))
-        fn2 = fused_pipeline(tuple(tail))
+        head, tail = stages[:first_join], stages[first_join:]
+        join1 = tail[0]
+        semi_keys = ((join1.lkeys, join1.rkeys)
+                     if join1.join_type == "inner" else None)
+        pre_keys = tuple(k for k, _, _ in pre_rows)
+        pre_vals = jnp.asarray([[lo, hi] for _, lo, hi in pre_rows],
+                               dtype=jnp.int64).reshape(len(pre_rows), 2)
+        fn_head = fused_prefilter(tuple(head), pre_keys, semi_keys)
+        fn_tail = fused_pipeline(tuple(tail))
         preps_t, builds_t, dyns_t = tuple(preps), tuple(builds), tuple(dyns)
-        mid_compact = self._compactor()
-        compact = self._compactor()
+        window = max(1, int(self.session.properties.get(
+            "fused_compact_window", 4)))
+        return self._stream_fused(fn_head, fn_tail, source, pre_vals,
+                                  preps_t, builds_t, dyns_t, window,
+                                  close_bufs)
 
-        def stream() -> Iterator[Batch]:
-            try:
-                for probe in self.run(source):
-                    out, err = fn1(probe, preps_t[:1], builds_t[:1],
-                                   dyns_t[:1])
-                    if err is not None:
-                        self.error_flags.append(err)
-                    out, err2 = fn2(mid_compact(out), preps_t[1:],
-                                    builds_t[1:], dyns_t[1:])
-                    if err2 is not None:
-                        self.error_flags.append(err2)
-                    yield compact(out)
-            finally:
-                close_bufs()
-        return stream()
+    def _stream_fused(self, fn_head, fn_tail, source, pre_vals, preps_t,
+                      builds_t, dyns_t, window, close_bufs
+                      ) -> Iterator[Batch]:
+        """Head -> windowed compaction -> tail streaming loop. One
+        liveness readback per ``window`` probe batches (the head carries
+        each batch's live count as a traced scalar); the check disables
+        itself after a window with no >=4x shrink, mirroring
+        _compactor's adaptive semantics, so a non-selective chain pays
+        exactly one sync."""
+        import numpy as np
+
+        from ..ops.jitcache import compact_jit
+        compact = self._compactor()
+        state = {"check": self.compact_streams}
+        pend: List[Tuple[Batch, jnp.ndarray]] = []
+        # same 2^17 floor as _compactor: below it the tail kernels over
+        # uncompacted capacity cost less than the (already amortized)
+        # liveness RTT. Session-overridable so tests exercise the path
+        # at CPU-friendly sizes.
+        floor = int(self.session.properties.get(
+            "fused_compact_floor", 1 << 17))
+
+        def drain_pend() -> List[Batch]:
+            if not pend:
+                return []
+            with TRACER.span("device-sync", what="fused-liveness",
+                             batches=len(pend)):
+                counts = np.asarray(jnp.stack([c for _, c in pend]))
+            outs, shrunk = [], False
+            for (b, _), live in zip(pend, counts):
+                tgt = bucket_capacity(max(int(live), 1))
+                if b.capacity > floor and tgt * 4 <= b.capacity:
+                    b = compact_jit(b, tgt)
+                    shrunk = True
+                outs.append(b)
+            if not shrunk:
+                # selectivity is near-uniform across a chain's batches:
+                # nothing shrank this window, so later windows won't
+                state["check"] = False
+            pend.clear()
+            return outs
+
+        def run_tail(hb: Batch) -> Iterator[Batch]:
+            _FUSED_TAIL_LANES.inc(hb.capacity)
+            out, err = fn_tail(hb, preps_t, builds_t, dyns_t)
+            if err is not None:
+                self.error_flags.append(err)
+            yield compact(out)
+
+        try:
+            for probe in self.run(source):
+                _FUSED_SOURCE_LANES.inc(probe.capacity)
+                hb, err, cnt = fn_head(probe, pre_vals, builds_t[0],
+                                       preps_t[0])
+                if err is not None:
+                    self.error_flags.append(err)
+                if not state["check"] or hb.capacity <= floor:
+                    # sub-floor batches can never compact (the tail over
+                    # their full capacity costs less than the readback):
+                    # bypass the window WITHOUT syncing or tripping the
+                    # adaptive disable, mirroring _compactor's skip
+                    yield from run_tail(hb)
+                    continue
+                pend.append((hb, cnt))
+                if len(pend) >= window:
+                    for b in drain_pend():
+                        yield from run_tail(b)
+            for b in drain_pend():
+                yield from run_tail(b)
+        finally:
+            close_bufs()
 
     def _drain_fused_builds(self, order, src_map, scan_target, dyn_enabled,
-                            stages, preps, builds, dyns, bufs) -> bool:
+                            stages, preps, builds, dyns, bufs,
+                            pre_rows) -> bool:
         """Drain + prepare every build of a fused chain, appending to the
         caller's lists; False = shape disqualified (empty/spilled build),
-        fall back to the generic path."""
+        fall back to the generic path. ``pre_rows`` collects every
+        dynamic-filter bound that maps to a raw source column —
+        (source index, lo, hi) — for the head program's
+        before-any-gathers mask."""
         from .fused import FilterStage, JoinStage, ProjectStage
         from .spill import HostPartitionStore, SpillableBuildBuffer
 
@@ -1099,6 +1224,13 @@ class _Executor:
                     dyn_val = jnp.asarray([[lo, hi]
                                            for _, lo, hi in bounds],
                                           dtype=jnp.int64)
+                    for k, lo, hi in bounds:
+                        # bounds whose key survives untouched back to the
+                        # raw source schema hoist to the head program's
+                        # pre-gather mask (selectivity-first)
+                        si = src_map.get(k)
+                        if si is not None:
+                            pre_rows.append((si, lo, hi))
                     if scan_target is not None:
                         scan, smap = scan_target
                         extra = []

@@ -355,10 +355,23 @@ class AggSpillBuffer:
 
     def __init__(self, pool: QueryMemoryPool, name: str,
                  key_idx: Sequence[int], aggs: Sequence[AggSpec],
-                 n_partitions: int, merge_every: int = 16):
+                 n_partitions: int, merge_every: int = 16,
+                 key_bounds=None, allow_dense: bool = True,
+                 error_sink=None):
         self.ctx = pool.context(name, revoke_cb=self._spill_all)
         self.key_idx = list(key_idx)
         self.aggs = list(aggs)
+        # stats-derived static key bounds (AggregationNode.key_bounds):
+        # merges and finals over state rows keep the dense scatter path;
+        # allow_dense=False (session dense_grouping=false) pins the sort
+        # path end to end
+        self.key_bounds = tuple(key_bounds) if key_bounds else None
+        self.allow_dense = allow_dense
+        # receives device error scalars (executor error_flags.append):
+        # a merge/final whose LARGER concatenated capacity flips the
+        # dense gate on must still flag out-of-bounds keys, even when
+        # the per-batch partials sorted (and so appended no flag)
+        self.error_sink = error_sink
         self.n_partitions = n_partitions
         self.merge_every = merge_every
         self.device: List[Batch] = []
@@ -388,8 +401,12 @@ class AggSpillBuffer:
                 self.ctx.revoke()
                 self._stage(partial)
                 return
-        merged = grouped_aggregate(concat_batches(snapshot),
-                                   self.key_idx, self.aggs, mode="merge")
+        states = concat_batches(snapshot)
+        self._flag_bounds(states)
+        merged = grouped_aggregate(states,
+                                   self.key_idx, self.aggs, mode="merge",
+                                   key_bounds=self.key_bounds,
+                                   allow_dense=self.allow_dense)
         state = merged.compact(
             bucket_capacity(max(merged.host_count(), 1)))
         with self.ctx.pool.lock:
@@ -400,6 +417,22 @@ class AggSpillBuffer:
             else:
                 self._stage(state)
                 self.spilled = True
+
+    def _flag_bounds(self, states: Batch) -> None:
+        """Mirror of this merge/final call's kernel dispatch: when the
+        dense (clamping) path engages for THIS batch, emit the
+        bounds-violation scalar — state batches keep raw key values, so
+        out-of-bounds keys from a sort-path partial are still visible
+        here (exec/local.py owns the per-partial-batch flags)."""
+        if self.key_bounds is None or not self.allow_dense \
+                or self.error_sink is None:
+            return
+        from ..ops.aggregation import dense_path_selected
+        from ..ops.jitcache import key_bounds_violation_jit
+        if dense_path_selected(states, self.key_idx, self.aggs,
+                               key_bounds=self.key_bounds):
+            self.error_sink(key_bounds_violation_jit(
+                states, self.key_idx, self.key_bounds))
 
     def _stage(self, b: Batch) -> int:
         if self.store is None:
@@ -435,16 +468,20 @@ class AggSpillBuffer:
                 return
             states = (device[0] if len(device) == 1
                       else concat_batches(device))
+            self._flag_bounds(states)
             yield grouped_aggregate(states, self.key_idx, self.aggs,
-                                    mode=mode)
+                                    mode=mode, key_bounds=self.key_bounds,
+                                    allow_dense=self.allow_dense)
             return
         for p in range(self.n_partitions):
             part = None if self.store is None else \
                 self.store.partition_batch(p)
             if part is None:
                 continue
+            self._flag_bounds(part)
             yield grouped_aggregate(part, self.key_idx, self.aggs,
-                                    mode=mode)
+                                    mode=mode, key_bounds=self.key_bounds,
+                                    allow_dense=self.allow_dense)
 
     def close(self) -> None:
         self.ctx.close()

@@ -25,8 +25,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from .prefix import prefix_sum
+# imported EAGERLY so its module-level device constants (SIGN64, MASK32)
+# are created outside any jit trace: a first import inside a traced
+# kernel leaks tracer-scoped constants and fails the compile
+# (UnexpectedTracerError seen on decimal aggregations whose first use
+# was inside grouped_aggregate's jit)
+from . import int128 as _int128  # noqa: F401
 from .. import types as T
-from ..batch import Batch, Column, Schema
+from ..batch import Batch, Column, Schema, bucket_capacity
 from ..types import Type
 
 _VARIANCE_FNS = ("var_samp", "var_pop", "stddev_samp",
@@ -65,9 +71,17 @@ def percentile_drains(aggs, input_types, grouped: bool) -> bool:
         for a in drains)
 
 
-#: largest fused key-domain the no-sort dense group-by path handles; past
-#: this the sort path's O(n log n) beats segment-reducing mostly-empty slots
+#: largest fused key-domain the broadcast-compare dense reducers handle
+#: ([rows, K] masked reduce); past this the scatter reducers take over
 _DENSE_GROUP_LIMIT = 4096
+
+#: largest fused key-domain of the stats-bounded dense SCATTER group-by
+#: (one i32 scatter per digit over K slots — ~85-110M updates/s on v5e vs
+#: ~8M/s for the 64-bit path and an 82s compile for the 3-operand
+#: lax.sort it replaces); past this the mostly-empty slot table stops
+#: paying for itself and the sort-segment path wins. Shared with the
+#: planner's rewrite gate (optimizer._attach_group_bounds).
+DENSE_SCATTER_LIMIT = 1 << 21
 
 
 @dataclasses.dataclass(frozen=True)
@@ -232,56 +246,134 @@ def _group_sort(batch: Batch, group_indices: Sequence[int]):
     return s_data, s_valid, s_mask, boundary, group_id, num_groups
 
 
-def _dense_group_code(batch: Batch, group_indices: Sequence[int],
-                      limit: int):
-    """Fused dense group slot for keys with small static domains
-    (dictionary-coded strings, booleans): slot =
-    mixed-radix(key components), component 0 = NULL. Returns
-    (code, K, sizes) or None when any key's domain is unknown/too big.
+def _wide_state_aggs(aggs: Sequence["AggSpec"]) -> bool:
+    """Aggregates whose states need the sort path's leading row dim
+    (HLL register tiles, decimal(38) limb pairs)."""
+    return any(a.fn == "approx_distinct" for a in aggs) or any(
+        getattr(st, "storage_width", None)
+        for a in aggs if a.fn not in DRAIN_FNS
+        for _, st in a.state_types())
 
-    This is the no-sort GroupByHash fast path (the role of reference
-    BigintGroupByHash.java's dense int path): group ids come straight
-    from the data, so aggregation is a single segment-reduce pass with
-    trivial compile time — no comparator, no permutation.
-    """
+
+def dense_path_selected(batch: "Batch", group_indices: Sequence[int],
+                        aggs: Sequence["AggSpec"],
+                        output_capacity: Optional[int] = None,
+                        key_bounds=None) -> bool:
+    """Host-only mirror of grouped_aggregate's kernel dispatch: True when
+    this batch/grouping takes the dense composite-code path (broadcast or
+    scatter), False when it sorts. The executor reports it (obs metric +
+    EXPLAIN ANALYZE) without tracing anything."""
+    if has_drain_agg(aggs) or _wide_state_aggs(aggs):
+        return False
+    cap = output_capacity or batch.capacity
+    return dense_group_plan(batch, group_indices, cap,
+                            key_bounds) is not None
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseGroupPlan:
+    """Host-static plan for the composite dense group code: one
+    mixed-radix component per key (component 0 = NULL). ``los[i]`` is the
+    integer key's stats-derived lower bound (None for dictionary/boolean
+    keys, whose domain comes from the data itself); ``scatter`` selects
+    the segment-scatter reducers over the [rows, K] broadcast reduce."""
+
+    sizes: Tuple[int, ...]
+    los: Tuple[Optional[int], ...]
+    K: int
+    scatter: bool
+
+
+def dense_group_plan(batch: Batch, group_indices: Sequence[int],
+                     cap: int,
+                     key_bounds: Optional[Sequence[
+                         Optional[Tuple[int, int]]]] = None
+                     ) -> Optional[DenseGroupPlan]:
+    """Dense-path dispatch rule (host-only — reads column aux data, no
+    device math, so the executor can also call it to report which kernel
+    a grouping takes). A key contributes a component when its domain is
+    host-known: dictionary-coded strings (|vocab|), booleans, or integer
+    keys with stats-derived [lo, hi] bounds from the planner
+    (AggregationNode.key_bounds — the reference BigintGroupByHash
+    dense-array mode generalized to mixed-radix composite keys). Returns
+    None when any domain is unknown or the product overflows the limit —
+    the sort-segment path then runs unchanged."""
     sizes: List[int] = []
-    for gi in group_indices:
+    los: List[Optional[int]] = []
+    bounded = False
+    for j, gi in enumerate(group_indices):
         c = batch.columns[gi]
+        kb = key_bounds[j] if key_bounds else None
         if c.type.is_string and c.dictionary is not None:
             sizes.append(len(c.dictionary) + 1)
+            los.append(None)
         elif c.data.dtype == jnp.bool_:
             sizes.append(3)
+            los.append(None)
+        elif (kb is not None and getattr(c.data, "ndim", 1) == 1
+                and jnp.issubdtype(c.data.dtype, jnp.integer)):
+            lo, hi = int(kb[0]), int(kb[1])
+            if hi < lo:
+                return None
+            sizes.append(hi - lo + 2)
+            los.append(lo)
+            bounded = True
         else:
             return None
     K = 1
     for s in sizes:
         K *= s
+    limit = min(cap, DENSE_SCATTER_LIMIT if bounded else _DENSE_GROUP_LIMIT)
     if not 0 < K <= limit:
         return None
+    return DenseGroupPlan(tuple(sizes), tuple(los), K,
+                          scatter=bounded or K > _DENSE_GROUP_LIMIT)
+
+
+def _dense_group_code(batch: Batch, group_indices: Sequence[int],
+                      plan: DenseGroupPlan) -> jnp.ndarray:
+    """Fused dense group slot: slot = mixed-radix(key components),
+    component 0 = NULL. Group ids come straight from the data, so
+    aggregation is a single segment-reduce pass with trivial compile
+    time — no comparator, no permutation. A live key outside its stats
+    bound CLAMPS into the domain (the slot table must stay in-bounds);
+    the executor independently raises STATS_BOUND_VIOLATION for such
+    rows through the row-error channel, so a misgrouped result never
+    escapes the query."""
     code = jnp.zeros(batch.capacity, dtype=jnp.int32)
-    for gi, size in zip(group_indices, sizes):
+    for gi, size, lo in zip(group_indices, plan.sizes, plan.los):
         c = batch.columns[gi]
-        comp = jnp.where(c.validity, c.data.astype(jnp.int32) + 1, 0)
+        if lo is None:
+            comp = jnp.where(c.validity, c.data.astype(jnp.int32) + 1, 0)
+        else:
+            shifted = jnp.clip(c.data.astype(jnp.int64) - lo + 1, 1,
+                               size - 1).astype(jnp.int32)
+            comp = jnp.where(c.validity, shifted, 0)
         code = code * size + comp
-    return code, K, sizes
+    return code
 
 
 def _dense_key_columns(batch: Batch, group_indices: Sequence[int],
-                       sizes: Sequence[int], K: int, cap: int,
+                       plan: DenseGroupPlan, cap: int,
                        out_mask: jnp.ndarray) -> List[Column]:
     """Decode slot indices 0..K-1 back into key columns (static mixed-radix
     decode — becomes constants under jit), padded to ``cap``."""
+    K = plan.K
     slots = np.arange(K, dtype=np.int64)
     comps: List[np.ndarray] = []
-    for size in reversed(list(sizes)):
+    for size in reversed(list(plan.sizes)):
         comps.append(slots % size)
         slots = slots // size
     comps.reverse()
     key_cols = []
-    for gi, comp in zip(group_indices, comps):
+    for gi, comp, lo in zip(group_indices, comps, plan.los):
         c = batch.columns[gi]
         valid = jnp.pad(jnp.asarray(comp > 0), (0, cap - K)) & out_mask
-        if c.data.dtype == jnp.bool_:
+        if lo is not None:
+            data = jnp.pad(jnp.asarray(
+                lo + np.maximum(comp - 1, 0)).astype(c.data.dtype),
+                (0, cap - K))
+        elif c.data.dtype == jnp.bool_:
             data = jnp.pad(jnp.asarray(comp == 2), (0, cap - K))
         else:
             data = jnp.pad(
@@ -310,6 +402,9 @@ class _SegReducers:
                  n_rows: Optional[int] = None):
         self.gid, self.cap = group_id, cap
         self.starts, self.n_rows = starts, n_rows
+
+    def count(self, valid):
+        return self.sum(valid.astype(jnp.int64))
 
     def sum(self, x):
         if self.starts is not None and getattr(x, "ndim", 0) == 1:
@@ -364,6 +459,11 @@ class _DenseReducers:
                                          dtype=self.code.dtype)[None, :])
         return self._match
 
+    def count(self, valid):
+        # accumulate in i32 (counts < 2^31 within one batch): this
+        # broadcast reduce is memory-bound and i64 doubles its traffic
+        return self.sum(valid.astype(jnp.int32)).astype(jnp.int64)
+
     def sum(self, x):
         return jnp.sum(jnp.where(self._m(), x[:, None],
                                  jnp.zeros((), x.dtype)), axis=0)
@@ -378,6 +478,51 @@ class _DenseReducers:
 
     def gather(self, per_group):
         return per_group[self.code]
+
+
+class _ScatterReducers:
+    """Group reductions over a dense i32 composite key code via
+    ``segment_*`` scatters — the bounded-domain no-sort path for key
+    spaces too wide for the [rows, K] broadcast reduce above. The group
+    id needs no sort and no boundary pass (it IS the key), so the whole
+    aggregation is a handful of scatters: counts are one i32 scatter,
+    exact 64-bit sums go through the i32 digit scatters of
+    ops/scatter_agg.py (the f64/i64 scatter is the ~14x cliff on this
+    chip), and f64 sums scatter directly in f64 (SQL sum(double)
+    tolerates the reduction order; the magnitude is still exact f64
+    adds). Signed inputs scatter positive and negative magnitudes
+    separately — the digit split needs non-negative values."""
+
+    def __init__(self, code: jnp.ndarray, cap: int, n_rows: int):
+        self.gid, self.cap, self.n_rows = code, cap, n_rows
+
+    def count(self, valid):
+        ones = jnp.where(valid, jnp.int32(1), jnp.int32(0))
+        c = jax.ops.segment_sum(ones, self.gid, num_segments=self.cap)
+        return c.astype(jnp.int64)
+
+    def sum(self, x):
+        if x.dtype == jnp.int64 and getattr(x, "ndim", 1) == 1:
+            from .scatter_agg import segment_sum_exact
+            pos = segment_sum_exact(jnp.maximum(x, 0), self.gid,
+                                    self.cap, self.n_rows, value_bits=62)
+            neg = segment_sum_exact(jnp.maximum(-x, 0), self.gid,
+                                    self.cap, self.n_rows, value_bits=62)
+            return pos - neg
+        return jax.ops.segment_sum(x, self.gid, num_segments=self.cap)
+
+    def min(self, x):
+        return jax.ops.segment_min(x, self.gid, num_segments=self.cap)
+
+    def max(self, x):
+        return jax.ops.segment_max(x, self.gid, num_segments=self.cap)
+
+    def hll(self, valid, hashed, m):
+        from .sketch import hll_update
+        return hll_update(self.gid, valid, hashed, self.cap, m)
+
+    def gather(self, per_group):
+        return per_group[self.gid]
 
 
 def _segment_aggs(
@@ -477,7 +622,7 @@ def _segment_aggs(
             continue
         # raw-input mode
         if agg.fn == "count_star":
-            cnt = red.sum(mask.astype(jnp.int64))
+            cnt = red.count(mask)
             results.append((cnt,))
             continue
         data = col_data[agg.input]
@@ -490,7 +635,7 @@ def _segment_aggs(
             hashed = hashed_column(data, vocab)
             results.append((red.hll(valid, hashed, hll_m(agg.param)),))
             continue
-        cnt = red.sum(valid.astype(jnp.int64))
+        cnt = red.count(valid)
         if agg.fn == "count":
             results.append((cnt,))
             continue
@@ -845,6 +990,7 @@ def grouped_aggregate(
     mode: str = "single",
     output_capacity: Optional[int] = None,
     allow_dense: bool = True,
+    key_bounds: Optional[Sequence[Optional[Tuple[int, int]]]] = None,
 ) -> Batch:
     """GROUP BY aggregation. mode: 'single' | 'partial' | 'final' | 'merge'.
 
@@ -853,6 +999,10 @@ def grouped_aggregate(
     layout of 'partial' mode (possibly concatenated/exchanged in between).
     'merge' re-combines state rows sharing a key but keeps the state layout
     (Presto's intermediate combine step), enabling hierarchical merging.
+
+    ``key_bounds`` (one Optional[(lo, hi)] per group key, from
+    AggregationNode.key_bounds) lets integer keys join the dense
+    composite-code path; see dense_group_plan.
     """
     assert mode in ("single", "partial", "final", "merge")
     if has_drain_agg(aggs):
@@ -861,33 +1011,37 @@ def grouped_aggregate(
     cap = output_capacity or batch.capacity
     from_states = mode in ("final", "merge")
     n_keys = len(group_indices)
-    if any(a.fn == "approx_distinct" for a in aggs) or any(
-            getattr(st, "storage_width", None)
-            for a in aggs if a.fn not in DRAIN_FNS
-            for _, st in a.state_types()):
+    if _wide_state_aggs(aggs):
         # wide states (HLL register tiles, decimal(38) limb pairs) need
         # the sort path whose segment ops keep a leading row dim; the
         # dense broadcast-compare reducer would materialize [rows, K, w]
         allow_dense = False
-    dense = (_dense_group_code(batch, group_indices,
-                               limit=min(cap, _DENSE_GROUP_LIMIT))
-             if allow_dense else None)
-    if dense is not None:
-        # no-sort fast path: group id straight from the key data
-        code, K, sizes = dense
+    plan = (dense_group_plan(batch, group_indices, cap, key_bounds)
+            if allow_dense else None)
+    if plan is not None:
+        # no-sort fast path: group id straight from the key data. The
+        # output shrinks to the key domain's bucket when the caller left
+        # capacity open — a 2^20-row batch grouping into a 10^5-slot
+        # domain must not ship 2^20-capacity state columns downstream.
+        K = plan.K
+        if output_capacity is None:
+            cap = min(cap, bucket_capacity(K + 1))
+        code = _dense_group_code(batch, group_indices, plan)
         mask = batch.row_mask
         gid = jnp.where(mask, code, K)       # dead rows -> overflow slot
-        red = _DenseReducers(gid, K + 1)
-        occ = red.sum(mask.astype(jnp.int32))[:K] > 0
+        red = (_ScatterReducers(gid, K + 1, batch.capacity)
+               if plan.scatter else _DenseReducers(gid, K + 1))
+        occ = red.count(mask)[:K] > 0
         out_mask = jnp.pad(occ, (0, cap - K))
-        key_cols = _dense_key_columns(batch, group_indices, sizes, K, cap,
+        key_cols = _dense_key_columns(batch, group_indices, plan, cap,
                                       out_mask)
         in_cols = batch.columns[n_keys:] if from_states else batch.columns
         raw = _segment_aggs(
             aggs, [c.data for c in in_cols], [c.validity for c in in_cols],
             mask, red, from_states=from_states,
             col_dicts=[c.dictionary for c in in_cols])
-        seg = [tuple(jnp.pad(arr[:K], (0, cap - K)) for arr in parts)
+        seg = [tuple(jnp.pad(arr[:K], [(0, cap - K)] + [(0, 0)] * (
+            getattr(arr, "ndim", 1) - 1)) for arr in parts)
                for parts in raw]
     else:
         s_data, s_valid, s_mask, boundary, group_id, num_groups = \

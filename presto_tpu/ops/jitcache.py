@@ -81,10 +81,12 @@ def _entry_cache(name: str, factory):
     return get
 
 
-def _grouped_factory(group_indices, aggs, mode, output_capacity):
+def _grouped_factory(group_indices, aggs, mode, output_capacity,
+                     key_bounds, allow_dense):
     def run(batch):
         return grouped_aggregate(batch, group_indices, aggs, mode,
-                                 output_capacity)
+                                 output_capacity, allow_dense=allow_dense,
+                                 key_bounds=key_bounds)
     return jax.jit(run)
 
 
@@ -93,9 +95,47 @@ _grouped = _entry_cache("grouped_aggregate", _grouped_factory)
 
 def grouped_aggregate_jit(batch, group_indices: Sequence[int],
                           aggs: Sequence[AggSpec], mode: str = "single",
-                          output_capacity: Optional[int] = None):
+                          output_capacity: Optional[int] = None,
+                          key_bounds=None, allow_dense: bool = True):
     return _grouped(tuple(group_indices), tuple(aggs), mode,
-                    output_capacity)(batch)
+                    output_capacity,
+                    tuple(key_bounds) if key_bounds else None,
+                    allow_dense)(batch)
+
+
+def _bounds_violation_factory(group_indices, key_bounds):
+    import jax.numpy as jnp
+
+    from ..errors import STATS_BOUND_VIOLATION
+
+    def run(b):
+        bad = jnp.zeros((), dtype=bool)
+        for gi, kb in zip(group_indices, key_bounds):
+            if kb is None:
+                continue
+            c = b.columns[gi]
+            data = c.data.astype(jnp.int64)
+            out = (b.row_mask & c.validity
+                   & ((data < kb[0]) | (data > kb[1])))
+            bad = bad | jnp.any(out)
+        return jnp.where(bad, jnp.int32(STATS_BOUND_VIOLATION),
+                         jnp.int32(0))
+    return jax.jit(run)
+
+
+_bounds_violation = _entry_cache("key_bounds_violation",
+                                 _bounds_violation_factory)
+
+
+def key_bounds_violation_jit(batch, group_indices, key_bounds):
+    """Device scalar (error code or 0) marking live, valid group keys
+    outside their stats-promised [lo, hi]. The dense composite-code
+    kernel CLAMPS such keys to stay in-bounds, so the executor must
+    append this scalar to its error-flag channel — the query then fails
+    with STATS_BOUND_VIOLATION instead of returning misgrouped rows. No
+    readback here: flags sync once per query (check_errors)."""
+    return _bounds_violation(tuple(group_indices),
+                             tuple(key_bounds))(batch)
 
 
 def _global_factory(aggs, mode):

@@ -191,7 +191,8 @@ class _Fragmenter:
         cols = tuple(range(len(node.fields)))
         partial = AggregationNode(child=child, group_indices=cols,
                                   aggs=(), fields=node.fields,
-                                  step="partial")
+                                  step="partial",
+                                  key_bounds=node.key_bounds)
         src = self.cut(partial, loc, OutputSpec("partition", cols))
         final = dataclasses.replace(node, child=src)
         return final, "fixed"

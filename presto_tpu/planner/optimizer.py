@@ -53,6 +53,8 @@ def optimize(plan: LogicalPlan, session: Session) -> LogicalPlan:
         if bool_property(session, "push_partial_aggregation_through_join",
                          True):
             node = _push_partial_agg_through_join(node, session)
+        if bool_property(session, "stats_bounded_grouping", True):
+            node = _attach_group_bounds(node, session)
         return _attach_scan_pushdown(node)
     # one memoized StatsCalculator for the whole pass: join ordering,
     # distribution choice, and the eager-agg gate all estimate the same
@@ -385,7 +387,38 @@ def _plan_join_graph(join: JoinNode, extra_preds: List[ir.Expr],
                     tree_keys.append(rmap_l[_col_index(a)])
                 return (_key_unique(new_leaves[i], cand_keys, session)
                         or _key_unique(current, tree_keys, session))
-            ranked = sorted(cands, key=lambda i: (not viable(i), sizes[i]))
+
+            def selectivity(i: int) -> float:
+                """Estimated fraction of the current tree's rows that
+                survive joining candidate i — the containment formula of
+                _JoinNode (rows = L*R/max(ndv)) divided by L. Star chains
+                then join the MOST SELECTIVE dimension first, so a fused
+                probe pipeline's first join prunes the fact table instead
+                of merely widening it (a filtered dimension can be far
+                more selective than a small-but-unfiltered one — ranking
+                by build size alone puts a 12-row store table ahead of a
+                1/70-selective customer_demographics filter)."""
+                ps = edges_between(joined, i)
+                if not ps:
+                    return 1.0
+                calc = _stats_calc(session)
+                cand_est = calc.estimate(new_leaves[i])
+                cur_est = calc.estimate(current)
+                rmap_l = {g: k for k, g in enumerate(cur_pos)}
+                ndv = 1.0
+                for (a, b) in ps:
+                    ln = cur_est.column(rmap_l[_col_index(a)]).distinct
+                    rn = cand_est.column(_col_index(b)
+                                         - offsets[i]).distinct
+                    cap = max(filter(None, (ln, rn)), default=None)
+                    if cap:
+                        ndv = max(ndv, cap)
+                if ndv <= 1.0:
+                    ndv = max(cur_est.rows, cand_est.rows)
+                return min(1.0, cand_est.rows / max(ndv, 1.0))
+
+            ranked = sorted(cands, key=lambda i: (not viable(i),
+                                                  selectivity(i), sizes[i]))
             i = ranked[0]
             pairs = edges_between(joined, i)
         right = new_leaves[i]
@@ -947,3 +980,92 @@ def _try_eager_agg(agg: AggregationNode,
         child=above, group_indices=tuple(range(n_keys)),
         aggs=final_aggs, fields=agg.fields, step="final",
         default_gids=agg.default_gids)
+
+
+# ---------------------------------------------------------------------------
+# Pass 5: stats-bounded dense grouping (the rewrite gate for the
+# ops/scatter_agg.py digit-scatter group-by path)
+# ---------------------------------------------------------------------------
+
+from ..ops.aggregation import DENSE_SCATTER_LIMIT  # noqa: E402
+
+
+def _group_key_bound(node: PlanNode, idx: int, session: Session
+                     ) -> Optional[Tuple[int, int]]:
+    """Static [lo, hi] for one group-key column when statistics prove it:
+    integer-family storage with both range ends known. Bounds must be
+    TRUE bounds, not estimates — the stats calculus only ever narrows
+    ranges from connector min/max (filters keep ranges, joins/projections
+    pass them through), so a connector publishing exact min/max yields
+    hard bounds. The executor still cross-checks every batch through the
+    row-error channel (exec/local.py), so a connector overclaiming its
+    statistics fails the query instead of corrupting groups."""
+    t = node.fields[idx].type
+    if not isinstance(t, _BOUNDABLE):
+        return None
+    ce = _stats_calc(session).estimate(node).column(idx)
+    if ce.lo is None or ce.hi is None or ce.hi < ce.lo:
+        return None
+    import math
+    lo, hi = math.floor(ce.lo), math.ceil(ce.hi)
+    if hi - lo + 1 > DENSE_SCATTER_LIMIT:
+        return None
+    return int(lo), int(hi)
+
+
+def _bounds_for_keys(child: PlanNode, key_cols: Sequence[int],
+                     session: Session
+                     ) -> Tuple[Optional[Tuple[int, int]], ...]:
+    """key_bounds tuple for a grouping over ``key_cols`` of ``child``, or
+    () when the dense composite code cannot engage. The gate mirrors the
+    kernel's dispatch (ops/aggregation.py dense_group_plan): every key
+    needs a host-known domain — integer stats bounds here, dictionary /
+    boolean domains at trace time — and the composite product must stay
+    under DENSE_SCATTER_LIMIT. Unknown string/bool domains contribute
+    their NDV estimate (the kernel re-gates with the true dictionary
+    size, so an optimistic pass here costs nothing)."""
+    calc = _stats_calc(session)
+    bounds: List[Optional[Tuple[int, int]]] = []
+    domain = 1.0
+    any_bound = False
+    for k in key_cols:
+        t = child.fields[k].type
+        if isinstance(t, _BOUNDABLE):
+            b = _group_key_bound(child, k, session)
+            if b is None:
+                return ()
+            bounds.append(b)
+            domain *= b[1] - b[0] + 2          # + NULL component
+            any_bound = True
+        elif t.is_string or isinstance(t, T.BooleanType):
+            # domain known only at trace time (dictionary size); gate on
+            # the NDV estimate when stats offer one
+            bounds.append(None)
+            d = calc.estimate(child).column(k).distinct
+            if d is not None:
+                domain *= max(d, 1.0) + 1
+        else:
+            return ()
+    if not any_bound or domain > DENSE_SCATTER_LIMIT:
+        return ()
+    return tuple(bounds)
+
+
+def _attach_group_bounds(node: PlanNode, session: Session) -> PlanNode:
+    """Attach stats-derived static key bounds to aggregations and
+    DISTINCTs whose composite key domain is provably small — the
+    planner-side gate that routes multi-key GROUP BYs onto the dense i32
+    scatter path (the reference BigintGroupByHash dense-array mode,
+    generalized to mixed-radix composite keys)."""
+    node = node.with_children([_attach_group_bounds(c, session)
+                               for c in node.children])
+    if isinstance(node, AggregationNode) and node.group_indices:
+        kb = _bounds_for_keys(node.child, node.group_indices, session)
+        if kb:
+            return dataclasses.replace(node, key_bounds=kb)
+    if isinstance(node, DistinctNode) and node.fields:
+        kb = _bounds_for_keys(node.child,
+                              tuple(range(len(node.fields))), session)
+        if kb:
+            return dataclasses.replace(node, key_bounds=kb)
+    return node

@@ -132,6 +132,14 @@ class AggregationNode(PlanNode):
     aggs: Tuple[PlanAgg, ...]
     fields: Tuple[Field, ...]
     step: str = "single"
+    # stats-derived static [lo, hi] per group key (aligned with
+    # group_indices; None per key when unknown). When every key's domain
+    # is host-known and the composite product is small, the executor
+    # composes a dense i32 group code and takes the scatter path of
+    # ops/scatter_agg.py instead of the multi-operand lax.sort path —
+    # the planner side of the reference BigintGroupByHash dense-array
+    # mode. Attached by optimizer._attach_group_bounds.
+    key_bounds: Tuple[Optional[Tuple[int, int]], ...] = ()
     # grouping-sets support (reference AggregationNode.groupIdSymbol +
     # hasDefaultOutput): $group_id values — indexes into the feeding
     # GroupIdNode's sets — that must still emit a default row (count=0,
@@ -280,6 +288,9 @@ class DistinctNode(PlanNode):
 
     child: PlanNode
     fields: Tuple[Field, ...] = ()
+    # stats-derived static [lo, hi] per output column (see
+    # AggregationNode.key_bounds — DISTINCT groups by every column)
+    key_bounds: Tuple[Optional[Tuple[int, int]], ...] = ()
 
     def __post_init__(self):
         if not self.fields:

@@ -148,8 +148,13 @@ def _label(n: PlanNode) -> str:
         return f"Project => [{cols}]"
     if isinstance(n, AggregationNode):
         aggs = ", ".join(f"{a.name}:={a.fn}({a.arg})" for a in n.aggs)
-        return (f"Aggregate[{n.step}, keys={list(n.group_indices)}] "
-                f"=> [{aggs}]")
+        dense = ""
+        if n.key_bounds:
+            spans = ["?" if b is None else f"{b[0]}..{b[1]}"
+                     for b in n.key_bounds]
+            dense = f", bounds=[{', '.join(spans)}]"
+        return (f"Aggregate[{n.step}, keys={list(n.group_indices)}"
+                f"{dense}] => [{aggs}]")
     if isinstance(n, JoinNode):
         return (f"Join[{n.join_type}, {n.distribution}, "
                 f"L{list(n.left_keys)}=R{list(n.right_keys)}"

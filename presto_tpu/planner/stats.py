@@ -301,8 +301,16 @@ class StatsCalculator:
 
     def _GroupIdNode(self, node: GroupIdNode) -> PlanEstimate:
         child = self.estimate(node.child)
-        return PlanEstimate(child.rows * max(len(node.grouping_sets), 1),
-                            child.columns)
+        nsets = max(len(node.grouping_sets), 1)
+        # child columns pass through (keys are nulled per set, which only
+        # raises the null fraction — ranges survive); the appended
+        # $group_id column has the exact static domain [0, nsets) — the
+        # bound that lets ROLLUP/CUBE aggregations compose a dense group
+        # code over it (optimizer._attach_group_bounds)
+        cols = dict(child.columns)
+        cols[len(node.child.fields)] = ColumnEstimate(
+            distinct=float(nsets), lo=0.0, hi=float(nsets - 1))
+        return PlanEstimate(child.rows * nsets, cols)
 
     def _LimitNode(self, node: LimitNode) -> PlanEstimate:
         child = self.estimate(node.child)

@@ -10,6 +10,10 @@ import sys
 
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — boots multi-worker HTTP clusters per fixture
+# (see tools/check_tier1_time.py; ~152s)
+pytestmark = pytest.mark.slow
+
 sys.path.insert(0, ".")
 from tpch_queries import Q as TPCH_QUERIES  # noqa: E402
 

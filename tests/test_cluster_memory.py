@@ -7,6 +7,10 @@ import time
 
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — boots a multi-worker cluster per test
+# (see tools/check_tier1_time.py; ~39s)
+pytestmark = pytest.mark.slow
+
 from presto_tpu.exec.cluster import (
     ClusterMemoryManager, ClusterRunner, QueryFailedError,
 )

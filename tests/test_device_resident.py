@@ -13,6 +13,10 @@ unguarded: eager jnp ops legitimately ship Python scalar constants.
 import jax
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — transfer-guard mesh runs are compile-bound
+# (see tools/check_tier1_time.py; ~77s)
+pytestmark = pytest.mark.slow
+
 from presto_tpu.exec.distributed import DistributedRunner
 from presto_tpu.exec.runner import LocalRunner
 

@@ -8,6 +8,10 @@ import pyarrow as pa
 import pyarrow.orc as pa_orc
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — per-test cluster + scan pruning paths
+# (see tools/check_tier1_time.py; ~25s)
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.orc import OrcConnector
 from presto_tpu.connectors.spi import CatalogManager
 from presto_tpu.exec.runner import LocalRunner

@@ -9,6 +9,10 @@ batches, and key tuples compare lexicographically at any arity/width.
 """
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — FULL OUTER matrix is compile-bound
+# (see tools/check_tier1_time.py; ~55s)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def runner():

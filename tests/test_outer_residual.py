@@ -7,6 +7,10 @@ import sqlite3
 
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — residual-join kernels compile-bound
+# (see tools/check_tier1_time.py; ~42s)
+pytestmark = pytest.mark.slow
+
 from test_sql import compare, oracle, runner  # noqa: F401 (fixtures)
 
 QUERIES = [

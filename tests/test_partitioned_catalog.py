@@ -12,6 +12,10 @@ import os
 import numpy as np
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — CTAS + ORC round-trips are IO/compile heavy
+# (see tools/check_tier1_time.py; ~51s)
+pytestmark = pytest.mark.slow
+
 
 def test_grouped_execution_partition_wise_join(orc_runner):
     """Grouped (lifespan) execution: a join of two tables co-partitioned

@@ -40,6 +40,11 @@ def spill_runner(runner):
                     rows_per_batch=1 << 12)
     r.session.properties["query_max_memory"] = BUDGET
     r.session.properties["spill_partitions"] = 4
+    # pin the sort-segment grouping path: the dense composite-code path
+    # (stats-bounded grouping) shrinks partial states to the key domain's
+    # bucket, and these queries then never hit the budget — but the
+    # SPILL machinery is what this module tests
+    r.session.properties["dense_grouping"] = False
     return r
 
 
@@ -70,6 +75,9 @@ def disk_runner(runner, tmp_path_factory):
     r.session.properties["spill_to_disk_bytes"] = 50_000
     r.session.properties["spill_path"] = str(
         tmp_path_factory.mktemp("spill"))
+    # see spill_runner: keep the sort-segment path so states stay big
+    # enough to hit the budget
+    r.session.properties["dense_grouping"] = False
     return r
 
 

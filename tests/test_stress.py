@@ -11,6 +11,10 @@ import time
 
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — stress shapes compile minutes of kernels for 5 tests
+# (see tools/check_tier1_time.py; ~128s)
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.spi import (
     CatalogManager, PageSource, Split, TableHandle,
 )

@@ -11,6 +11,10 @@ import sqlite3
 
 import pytest
 
+# tier-1 budget: excluded from `pytest -m 'not slow'` — executes the full TPC-DS query battery against the oracle
+# (see tools/check_tier1_time.py; ~192s)
+pytestmark = pytest.mark.slow
+
 from presto_tpu.connectors.spi import CatalogManager, TableHandle
 from presto_tpu.connectors.tpcds import TABLES, TpcdsConnector, tpcds_schema
 from presto_tpu.exec.runner import LocalRunner

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Tier-1 time-budget checker: per-module durations from pytest output.
+
+The tier-1 verify command runs ``pytest -m 'not slow'`` under a hard
+870 s timeout — when the suite creeps past it, the run is KILLED and
+every not-yet-run module's passes are lost (round-6 baseline: rc=124 at
+~69%). This tool makes the creep visible: feed it a pytest log produced
+with ``--durations=0`` (or any log containing the `slowest durations`
+section), and it aggregates test durations per module, prints them
+sorted, and flags when the projected total busts the budget.
+
+Usage:
+    python -m pytest tests/ -q -m 'not slow' --durations=0 | tee /tmp/t1.log
+    python tools/check_tier1_time.py /tmp/t1.log [--budget 870]
+
+The per-test durations understate wall-clock (collection, fixtures and
+compile time between tests are unattributed), so the budget check also
+applies a configurable safety factor (default 1.3).
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+# "12.34s call  tests/test_sql.py::test_features[3]" (also setup/teardown)
+_DUR = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(?:call|setup|teardown)\s+"
+    r"(?:.*[/\\])?tests[/\\](test_\w+)\.py::")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("log", help="pytest output containing --durations")
+    ap.add_argument("--budget", type=float, default=870.0,
+                    help="tier-1 timeout in seconds (default 870)")
+    ap.add_argument("--safety", type=float, default=1.3,
+                    help="factor for unattributed overhead (default 1.3)")
+    ap.add_argument("--top", type=int, default=0,
+                    help="print only the N slowest modules")
+    args = ap.parse_args(argv)
+
+    per_module: dict = defaultdict(float)
+    with open(args.log, errors="replace") as f:
+        for line in f:
+            m = _DUR.match(line)
+            if m:
+                per_module[m.group(2)] += float(m.group(1))
+    if not per_module:
+        print("no duration lines found — run pytest with --durations=0",
+              file=sys.stderr)
+        return 2
+
+    total = sum(per_module.values())
+    ranked = sorted(per_module.items(), key=lambda kv: -kv[1])
+    if args.top:
+        ranked = ranked[:args.top]
+    width = max(len(k) for k, _ in ranked)
+    for mod, s in ranked:
+        share = 100.0 * s / total
+        print(f"{mod:<{width}}  {s:8.1f}s  {share:5.1f}%")
+    projected = total * args.safety
+    print(f"{'TOTAL':<{width}}  {total:8.1f}s  (projected "
+          f"~{projected:.0f}s with x{args.safety} overhead; "
+          f"budget {args.budget:.0f}s)")
+    if projected > args.budget:
+        print(f"OVER BUDGET: mark the slowest modules @pytest.mark.slow "
+              f"or split them", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
