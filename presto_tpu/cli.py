@@ -120,11 +120,32 @@ def main(argv=None) -> int:
                          "exit; in-process spans only — point a remote "
                          "worker at the same trace with "
                          "PRESTO_TPU_TRACE=1")
+    ap.add_argument("--history-out", default=None, metavar="PATH",
+                    help="append one JSON line per completed query "
+                         "(the system.runtime.completed_queries "
+                         "record) to this file; embedded server only — "
+                         "with --server, configure HISTORY in the "
+                         "server process")
+    ap.add_argument("--slow-query-log", type=float, default=None,
+                    metavar="SECONDS",
+                    help="emit the full history record of queries "
+                         "slower than this through the structured "
+                         "JSON-lines logger (stderr unless "
+                         "PRESTO_TPU_LOG points elsewhere); embedded "
+                         "server only, like --history-out")
     args = ap.parse_args(argv)
 
     if args.trace_out:
         from .obs.trace import TRACER
         TRACER.enable(True)
+    if args.history_out or args.slow_query_log is not None:
+        from .obs.history import HISTORY
+        HISTORY.configure(sink_path=args.history_out,
+                          slow_threshold_s=args.slow_query_log)
+        if args.slow_query_log is not None:
+            from .obs.log import LOG
+            if not LOG.enabled:
+                LOG.configure(stream=sys.stderr)
 
     embedded = None
     url = args.server

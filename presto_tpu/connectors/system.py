@@ -18,12 +18,23 @@ namespace like the rest of the engine's two-level names):
                  create_time) — the runner's log (reference
                  system.runtime.queries)
 - ``tasks``     (task_id, query_id, stage_id, task_partition, node_id,
-                 state, elapsed_ms) — worker tasks from the process-wide
-                 obs registry (reference system.runtime.tasks)
+                 state, elapsed_ms, output_rows, output_bytes,
+                 straggler, skew_ratio) — worker tasks from the
+                 process-wide obs registry (reference
+                 system.runtime.tasks), straggler/skew columns fed by
+                 the coordinator's StageMonitor
 - ``metrics``   (name, kind, value) — the obs metrics registry
                  (the reference's JMX connector role: engine metrics as
-                 a SQL table)
-- ``nodes``     (node_id, coordinator, state)
+                 a SQL table); histograms flatten to
+                 ``.count/.sum/.min/.max/.p50/.p95/.p99`` rows
+- ``nodes``     (node_id, state, coordinator, heartbeat_age_s,
+                 active_tasks, mem_pool_peak_bytes, uri) — the
+                 coordinator's node federator view (falls back to local
+                 jax devices outside a cluster)
+- ``completed_queries`` — the persistent query history
+                 (obs/history.py), local and cluster queries
+- ``operator_stats``    — per-operator (local) / per-task (cluster)
+                 rows/batches/wall from the same history records
 
 These double as the ``system.runtime.*`` names: the engine flattens
 schemas, so ``system.runtime.queries`` and ``system.default.queries``
@@ -54,9 +65,24 @@ _SCHEMAS: Dict[str, List] = {
                 ("create_time", T.DOUBLE)],
     "tasks": [("task_id", V), ("query_id", V), ("stage_id", T.BIGINT),
               ("task_partition", T.BIGINT), ("node_id", V), ("state", V),
-              ("elapsed_ms", T.DOUBLE)],
+              ("elapsed_ms", T.DOUBLE), ("output_rows", T.BIGINT),
+              ("output_bytes", T.BIGINT), ("straggler", T.BOOLEAN),
+              ("skew_ratio", T.DOUBLE)],
     "metrics": [("name", V), ("kind", V), ("value", T.DOUBLE)],
-    "nodes": [("node_id", V), ("coordinator", T.BOOLEAN), ("state", V)],
+    "nodes": [("node_id", V), ("state", V), ("coordinator", T.BOOLEAN),
+              ("heartbeat_age_s", T.DOUBLE), ("active_tasks", T.BIGINT),
+              ("mem_pool_peak_bytes", T.BIGINT), ("uri", V)],
+    "completed_queries": [
+        ("query_id", V), ("state", V), ("user", V), ("query", V),
+        ("error", V), ("error_code", V), ("create_time", T.DOUBLE),
+        ("elapsed_ms", T.DOUBLE), ("cpu_ms", T.DOUBLE),
+        ("device_sync_ms", T.DOUBLE), ("planning_ms", T.DOUBLE),
+        ("peak_memory_bytes", T.BIGINT), ("rows", T.BIGINT),
+        ("mode", V), ("plan_summary", V)],
+    "operator_stats": [
+        ("query_id", V), ("operator", V), ("rows", T.BIGINT),
+        ("batches", T.BIGINT), ("wall_ms", T.DOUBLE),
+        ("bytes", T.BIGINT)],
 }
 
 
@@ -172,16 +198,58 @@ class SystemConnector(Connector):
                             int(t.get("partition", 0)),
                             t.get("node_id", ""),
                             t.get("state", ""),
-                            float(t.get("elapsed_ms", 0.0))))
+                            float(t.get("elapsed_ms", 0.0)),
+                            int(t.get("output_rows", 0) or 0),
+                            int(t.get("output_bytes", 0) or 0),
+                            bool(t.get("straggler", False)),
+                            float(t.get("skew_ratio", 0.0) or 0.0)))
             return out
         if table == "metrics":
             from ..obs.metrics import REGISTRY
             return [(m["name"], m["kind"], float(m["value"]))
                     for m in REGISTRY.snapshot()]
         if table == "nodes":
+            from ..obs.metrics import NODES
+            rows = NODES.snapshot()
+            if rows:
+                return [(n.get("node_id", ""),
+                         n.get("state", ""),
+                         bool(n.get("coordinator", False)),
+                         float(n.get("heartbeat_age_s", 0.0)),
+                         int(n.get("active_tasks", 0) or 0),
+                         int(n.get("mem_pool_peak_bytes", 0) or 0),
+                         n.get("uri", ""))
+                        for n in rows]
+            # no cluster federation running: local device view
             import jax
-            return [(f"device-{d.id}", d.id == 0, "active")
-                    for d in jax.devices()]
+            return [(f"device-{d.id}", "active", d.id == 0, 0.0, 0, 0,
+                     "") for d in jax.devices()]
+        if table == "completed_queries":
+            from ..obs.history import HISTORY
+            return [(r.get("query_id", ""), r.get("state", ""),
+                     r.get("user", ""), r.get("query", ""),
+                     r.get("error"), r.get("error_code"),
+                     float(r.get("create_time") or 0.0),
+                     float(r.get("elapsed_ms") or 0.0),
+                     float(r.get("cpu_ms") or 0.0),
+                     float(r.get("device_sync_ms") or 0.0),
+                     float(r.get("planning_ms") or 0.0),
+                     int(r.get("peak_memory_bytes") or 0),
+                     int(r.get("rows") or 0),
+                     r.get("mode", ""), r.get("plan_summary", ""))
+                    for r in HISTORY.snapshot()]
+        if table == "operator_stats":
+            from ..obs.history import HISTORY
+            out = []
+            for r in HISTORY.snapshot():
+                for op in r.get("operators") or ():
+                    out.append((r.get("query_id", ""),
+                                op.get("operator", ""),
+                                int(op.get("rows") or 0),
+                                int(op.get("batches") or 0),
+                                float(op.get("wall_ms") or 0.0),
+                                int(op.get("bytes") or 0)))
+            return out
         raise KeyError(table)
 
     def page_source(self, split: Split, columns: Sequence[str],

@@ -22,6 +22,10 @@ class QueryCompletedEvent:
     elapsed_ms: float
     error: Optional[str] = None
     create_time: float = 0.0    # epoch seconds
+    #: rich final record (plan summary, per-operator stats, peak
+    #: memory, cpu/device-sync time) — the publisher-built payload the
+    #: query-history listener (obs.history) persists verbatim
+    history: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,9 +69,9 @@ class EventListenerManager:
 
 
 def completed_event(query_id: str, query: str, user: str, state: str,
-                    started_at: float,
-                    error: Optional[str] = None) -> QueryCompletedEvent:
+                    started_at: float, error: Optional[str] = None,
+                    history: Optional[dict] = None) -> QueryCompletedEvent:
     return QueryCompletedEvent(
         query_id=query_id, query=query, user=user, state=state,
         elapsed_ms=(time.perf_counter() - started_at) * 1e3,
-        error=error, create_time=time.time())
+        error=error, create_time=time.time(), history=history)

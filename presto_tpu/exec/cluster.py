@@ -25,6 +25,7 @@ tasks on a dead node fail fast rather than hang.
 from __future__ import annotations
 
 import json
+import statistics
 import threading
 import time
 import urllib.error
@@ -32,7 +33,8 @@ import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from ..connectors.spi import Split
-from ..obs.metrics import REGISTRY
+from ..obs.log import LOG
+from ..obs.metrics import NODES, REGISTRY, TASKS
 from ..obs.trace import TRACER
 from ..planner import codec
 from ..planner.fragmenter import (
@@ -53,7 +55,7 @@ class HeartbeatFailureDetector:
     exponential-decay rate collapsed to a consecutive-failure budget)."""
 
     def __init__(self, urls, interval_s: float = 5.0,
-                 max_consecutive: int = 3):
+                 max_consecutive: int = 3, on_info=None):
         # ``urls`` may be a static list or a zero-arg callable returning
         # the current membership (discovery-fed, reference
         # DiscoveryNodeManager feeding the failure detector)
@@ -61,6 +63,9 @@ class HeartbeatFailureDetector:
         self.interval_s = interval_s
         self.max_consecutive = max_consecutive
         self.failures: Dict[str, int] = {}
+        #: optional ``(url, info_doc)`` callback on every successful
+        #: ping — the heartbeat doubles as the node-state federator feed
+        self.on_info = on_info
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
@@ -74,20 +79,24 @@ class HeartbeatFailureDetector:
     def stop(self) -> None:
         self._stop.set()
 
-    def ping(self, url: str) -> bool:
+    def ping(self, url: str) -> Optional[dict]:
+        """The worker's ``/v1/info`` doc on success (always truthy),
+        None on failure."""
         try:
             with urllib.request.urlopen(f"{url}/v1/info",
                                         timeout=5) as resp:
-                json.loads(resp.read())
-            return True
+                return json.loads(resp.read()) or {"state": "ACTIVE"}
         except Exception:
-            return False
+            return None
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             for u in self.urls:
-                if self.ping(u):
+                info = self.ping(u)
+                if info is not None:
                     self.failures[u] = 0
+                    if self.on_info is not None:
+                        self.on_info(u, info)
                 else:
                     self.failures[u] = self.failures.get(u, 0) + 1
 
@@ -139,6 +148,8 @@ class ClusterMemoryManager:
             return
         victim = max(live, key=live.get)
         self.killed[victim] = live[victim]
+        LOG.log("query_killed_low_memory", query_id=victim,
+                reserved_bytes=live[victim], limit_bytes=self.limit)
         for url in list(self.runner.worker_urls):
             try:
                 self.runner._request(f"{url}/v1/query/{victim}",
@@ -150,6 +161,126 @@ class ClusterMemoryManager:
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             self.enforce(self.poll_once())
+
+
+_STRAGGLERS_DETECTED = REGISTRY.counter("straggler_detected_total")
+_SKEWED_STAGES = REGISTRY.counter("skewed_stage_total")
+
+
+class StageMonitor:
+    """Coordinator-side progress + straggler/skew detection over task
+    status docs (the role of the reference's SqlStageExecution task
+    stats aggregation feeding the low-memory killer and the webapp's
+    stage timelines; see tf.data's production straggler story for why
+    this must be always-on, not a profiling mode).
+
+    Fed by the status polls the collector already makes: per stage it
+    tracks completion progress, flags a task as a straggler when its
+    elapsed time exceeds ``straggler_ratio`` x the median of the
+    stage's OTHER tasks (median-of-others keeps a 2-task stage
+    flaggable), and flags a stage as skewed when its max per-partition
+    output row count exceeds ``skew_ratio`` x the stage median (the
+    mean is useless here: max/mean is bounded by the task count, so a
+    3-task stage could never cross a 4x threshold). Findings
+    land in the shared TaskRegistry (``system.runtime.tasks`` columns
+    ``straggler``/``skew_ratio``), in counters
+    (``straggler_detected_total``/``skewed_stage_total``) so tests can
+    assert regressions, and in the structured log."""
+
+    straggler_ratio = 3.0
+    min_elapsed_ms = 25.0
+    skew_ratio = 4.0
+    min_stage_rows = 256
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self._stragglers: set = set()
+        self._skew: Dict[int, float] = {}
+        self.progress: Dict[int, float] = {}
+        self.last_statuses: List[dict] = []
+
+    @staticmethod
+    def _stage_of(task_id: str) -> int:
+        parts = task_id.split(".")
+        return int(parts[1]) if len(parts) > 2 and parts[1].isdigit() \
+            else 0
+
+    def _by_stage(self, statuses: List[dict]) -> Dict[int, List[dict]]:
+        out: Dict[int, List[dict]] = {}
+        for st in statuses:
+            tid = st.get("taskId")
+            if tid:
+                out.setdefault(self._stage_of(tid), []).append(st)
+        return out
+
+    def observe(self, statuses: List[dict]) -> None:
+        self.last_statuses = statuses
+        for fid, sts in self._by_stage(statuses).items():
+            done = sum(1 for s in sts if s.get("state") == "FINISHED")
+            self.progress[fid] = round(100.0 * done / len(sts), 1)
+            for st in sts:
+                # mirror worker status into the coordinator's registry:
+                # system.runtime.tasks works against remote workers too
+                TASKS.update(
+                    st["taskId"], query_id=self.query_id, stage_id=fid,
+                    state=st.get("state", ""),
+                    elapsed_ms=float(st.get("elapsedMs") or 0.0),
+                    output_rows=int(st.get("rowsOut") or 0),
+                    output_bytes=int(st.get("bytesOut") or 0))
+            elapsed = [float(s.get("elapsedMs") or 0.0) for s in sts]
+            if len(elapsed) < 2:
+                continue
+            for i, st in enumerate(sts):
+                tid = st["taskId"]
+                if tid in self._stragglers:
+                    continue
+                others = elapsed[:i] + elapsed[i + 1:]
+                med = statistics.median(others)
+                if med >= self.min_elapsed_ms \
+                        and elapsed[i] > self.straggler_ratio * med:
+                    self._stragglers.add(tid)
+                    _STRAGGLERS_DETECTED.inc()
+                    TASKS.update(tid, straggler=True)
+                    LOG.log("straggler_detected",
+                            query_id=self.query_id, task_id=tid,
+                            stage_id=fid,
+                            elapsed_ms=round(elapsed[i], 1),
+                            stage_median_ms=round(med, 1))
+
+    def finalize(self, statuses: List[dict]) -> Dict[str, object]:
+        """Final pass once every task reached a terminal state: one
+        more straggler sweep over frozen elapsed values (a query that
+        finished within one long-poll never hit ``observe``), then
+        per-stage output-row skew. Returns the summary that rides the
+        query-history record."""
+        if statuses:
+            self.observe(statuses)
+        for fid, sts in self._by_stage(self.last_statuses).items():
+            if fid in self._skew or len(sts) < 2:
+                continue
+            rows = [float(s.get("rowsOut") or 0.0) for s in sts]
+            total = sum(rows)
+            if total < self.min_stage_rows:
+                continue
+            # floor the median at one row: an all-in-one-partition
+            # stage must flag with a FINITE ratio (inf would leak
+            # non-strict "Infinity" tokens into the JSONL history sink
+            # and the structured log)
+            ratio = max(rows) / max(statistics.median(rows), 1.0)
+            if ratio >= self.skew_ratio:
+                self._skew[fid] = round(ratio, 2)
+                _SKEWED_STAGES.inc()
+                for st in sts:
+                    TASKS.update(st["taskId"], skew_ratio=round(ratio, 2))
+                LOG.log("stage_skew_detected", query_id=self.query_id,
+                        stage_id=fid, skew_ratio=round(ratio, 2),
+                        rows=[int(r) for r in rows])
+        return self.summary()
+
+    def summary(self) -> Dict[str, object]:
+        return {"progress": dict(sorted(self.progress.items())),
+                "stragglers": sorted(self._stragglers),
+                "skewed_stages": dict(sorted(self._skew.items()))}
 
 
 class ClusterRunner:
@@ -171,7 +302,13 @@ class ClusterRunner:
         self.session = self.local.session
         self.rows_per_batch = rows_per_batch
         self._seq = 0
-        self.detector = HeartbeatFailureDetector(self._current_urls)
+        #: worker url -> node id learned from /v1/info (node federator)
+        self._node_ids: Dict[str, str] = {}
+        NODES.update("coordinator", state="ACTIVE", coordinator=True,
+                     uri="", active_tasks=0, mem_pool_peak_bytes=0)
+        self.detector = HeartbeatFailureDetector(
+            self._current_urls, on_info=self._note_node_info)
+        self._heartbeat_on = bool(heartbeat)
         if heartbeat:
             self.detector.start()
         self.memory_manager: Optional[ClusterMemoryManager] = None
@@ -193,6 +330,35 @@ class ClusterRunner:
     @property
     def worker_urls(self) -> List[str]:
         return self._current_urls()
+
+    # -- node-state federation (system.runtime.nodes) ------------------------
+    def _note_node_info(self, url: str, info: dict) -> None:
+        """Fold one worker's ``/v1/info`` doc into the process-wide
+        node registry — the feed of ``system.runtime.nodes`` and of the
+        node-labeled series on the coordinator's ``/v1/metrics``."""
+        nid = str(info.get("nodeId") or url)
+        self._node_ids[url] = nid
+        tasks = info.get("tasks") or {}
+        NODES.update(nid, state=str(info.get("state", "ACTIVE")),
+                     coordinator=False, uri=url,
+                     active_tasks=int(tasks.get("RUNNING", 0) or 0),
+                     mem_pool_peak_bytes=int(
+                         info.get("memPoolPeakBytes", 0) or 0))
+
+    def poll_nodes(self, urls: Optional[List[str]] = None) -> None:
+        """One synchronous federation sweep (the background heartbeat
+        does the same continuously when enabled); unreachable workers
+        keep their last heartbeat timestamp so their age grows."""
+        for url in (urls if urls is not None else self.worker_urls):
+            try:
+                info = self._request(f"{url}/v1/info", retries=0,
+                                     timeout=5)
+            except Exception:
+                nid = self._node_ids.get(url)
+                if nid:
+                    NODES.update(nid, seen=False, state="UNREACHABLE")
+                continue
+            self._note_node_info(url, info)
 
     # -- HTTP helpers --------------------------------------------------------
     #: transient-failure budget for one remote-task call (reference
@@ -259,26 +425,86 @@ class ClusterRunner:
         run_init_plans(ex, plan)
         init_values = ex.init_values
         fragmented = fragment_plan(plan.root)
-        return self._run_fragments(fragmented, init_values)
+        return self._run_fragments(fragmented, init_values, sql)
 
     # -- scheduling ----------------------------------------------------------
     def _run_fragments(self, fp: FragmentedPlan,
-                       init_values: List[object]) -> QueryResult:
+                       init_values: List[object],
+                       sql: str = "") -> QueryResult:
         workers = self.detector.active()
         if not workers:
             raise QueryFailedError("no active workers")
         self._seq += 1
         qid = f"cq_{self._seq:06d}"
         REGISTRY.counter("cluster_queries_total").inc()
-        with TRACER.span("query", query_id=qid, mode="cluster",
-                         workers=len(workers)):
-            return self._schedule_and_collect(fp, init_values, workers,
-                                              qid)
+        if not self._heartbeat_on:
+            # no background heartbeat federating node state (embedded/
+            # test setups): one synchronous sweep keeps
+            # system.runtime.nodes fresh; with the heartbeat on, its
+            # 5s on_info feed already does this without adding N RTTs
+            # to every query
+            self.poll_nodes(workers)
+        from ..connectors.system import QueryLogEntry
+        from ..events import QueryCompletedEvent
+        entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0,
+                              create_time=time.time())
+        with self.local._state_lock:
+            self.local.query_log.append(entry)
+            # same bound LocalRunner.execute applies: a cluster-only
+            # coordinator must not grow the log without limit
+            if len(self.local.query_log) > 1000:
+                del self.local.query_log[:-500]
+        monitor = StageMonitor(qid)
+        t0 = time.perf_counter()
+        error: Optional[str] = None
+        try:
+            with TRACER.span("query", query_id=qid, mode="cluster",
+                             workers=len(workers)):
+                out = self._schedule_and_collect(
+                    fp, init_values, workers, qid, monitor)
+            entry.state = "FINISHED"
+            return out
+        except Exception as e:
+            entry.state = "FAILED"
+            error = str(e)
+            raise
+        finally:
+            entry.elapsed_ms = (time.perf_counter() - t0) * 1e3
+            entry.error = error
+            summary = monitor.summary()
+            history = {
+                "query_id": qid, "query": entry.query, "user": "",
+                "state": entry.state, "error": error,
+                "error_code": None, "create_time": entry.create_time,
+                "elapsed_ms": round(entry.elapsed_ms, 3),
+                "mode": "cluster", "plan_summary": " | ".join(
+                    f"stage{f.id}[{f.partitioning}]"
+                    for f in fp.fragments),
+                "stages": summary,
+                "operators": [
+                    {"operator": "task " + str(st.get("taskId", "")),
+                     "rows": int(st.get("rowsOut") or 0),
+                     "bytes": int(st.get("bytesOut") or 0),
+                     "batches": 0,
+                     "wall_ms": float(st.get("elapsedMs") or 0.0)}
+                    for st in monitor.last_statuses],
+            }
+            self.local.events.query_completed(QueryCompletedEvent(
+                query_id=qid, query=entry.query, user="",
+                state=entry.state, elapsed_ms=entry.elapsed_ms,
+                error=error, create_time=entry.create_time,
+                history=history))
+            if LOG.enabled:
+                LOG.log("query_completed", query_id=qid, mode="cluster",
+                        state=entry.state,
+                        elapsed_ms=round(entry.elapsed_ms, 3),
+                        error=error, **summary)
 
     def _schedule_and_collect(self, fp: FragmentedPlan,
                               init_values: List[object],
-                              workers: List[str],
-                              qid: str) -> QueryResult:
+                              workers: List[str], qid: str,
+                              monitor: Optional[StageMonitor] = None
+                              ) -> QueryResult:
         # task counts per fragment
         consumer_of: Dict[int, int] = {}
         for f in fp.fragments:
@@ -336,14 +562,36 @@ class ClusterRunner:
                             sources, init_values))
                 task_urls[f.id] = urls
                 all_tasks.extend(urls)
-            return self._collect(fp, task_urls, all_tasks)
+            return self._collect(fp, task_urls, all_tasks, monitor)
         finally:
+            if monitor is not None:
+                # final status sweep BEFORE the task DELETEs: frozen
+                # elapsed/rows feed the last straggler pass, the skew
+                # pass, and the query-history operator records
+                monitor.finalize(self._task_statuses(all_tasks))
             self._harvest_spans(all_tasks)
             for u in all_tasks:
                 try:
                     self._request(u, method="DELETE")
                 except Exception:
                     pass
+
+    def _task_statuses(self, all_tasks: List[str]) -> List[dict]:
+        """Best-effort status fetch for every task (single attempt —
+        this runs on the completion path, including after a failure, so
+        a dead worker must cost ONE timeout, not one per task: the
+        first unreachable task skips the rest of that worker)."""
+        out: List[dict] = []
+        dead: set = set()
+        for u in all_tasks:
+            base = u.split("/v1/task/")[0]
+            if base in dead:
+                continue
+            try:
+                out.append(self._request(u, retries=0, timeout=2))
+            except Exception:
+                dead.add(base)
+        return out
 
     def _harvest_spans(self, all_tasks: List[str]) -> None:
         """Pull each task's spans (its share of this query's trace) back
@@ -414,7 +662,8 @@ class ClusterRunner:
     # -- result collection ---------------------------------------------------
     def _collect(self, fp: FragmentedPlan,
                  task_urls: Dict[int, List[str]],
-                 all_tasks: List[str]) -> QueryResult:
+                 all_tasks: List[str],
+                 monitor: Optional[StageMonitor] = None) -> QueryResult:
         from .pages import deserialize_page
         root = fp.root
         (root_url,) = task_urls[root.id]
@@ -444,23 +693,34 @@ class ClusterRunner:
                 rows.extend(deserialize_page(page).to_pylist())
             if complete:
                 break
-            self._check_tasks(all_tasks)
+            self._check_tasks(all_tasks, monitor)
         return QueryResult(names=names, types=types, rows=rows)
 
-    def _check_tasks(self, all_tasks: List[str]) -> None:
+    def _check_tasks(self, all_tasks: List[str],
+                     monitor: Optional[StageMonitor] = None) -> None:
         # failure-path diagnostic probes: single attempt with a short
         # timeout — this path runs when something already looks wrong,
         # and burning the full retry budget per task against a dead
-        # worker turns fail-fast into minutes of hanging
+        # worker turns fail-fast into minutes of hanging. The liveness
+        # polls double as the straggler monitor's status feed.
+        statuses: List[dict] = []
+        failed: Optional[dict] = None
         for u in all_tasks:
             try:
                 st = self._request(u, retries=0, timeout=5)
             except Exception as e:
                 raise QueryFailedError(
                     f"lost task {u}: {e}") from None
-            if st.get("state") in ("FAILED", "ABORTED"):
-                raise QueryFailedError(
-                    f"task {st.get('taskId')} failed: {st.get('error')}")
+            statuses.append(st)
+            if failed is None \
+                    and st.get("state") in ("FAILED", "ABORTED"):
+                failed = st
+        if monitor is not None:
+            monitor.observe(statuses)
+        if failed is not None:
+            raise QueryFailedError(
+                f"task {failed.get('taskId')} failed: "
+                f"{failed.get('error')}")
 
     def _fail_tasks(self, all_tasks: List[str]) -> None:
         try:

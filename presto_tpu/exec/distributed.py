@@ -882,19 +882,44 @@ class DistributedRunner:
                 "session statements")
         self._seq += 1
         qid = f"dq_{self._seq:06d}"
-        with TRACER.span("query", query_id=qid,
-                         mode="spmd", shards=self.mesh.devices.size):
-            with TRACER.span("plan"):
-                plan = self._optimize(plan_query(stmt, self.session),
-                                      self.session)
-            from .local import run_init_plans
-            ex = DistributedExecutor(self.session, self.rows_per_batch,
-                                     self.mesh)
-            run_init_plans(ex, plan)
-            root = plan.root
-            batches = list(ex.run(root.child))
-            ex.check_errors()
-            with TRACER.span("device-sync", what="result-gather"):
-                rows = [r for b in batches for r in b.to_pylist()]
-        return QueryResult(names=[f.name for f in root.fields],
-                           types=[f.type for f in root.fields], rows=rows)
+        import time as _time
+        from ..obs.history import HISTORY
+        t0 = _time.perf_counter()
+        create_time = _time.time()
+        error: Optional[str] = None
+        rows = None
+        try:
+            with TRACER.span("query", query_id=qid,
+                             mode="spmd", shards=self.mesh.devices.size):
+                with TRACER.span("plan"):
+                    plan = self._optimize(plan_query(stmt, self.session),
+                                          self.session)
+                from .local import run_init_plans
+                ex = DistributedExecutor(self.session,
+                                         self.rows_per_batch, self.mesh)
+                run_init_plans(ex, plan)
+                root = plan.root
+                batches = list(ex.run(root.child))
+                ex.check_errors()
+                with TRACER.span("device-sync", what="result-gather"):
+                    rows = [r for b in batches for r in b.to_pylist()]
+            return QueryResult(names=[f.name for f in root.fields],
+                               types=[f.type for f in root.fields],
+                               rows=rows)
+        except Exception as e:
+            error = str(e)
+            raise
+        finally:
+            # the SPMD path has no EventListenerManager; feed the
+            # persistent query history directly so
+            # system.runtime.completed_queries covers all three
+            # executors
+            HISTORY.add({
+                "query_id": qid, "query": sql.strip(), "user": "",
+                "state": "FAILED" if error is not None else "FINISHED",
+                "error": error, "create_time": create_time,
+                "elapsed_ms": round(
+                    (_time.perf_counter() - t0) * 1e3, 3),
+                "rows": None if rows is None else len(rows),
+                "mode": "spmd",
+            })

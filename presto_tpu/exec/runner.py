@@ -51,6 +51,10 @@ class LocalRunner:
         # metrics sink: query/split completion events feed the
         # process-wide registry (system.runtime.metrics)
         attach_event_listeners(self.events)
+        # history sink: every completed query leaves one final record
+        # in the process-wide store (system.runtime.completed_queries)
+        from ..obs.history import attach_history
+        attach_history(self.events)
         self.access_control = AccessControl()    # allow-all until rules set
         from ..server.security import RoleManager
         self.roles = RoleManager()               # enforce=False by default
@@ -96,18 +100,25 @@ class LocalRunner:
                     if old not in running:   # keep live queries visible
                         del self.live_stats[old]
         t0 = _time.perf_counter()
+        c0 = _time.process_time()
         error: Optional[str] = None
+        error_code: Optional[str] = None
+        rows_out: Optional[int] = None
+        trace_id = None
         REGISTRY.counter("queries_started_total").inc()
         try:
-            with TRACER.span("query", query_id=qid, user=user):
+            with TRACER.span("query", query_id=qid, user=user) as qspan:
+                trace_id = getattr(qspan, "trace_id", None)
                 out = self._execute_stmt(stmt, properties, user,
                                          cancel_event=cancel_event,
                                          stats=stats)
+            rows_out = len(out.rows)
             entry.state = "FINISHED"
             return out
         except Exception as e:
             entry.state = "FAILED"
             error = str(e)
+            error_code = getattr(e, "name", type(e).__name__)
             raise
         finally:
             entry.elapsed_ms = (_time.perf_counter() - t0) * 1e3
@@ -120,8 +131,18 @@ class LocalRunner:
                 self.events.split_completed(SplitCompletedEvent(
                     qid, s["table"], s["split"], s["wallMs"],
                     s["batches"]))
+            cpu_ms = (_time.process_time() - c0) * 1e3
             self.events.query_completed(completed_event(
-                qid, sql.strip(), user, entry.state, t0, error))
+                qid, sql.strip(), user, entry.state, t0, error,
+                history=self._history_record(
+                    entry, stats, user, cpu_ms, rows_out, error_code,
+                    trace_id)))
+            from ..obs.log import LOG
+            if LOG.enabled:
+                LOG.log("query_completed", query_id=qid,
+                        state=entry.state, user=user,
+                        elapsed_ms=round(entry.elapsed_ms, 3),
+                        error=error)
 
     def _feed_metrics(self, stats) -> None:
         """Fold one query's per-node stats and memory-pool stats into the
@@ -138,6 +159,55 @@ class LocalRunner:
                     st.rows)
         # memory_pool_peak_bytes is fed at reservation time (memory.py
         # _POOL_PEAK) — the pool, not the query, owns that gauge
+
+    def _history_record(self, entry, stats, user: str, cpu_ms: float,
+                        rows_out: Optional[int],
+                        error_code: Optional[str],
+                        trace_id) -> Dict[str, object]:
+        """Final per-query record for the history store: plan summary
+        + per-operator rows/batches/wall from the StatsCollector, peak
+        memory from the pool, and (tracer on) plan/device-sync seconds
+        from this query's spans."""
+        by_kind: Dict[str, Dict[str, float]] = {}
+        for node, st in list(stats.by_node.items()):
+            kind = type(node).__name__.replace("Node", "")
+            agg = by_kind.setdefault(
+                kind, {"rows": 0, "batches": 0, "wall_ms": 0.0})
+            agg["rows"] += st.rows
+            agg["batches"] += st.batches
+            agg["wall_ms"] += st.wall_s * 1e3
+        # no "bytes" key: the local stats collector doesn't measure
+        # operator output bytes (cluster records carry per-task
+        # bytesOut); rows are live only in analyze mode — counting
+        # them on the normal path would cost a device sync per batch
+        operators = [{"operator": k, "rows": int(v["rows"]),
+                      "batches": int(v["batches"]),
+                      "wall_ms": round(v["wall_ms"], 3)}
+                     for k, v in by_kind.items()]
+        pool_stats = getattr(self.session, "last_memory_stats", None)
+        planning_ms = device_sync_ms = 0.0
+        if TRACER.enabled and trace_id is not None:
+            for s in TRACER.export(trace_id):
+                dur = (float(s.get("end", 0.0))
+                       - float(s.get("start", 0.0))) * 1e3
+                if s.get("name") == "plan":
+                    planning_ms += dur
+                elif s.get("name") == "device-sync":
+                    device_sync_ms += dur
+        return {
+            "query_id": entry.query_id, "query": entry.query,
+            "user": user, "state": entry.state, "error": entry.error,
+            "error_code": error_code, "create_time": entry.create_time,
+            "elapsed_ms": round(entry.elapsed_ms, 3),
+            "cpu_ms": round(cpu_ms, 3),
+            "device_sync_ms": round(device_sync_ms, 3),
+            "planning_ms": round(planning_ms, 3),
+            "peak_memory_bytes": int(
+                getattr(pool_stats, "peak_bytes", 0) or 0),
+            "rows": rows_out, "mode": "local",
+            "plan_summary": " -> ".join(by_kind),
+            "operators": operators,
+        }
 
     def plan(self, sql: str, optimized: bool = True) -> LogicalPlan:
         stmt = parse_statement(sql)
@@ -236,6 +306,11 @@ class LocalRunner:
                 if trace_spans:
                     from ..planner.printer import format_trace_summary
                     text += "\n" + format_trace_summary(trace_spans)
+                if stats is not None:
+                    from ..planner.printer import format_skew_summary
+                    skew = format_skew_summary(stats)
+                    if skew:
+                        text += "\n" + skew
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.split("\n")])
         if isinstance(stmt, A.ShowCatalogs):

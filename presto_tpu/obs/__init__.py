@@ -11,11 +11,25 @@ presto-main/.../connector/jmx/) — reshaped for a device runtime:
                   context for distributed stitching;
 - ``obs.metrics`` process-wide counters/gauges/histograms fed by direct
                   instrumentation and by an EventListenerManager sink,
-                  queryable as ``system.runtime.metrics``.
+                  queryable as ``system.runtime.metrics``;
+- ``obs.exposition`` Prometheus/OpenMetrics text rendering of the
+                  registry — the ``GET /v1/metrics`` scrape surface on
+                  workers and the coordinator;
+- ``obs.history`` bounded persistent query history (+ optional JSONL
+                  sink), queryable as ``system.runtime.
+                  {completed_queries,operator_stats}``;
+- ``obs.log``     structured JSON-lines logging correlated by
+                  query/task/trace ids from the span context.
 
-Both are always importable and safe when idle: the tracer is OFF by
-default (a disabled ``span()`` returns a shared no-op and records
-nothing), and metric updates are single dict/number operations.
+Everything is always importable and safe when idle: the tracer is OFF
+by default (a disabled ``span()`` returns a shared no-op and records
+nothing), the logger is off by default, and metric updates are single
+dict/number operations.
 """
 from .trace import TRACER, Span, chrome_trace, write_chrome_trace  # noqa: F401
-from .metrics import REGISTRY, TASKS, attach_event_listeners  # noqa: F401
+from .metrics import (  # noqa: F401
+    NODES, REGISTRY, TASKS, attach_event_listeners,
+)
+from .exposition import parse_exposition, render_exposition  # noqa: F401
+from .history import HISTORY, attach_history  # noqa: F401
+from .log import LOG  # noqa: F401

@@ -1,0 +1,94 @@
+"""Persistent query history: bounded ring + optional JSONL sink.
+
+The role of the reference's completed-query history (QueryMonitor's
+QueryCompletedEvent payload retained past process queries, surfaced as
+``system.runtime.completed_queries``): every finished or failed query —
+local or cluster — leaves one final record carrying the SQL text, a
+plan summary, wall/cpu/device-sync time, per-operator rows/bytes, peak
+memory, and the error, fed through ``events.EventListenerManager`` so
+both executors publish the same way.
+
+The ring is bounded (records die with the process unless a JSONL sink
+is configured with ``HISTORY.configure(sink_path=...)`` / the CLI's
+``--history-out``); ``slow_threshold_s`` additionally emits the full
+record through the structured logger (``--slow-query-log``).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+#: flattened columns of system.runtime.completed_queries, in order
+RECORD_COLUMNS = (
+    "query_id", "state", "user", "query", "error", "error_code",
+    "create_time", "elapsed_ms", "cpu_ms", "device_sync_ms",
+    "planning_ms", "peak_memory_bytes", "rows", "mode", "plan_summary")
+
+
+class QueryHistory:
+    """Bounded store of final per-query records (dicts)."""
+
+    def __init__(self, max_records: int = 1000):
+        self._ring: deque = deque(maxlen=max_records)
+        self._lock = threading.Lock()
+        self.sink_path: Optional[str] = None
+        self.slow_threshold_s: Optional[float] = None
+
+    def configure(self, sink_path: Optional[str] = None,
+                  slow_threshold_s: Optional[float] = None) -> None:
+        if sink_path is not None:
+            self.sink_path = sink_path
+        if slow_threshold_s is not None:
+            self.slow_threshold_s = slow_threshold_s
+
+    def add(self, record: Dict) -> None:
+        with self._lock:
+            self._ring.append(record)
+        if self.sink_path:
+            try:
+                with open(self.sink_path, "a") as f:
+                    f.write(json.dumps(record, default=str) + "\n")
+            except Exception:   # history must not break queries
+                pass
+        thr = self.slow_threshold_s
+        if thr is not None \
+                and float(record.get("elapsed_ms") or 0.0) >= thr * 1e3:
+            from .log import LOG
+            LOG.log("slow_query", **record)
+
+    def snapshot(self) -> List[Dict]:
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+#: the process-wide history store
+HISTORY = QueryHistory()
+
+
+def attach_history(events, history: Optional[QueryHistory] = None) -> None:
+    """Register a query-completion listener that lands every query's
+    final record in the history store. The runner attaches the rich
+    payload on the event (``QueryCompletedEvent.history``); events
+    without one (foreign publishers) still get a minimal record from
+    the event fields."""
+    h = history if history is not None else HISTORY
+
+    def on_query_completed(ev) -> None:
+        rec = dict(getattr(ev, "history", None) or {})
+        rec.setdefault("query_id", ev.query_id)
+        rec.setdefault("query", ev.query)
+        rec.setdefault("user", ev.user)
+        rec.setdefault("state", ev.state)
+        rec.setdefault("error", ev.error)
+        rec.setdefault("elapsed_ms", round(ev.elapsed_ms, 3))
+        rec.setdefault("create_time", ev.create_time)
+        rec.setdefault("mode", "local")
+        h.add(rec)
+
+    events.register(on_query_completed)

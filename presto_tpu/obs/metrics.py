@@ -13,12 +13,40 @@ registry can stay always-on; nothing here touches the device.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _INF = float("inf")
+
+#: default histogram bucket upper bounds (seconds-flavoured exponential
+#: ladder; the last implicit bucket is +Inf). Shared by every histogram
+#: so the exposition endpoint can render Prometheus ``_bucket`` series
+#: and ``snapshot()`` can derive p50/p95/p99 without a quantile store.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def _quantile(q: float, count: int, bucket_counts: List[int],
+              bounds: Tuple[float, ...], lo_clamp: float,
+              hi_clamp: float) -> float:
+    """Linear-interpolated quantile from bucket counts (the
+    ``histogram_quantile`` estimate), clamped to the observed range."""
+    target = q * count
+    cum = 0
+    lo = 0.0
+    for i, c in enumerate(bucket_counts):
+        hi = bounds[i] if i < len(bounds) else hi_clamp
+        if c and cum + c >= target:
+            frac = (target - cum) / c
+            v = lo + (hi - lo) * frac
+            return min(max(v, lo_clamp), hi_clamp)
+        cum += c
+        lo = hi
+    return hi_clamp
 
 
 class Counter:
@@ -57,17 +85,22 @@ class Gauge:
 
 
 class Histogram:
-    """Count/sum/min/max summary (no buckets: the consumers are SQL and
-    EXPLAIN output, not a quantile store)."""
+    """Count/sum/min/max summary plus fixed exponential buckets, so the
+    exposition endpoint can render Prometheus ``_bucket`` series and the
+    SQL surface can carry derived p50/p95/p99."""
 
-    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+    __slots__ = ("name", "count", "sum", "min", "max", "buckets",
+                 "bucket_counts", "_lock")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
         self.name = name
         self.count = 0
         self.sum = 0.0
         self.min = _INF
         self.max = -_INF
+        self.buckets = tuple(buckets)
+        self.bucket_counts = [0] * (len(self.buckets) + 1)  # last = +Inf
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -79,6 +112,38 @@ class Histogram:
                 self.min = v
             if v > self.max:
                 self.max = v
+            self.bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+
+    def state(self) -> Dict:
+        """Consistent copy with cumulative buckets and derived
+        quantiles — the shared feed of ``snapshot()`` and the
+        Prometheus exposition."""
+        with self._lock:
+            count, total = self.count, self.sum
+            mn, mx = self.min, self.max
+            counts = list(self.bucket_counts)
+        doc: Dict = {"name": self.name, "kind": "histogram",
+                     "count": count, "sum": total}
+        if count:
+            doc["min"], doc["max"] = mn, mx
+            doc["quantiles"] = {
+                q: _quantile(q, count, counts, self.buckets, mn, mx)
+                for q in (0.5, 0.95, 0.99)}
+        cum = 0
+        cumulative = []
+        for i, c in enumerate(counts):
+            cum += c
+            le = self.buckets[i] if i < len(self.buckets) else _INF
+            cumulative.append((le, cum))
+        doc["buckets"] = cumulative
+        return doc
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self.count:
+                return None
+            return _quantile(q, self.count, list(self.bucket_counts),
+                             self.buckets, self.min, self.max)
 
 
 class MetricsRegistry:
@@ -111,10 +176,11 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
-    def snapshot(self) -> List[Dict]:
-        """JSON-able rows, one per scalar: histograms flatten to
-        ``name.count/sum/min/max`` — the ``system.runtime.metrics``
-        surface."""
+    def collect(self) -> List[Dict]:
+        """Typed metric states, one entry per metric — the feed of the
+        Prometheus exposition (``obs.exposition``). Histograms stay
+        structured (count/sum/buckets/quantiles); counters and gauges
+        are ``{"name", "kind", "value"}``."""
         with self._lock:
             metrics = sorted(self._metrics.items())
         out: List[Dict] = []
@@ -126,15 +192,33 @@ class MetricsRegistry:
                 out.append({"name": name, "kind": "gauge",
                             "value": m.value})
             elif isinstance(m, Histogram):
-                out.append({"name": f"{name}.count", "kind": "histogram",
-                            "value": float(m.count)})
-                out.append({"name": f"{name}.sum", "kind": "histogram",
-                            "value": m.sum})
-                if m.count:
-                    out.append({"name": f"{name}.min",
-                                "kind": "histogram", "value": m.min})
-                    out.append({"name": f"{name}.max",
-                                "kind": "histogram", "value": m.max})
+                out.append(m.state())
+        return out
+
+    def snapshot(self) -> List[Dict]:
+        """JSON-able rows, one per scalar: histograms flatten to
+        ``name.count/sum/min/max/p50/p95/p99`` — the
+        ``system.runtime.metrics`` surface."""
+        out: List[Dict] = []
+        for m in self.collect():
+            if m["kind"] != "histogram":
+                out.append(m)
+                continue
+            name = m["name"]
+            out.append({"name": f"{name}.count", "kind": "histogram",
+                        "value": float(m["count"])})
+            out.append({"name": f"{name}.sum", "kind": "histogram",
+                        "value": m["sum"]})
+            if m["count"]:
+                out.append({"name": f"{name}.min",
+                            "kind": "histogram", "value": m["min"]})
+                out.append({"name": f"{name}.max",
+                            "kind": "histogram", "value": m["max"]})
+                for q, label in ((0.5, "p50"), (0.95, "p95"),
+                                 (0.99, "p99")):
+                    out.append({"name": f"{name}.{label}",
+                                "kind": "histogram",
+                                "value": m["quantiles"][q]})
         return out
 
     def reset(self) -> None:
@@ -149,6 +233,7 @@ class MetricsRegistry:
                 elif isinstance(m, Histogram):
                     m.count, m.sum = 0, 0.0
                     m.min, m.max = _INF, -_INF
+                    m.bucket_counts = [0] * (len(m.buckets) + 1)
 
 
 #: the process-wide registry
@@ -156,6 +241,9 @@ REGISTRY = MetricsRegistry()
 
 
 # -- task registry (system.runtime.tasks) ------------------------------------
+
+_TERMINAL_TASK_STATES = ("FINISHED", "FAILED", "ABORTED")
+
 
 class TaskRegistry:
     """Bounded registry of worker-task states: the feed of the
@@ -168,14 +256,28 @@ class TaskRegistry:
         self._lock = threading.Lock()
 
     def update(self, task_id: str, **fields) -> None:
+        evicted = 0
         with self._lock:
             t = self._tasks.get(task_id)
             if t is None:
                 t = self._tasks[task_id] = {
                     "task_id": task_id, "created": time.time()}
-                while len(self._tasks) > self._max:
-                    self._tasks.popitem(last=False)
             t.update(fields)
+            # over the cap: evict the oldest terminal task first — a
+            # RUNNING entry must stay visible even when the registry is
+            # full of history; only when everything is live does the
+            # plain-oldest fall (and the counter makes either loss
+            # observable instead of silent)
+            while len(self._tasks) > self._max:
+                victim = next(
+                    (k for k, v in self._tasks.items()
+                     if v.get("state") in _TERMINAL_TASK_STATES), None)
+                if victim is None:
+                    victim = next(iter(self._tasks))
+                del self._tasks[victim]
+                evicted += 1
+        if evicted:
+            REGISTRY.counter("task_registry_evicted_total").inc(evicted)
 
     def snapshot(self) -> List[Dict]:
         with self._lock:
@@ -187,6 +289,48 @@ class TaskRegistry:
 
 
 TASKS = TaskRegistry()
+
+
+# -- node registry (system.runtime.nodes) -------------------------------------
+
+class NodeRegistry:
+    """Coordinator-side view of cluster nodes: the feed of the
+    ``system.runtime.nodes`` table and of the node-labeled series on the
+    coordinator's ``/v1/metrics`` exposition (reference
+    connector/system/NodesSystemTable over DiscoveryNodeManager).
+    Updated by the ClusterRunner's heartbeat/info polls; heartbeat age
+    is computed at read time so a stalled poller shows as a growing
+    age, not a frozen-fresh one."""
+
+    def __init__(self):
+        self._nodes: Dict[str, Dict] = {}
+        self._lock = threading.Lock()
+
+    def update(self, node_id: str, seen: bool = True, **fields) -> None:
+        with self._lock:
+            n = self._nodes.setdefault(node_id, {"node_id": node_id})
+            n.update(fields)
+            if seen:
+                n["last_seen"] = time.monotonic()
+
+    def snapshot(self) -> List[Dict]:
+        now = time.monotonic()
+        with self._lock:
+            out = []
+            for n in self._nodes.values():
+                doc = dict(n)
+                seen = doc.pop("last_seen", None)
+                doc["heartbeat_age_s"] = (
+                    round(now - seen, 3) if seen is not None else _INF)
+                out.append(doc)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._nodes.clear()
+
+
+NODES = NodeRegistry()
 
 
 # -- EventListenerManager sink -----------------------------------------------

@@ -234,6 +234,19 @@ class Tracer:
 TRACER = Tracer()
 
 
+def current_span_ids() -> Dict:
+    """Correlation ids of the active span for structured logging
+    (``obs.log``): ``query_id``/``task_id``/``stage_id`` attributes
+    plus the trace id, when a span is open on this context."""
+    cur = _CURRENT.get()
+    if not isinstance(cur, Span):
+        return {}
+    out = {k: cur.attrs[k] for k in ("query_id", "task_id", "stage_id")
+           if k in cur.attrs}
+    out["trace_id"] = cur.trace_id
+    return out
+
+
 # -- Chrome-trace (chrome://tracing / Perfetto) export -----------------------
 
 def chrome_trace(spans: List[Dict]) -> Dict:

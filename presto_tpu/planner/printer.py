@@ -138,6 +138,46 @@ def format_trace_summary(spans) -> str:
     return "\n".join(lines)
 
 
+def format_skew_summary(stats, straggler_ratio: float = 3.0,
+                        min_wall_ms: float = 10.0) -> str:
+    """Skew section appended to EXPLAIN ANALYZE: per-table split
+    wall-time and batch-count spread, flagging splits whose wall time
+    exceeds ``straggler_ratio`` x the median of the table's other
+    splits — the single-process analogue of the coordinator's
+    straggler detection (exec/cluster.StageMonitor). Empty string when
+    there is nothing to compare (fewer than two splits everywhere)."""
+    import statistics
+    by_table: dict = {}
+    for s in stats.splits:
+        by_table.setdefault(s["table"], []).append(s)
+    lines = []
+    for table in sorted(by_table):
+        splits = by_table[table]
+        if len(splits) < 2:
+            continue
+        walls = [float(s["wallMs"]) for s in splits]
+        batches = [int(s["batches"]) for s in splits]
+        med = statistics.median(walls)
+        ratio = max(walls) / med if med > 0 else float("inf")
+        stragglers = []
+        for i, w in enumerate(walls):
+            others = walls[:i] + walls[i + 1:]
+            omed = statistics.median(others)
+            if omed >= min_wall_ms and w > straggler_ratio * omed:
+                stragglers.append(splits[i]["split"])
+        line = (f"  {table}: {len(splits)} splits, wall med "
+                f"{med:,.1f}ms max {max(walls):,.1f}ms (x{ratio:,.1f}), "
+                f"batches {min(batches)}..{max(batches)}")
+        if stragglers:
+            line += (" STRAGGLER split"
+                     f"{'s' if len(stragglers) > 1 else ''} "
+                     f"{sorted(stragglers)}")
+        lines.append(line)
+    if not lines:
+        return ""
+    return "\n".join(["Skew (splits per table):"] + lines)
+
+
 def _label(n: PlanNode) -> str:
     cols = ", ".join(f"{f.name}:{f.type.display()}" for f in n.fields)
     if isinstance(n, TableScanNode):

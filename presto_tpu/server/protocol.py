@@ -314,6 +314,21 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/v1/service":
             self._reply(200, {"services": self._srv.discovery.nodes()})
             return
+        if self.path.split("?")[0].rstrip("/") == "/v1/metrics":
+            # Prometheus scrape surface (unauthenticated, like
+            # /v1/service — node-internal plane): the coordinator's
+            # registry plus node-labeled series federated from worker
+            # heartbeats (obs/exposition.py)
+            from ..obs.exposition import render_exposition
+            from ..obs.metrics import NODES, REGISTRY
+            body = render_exposition(REGISTRY, nodes=NODES).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if not self._authenticate():
             return
         if self.path.rstrip("/") == "/v1/resourceGroup":

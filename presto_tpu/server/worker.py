@@ -36,6 +36,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from ..batch import Batch
 from ..connectors.spi import CatalogManager, Split
 from ..exec import local as local_exec
+from ..obs.log import LOG
 from ..obs.metrics import REGISTRY, TASKS
 from ..obs.trace import TRACER
 from ..exec.pages import deserialize_page, serialize_page, \
@@ -270,6 +271,11 @@ class Task:
         self.trace_ctx = doc.get("trace")
         self.started_at: Optional[float] = None
         self.elapsed_ms = 0.0
+        #: output accounting, surfaced in status docs (the feed of the
+        #: coordinator's progress/straggler/skew monitor) and in
+        #: system.runtime.tasks
+        self.rows_out = 0
+        self.bytes_out = 0
         self.root = codec.decode(doc["fragment"])
         self.output_kind = doc["output"]["kind"]
         self.output_keys = list(doc["output"].get("keys", ()))
@@ -300,7 +306,9 @@ class Task:
         qid, fid = self._task_ids()
         TASKS.update(self.task_id, query_id=qid, stage_id=fid,
                      partition=self.partition, node_id=self.node_id,
-                     state=self.state, elapsed_ms=self._elapsed_now())
+                     state=self.state, elapsed_ms=self._elapsed_now(),
+                     output_rows=self.rows_out,
+                     output_bytes=self.bytes_out)
 
     def _elapsed_now(self) -> float:
         """Live elapsed for RUNNING tasks; frozen value once terminal."""
@@ -348,18 +356,25 @@ class Task:
                         handle, lambda: next(it, sentinel))
                     if batch is sentinel:
                         break
-                    if batch.host_count() == 0:
+                    live = batch.host_count()
+                    if live == 0:
                         continue
+                    self.rows_out += live
                     if self.output_kind == "partition":
                         pages = serialize_partitioned(
                             batch, self.output_keys, self.buffer.n)
                         for b, page in enumerate(pages):
                             if page is not None:
+                                self.bytes_out += len(page)
                                 self.buffer.add(b, page)
                     elif self.output_kind == "broadcast":
-                        self.buffer.add_broadcast(serialize_page(batch))
+                        page = serialize_page(batch)
+                        self.bytes_out += len(page)
+                        self.buffer.add_broadcast(page)
                     else:   # single
-                        self.buffer.add(0, serialize_page(batch))
+                        page = serialize_page(batch)
+                        self.bytes_out += len(page)
+                        self.buffer.add(0, page)
                 ex.check_errors()
             self.buffer.finish()
             self._set_state("FINISHED")
@@ -367,6 +382,8 @@ class Task:
             self.error = f"{type(e).__name__}: {e}"
             self._set_state("FAILED")
             self.buffer.fail(self.error)
+            LOG.log("task_failed", query_id=qid, task_id=self.task_id,
+                    node_id=self.node_id, error=self.error)
         finally:
             _release_query_handle(qid)
 
@@ -378,7 +395,8 @@ class Task:
     def status(self, include_spans: bool = False) -> dict:
         doc = {"taskId": self.task_id, "state": self.state,
                "error": self.error,
-               "elapsedMs": round(self._elapsed_now(), 1)}
+               "elapsedMs": round(self._elapsed_now(), 1),
+               "rowsOut": self.rows_out, "bytesOut": self.bytes_out}
         self._register()     # status polls refresh system.runtime.tasks
         if include_spans and isinstance(self.trace_ctx, dict):
             # span harvest: the coordinator pulls this worker's spans for
@@ -411,6 +429,18 @@ class _Handler(BaseHTTPRequestHandler):
         parts = self.path.split("?")[0].strip("/").split("/")
         if parts[:2] == ["v1", "info"]:
             self._json(200, self.worker.info())
+            return
+        if parts[:2] == ["v1", "metrics"]:
+            # Prometheus scrape surface: the process-wide registry in
+            # text exposition format (obs/exposition.py)
+            from ..obs.exposition import render_exposition
+            body = render_exposition(REGISTRY).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         if parts[:2] == ["v1", "task"] and len(parts) == 3:
             task = self.worker.tasks.get(parts[2])
@@ -565,6 +595,10 @@ class WorkerServer:
                              if t.state == s)
                       for s in ("RUNNING", "FINISHED", "FAILED")},
             "queryMemory": queries,
+            # pool high-water for the coordinator's node federator
+            # (process-wide gauge: in-process test workers share it)
+            "memPoolPeakBytes": int(
+                REGISTRY.gauge("memory_pool_peak_bytes").value),
         }
 
     def abort_query(self, query_id: str) -> int:

@@ -1,0 +1,393 @@
+"""Operations plane: Prometheus exposition, query history,
+straggler/skew detection, node federation, metric-name lint.
+
+Covers the PR-3 layer end to end: /v1/metrics on a live WorkerServer
+and on the coordinator protocol server (round-tripped through the tiny
+text-format parser), histogram buckets + derived p50/p95/p99,
+TaskRegistry eviction, history capture across local and ClusterRunner
+paths (including a failed query), straggler detection with an
+artificially delayed worker task, and the system.runtime
+{nodes,completed_queries,operator_stats} tables over plain SQL.
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.exec.runner import LocalRunner
+from presto_tpu.obs.exposition import parse_exposition, render_exposition
+from presto_tpu.obs.history import HISTORY
+from presto_tpu.obs.log import LOG
+from presto_tpu.obs.metrics import (
+    REGISTRY, TASKS, MetricsRegistry, TaskRegistry,
+)
+
+
+def _counter(name: str) -> float:
+    return REGISTRY.counter(name).value
+
+
+# -- histogram buckets + quantiles -------------------------------------------
+
+def test_histogram_buckets_and_quantiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds")
+    for i in range(1, 101):
+        h.observe(i / 100.0)          # 0.01 .. 1.00 uniform
+    st = h.state()
+    assert st["count"] == 100 and st["min"] == 0.01 and st["max"] == 1.0
+    # buckets cumulative and monotone; +Inf bucket equals count
+    cums = [c for _, c in st["buckets"]]
+    assert cums == sorted(cums) and cums[-1] == 100
+    assert st["buckets"][-1][0] == float("inf")
+    # bucket-interpolated quantiles of a uniform 0.01..1.0 sample
+    assert st["quantiles"][0.5] == pytest.approx(0.5, abs=0.05)
+    assert st["quantiles"][0.95] == pytest.approx(0.95, abs=0.05)
+    assert st["quantiles"][0.99] == pytest.approx(0.99, abs=0.05)
+    assert h.quantile(0.5) == pytest.approx(0.5, abs=0.05)
+    # snapshot rows carry the derived quantiles
+    rows = {r["name"]: r["value"] for r in reg.snapshot()}
+    assert rows["h_seconds.count"] == 100
+    for q in ("p50", "p95", "p99"):
+        assert f"h_seconds.{q}" in rows
+
+
+def test_empty_histogram_has_no_quantiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("e_seconds")
+    assert h.quantile(0.5) is None
+    rows = {r["name"] for r in reg.snapshot()}
+    assert "e_seconds.count" in rows and "e_seconds.p50" not in rows
+
+
+# -- exposition round-trip ---------------------------------------------------
+
+def test_exposition_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(3)
+    reg.counter("op_total.scan").inc(2)      # dotted name -> label
+    reg.gauge("g_bytes").set(5)
+    for v in (0.01, 0.2, 3.0):
+        reg.histogram("h_seconds").observe(v)
+    text = render_exposition(reg)
+    assert text.rstrip().endswith("# EOF")
+    samples, types = parse_exposition(text)
+    assert types["c_total"] == "counter"
+    assert types["op_total"] == "counter"
+    assert types["g_bytes"] == "gauge"
+    assert types["h_seconds"] == "histogram"
+    assert types["h_seconds_quantile"] == "gauge"
+    assert samples[("c_total", ())] == 3
+    assert samples[("op_total", (("key", "scan"),))] == 2
+    assert samples[("g_bytes", ())] == 5
+    assert samples[("h_seconds_count", ())] == 3
+    assert samples[("h_seconds_sum", ())] == pytest.approx(3.21)
+    assert samples[("h_seconds_bucket", (("le", "+Inf"),))] == 3
+    # cumulative buckets are monotone in le order
+    buckets = sorted(
+        ((dict(lbl)["le"], v) for (n, lbl), v in samples.items()
+         if n == "h_seconds_bucket"), key=lambda kv: float(kv[0]))
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts)
+    assert any(n == "h_seconds_quantile" and ("quantile", "0.95") in lbl
+               for (n, lbl), _ in samples.items())
+
+
+def test_parse_exposition_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_exposition("this is not a metric line\n")
+
+
+# -- task registry eviction --------------------------------------------------
+
+def test_task_registry_evicts_oldest_finished_first():
+    before = _counter("task_registry_evicted_total")
+    reg = TaskRegistry(max_tasks=3)
+    reg.update("t1", state="FINISHED")
+    reg.update("t2", state="RUNNING")
+    reg.update("t3", state="RUNNING")
+    reg.update("t4", state="RUNNING")   # over cap: t1 (terminal) goes
+    ids = {t["task_id"] for t in reg.snapshot()}
+    assert ids == {"t2", "t3", "t4"}
+    reg.update("t5", state="RUNNING")   # all live: oldest (t2) goes
+    ids = {t["task_id"] for t in reg.snapshot()}
+    assert ids == {"t3", "t4", "t5"}
+    assert _counter("task_registry_evicted_total") == before + 2
+
+
+# -- engine integration (local) ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalRunner(tpch_sf=0.001)
+
+
+def test_metrics_table_carries_quantiles(runner):
+    runner.execute("select count(*) from nation")
+    res = runner.execute(
+        "select name, value from system.runtime.metrics "
+        "where name = 'query_seconds.p95'")
+    assert len(res.rows) == 1
+    assert res.rows[0][1] > 0
+
+
+def test_history_local_success_and_failure(runner):
+    runner.execute("select 41 + 1")
+    with pytest.raises(Exception):
+        runner.execute("select nope_col from nation")
+    res = runner.execute(
+        "select query_id, state, error, rows, mode from "
+        "system.runtime.completed_queries "
+        "where query = 'select 41 + 1'")
+    assert res.rows
+    qid, state, error, rows, mode = res.rows[-1]
+    assert state == "FINISHED" and error is None
+    assert rows == 1 and mode == "local"
+    res = runner.execute(
+        "select state, error from system.runtime.completed_queries "
+        "where query = 'select nope_col from nation'")
+    assert res.rows and res.rows[-1][0] == "FAILED"
+    assert res.rows[-1][1]               # error text populated
+    # operator_stats rows exist for the succeeded query
+    res = runner.execute(
+        "select operator, batches from system.runtime.operator_stats "
+        f"where query_id = '{qid}'")
+    assert res.rows
+    assert all(b >= 0 for _, b in res.rows)
+    # the record itself carries cpu/peak-memory accounting
+    rec = next(r for r in HISTORY.snapshot()
+               if r.get("query") == "select 41 + 1")
+    assert rec["cpu_ms"] >= 0 and rec["plan_summary"]
+
+
+def test_history_jsonl_sink_and_slow_query_log(runner, tmp_path):
+    sink = tmp_path / "history.jsonl"
+    logf = tmp_path / "engine.log"
+    old_sink, old_thr = HISTORY.sink_path, HISTORY.slow_threshold_s
+    HISTORY.configure(sink_path=str(sink), slow_threshold_s=0.0)
+    LOG.configure(path=str(logf))
+    try:
+        runner.execute("select 'jsonl-sink-marker'")
+    finally:
+        HISTORY.sink_path, HISTORY.slow_threshold_s = old_sink, old_thr
+        LOG.configure()
+    recs = [json.loads(line) for line in
+            sink.read_text().strip().splitlines()]
+    assert any("jsonl-sink-marker" in r["query"] for r in recs)
+    events = [json.loads(line) for line in
+              logf.read_text().strip().splitlines()]
+    slow = [e for e in events if e["event"] == "slow_query"]
+    assert any("jsonl-sink-marker" in e.get("query", "") for e in slow)
+    done = [e for e in events if e["event"] == "query_completed"]
+    assert done and done[-1]["state"] == "FINISHED"
+
+
+def test_explain_analyze_skew_section(runner):
+    res = runner.execute("explain analyze select count(*) from lineitem")
+    text = "\n".join(r[0] for r in res.rows)
+    # lineitem scans with scan_threads=2 -> 2 splits -> skew section
+    assert "Skew (splits per table):" in text
+    assert "lineitem" in text.split("Skew (splits per table):")[1]
+
+
+def test_format_skew_summary_flags_straggler():
+    from presto_tpu.exec.stats import StatsCollector
+    st = StatsCollector()
+    st.record_split("t", 0, 0.0, 0.020, 4)
+    st.record_split("t", 1, 0.0, 0.025, 4)
+    st.record_split("t", 2, 0.0, 0.500, 4)   # 20x the median of others
+    from presto_tpu.planner.printer import format_skew_summary
+    out = format_skew_summary(st)
+    assert "STRAGGLER" in out and "[2]" in out
+    # balanced splits: no straggler flag
+    st2 = StatsCollector()
+    for i in range(3):
+        st2.record_split("t", i, 0.0, 0.020, 4)
+    assert "STRAGGLER" not in format_skew_summary(st2)
+
+
+# -- cluster integration -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    from presto_tpu.exec.cluster import ClusterRunner
+    from presto_tpu.server.worker import WorkerServer
+    workers = [WorkerServer(tpch_sf=0.001) for _ in range(3)]
+    for w in workers:
+        w.start()
+    urls = [f"http://127.0.0.1:{w.port}" for w in workers]
+    runner = ClusterRunner(urls, tpch_sf=0.001, heartbeat=False)
+    yield runner, workers
+    for w in workers:
+        w.stop()
+
+
+def test_worker_metrics_endpoint(cluster):
+    runner, workers = cluster
+    runner.execute("select count(*) from nation")
+    url = f"http://127.0.0.1:{workers[0].port}/v1/metrics"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    samples, types = parse_exposition(text)      # round-trip parse
+    assert samples and types
+    assert samples[("cluster_queries_total", ())] >= 1
+    assert types["query_seconds"] == "histogram"
+    assert text.rstrip().endswith("# EOF")
+
+
+def test_coordinator_metrics_endpoint(cluster, runner):
+    from presto_tpu.server.protocol import PrestoTpuServer
+    crunner, _ = cluster
+    crunner.execute("select count(*) from region")
+    srv = PrestoTpuServer(runner)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/v1/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            samples, types = parse_exposition(resp.read().decode())
+    finally:
+        srv.stop()
+    # federated node-labeled series from the worker heartbeats
+    ups = [lbl for (n, lbl), v in samples.items() if n == "node_up"]
+    assert len(ups) >= 3
+    assert types["node_heartbeat_age_seconds"] == "gauge"
+
+
+def test_cluster_history_and_nodes_tables(cluster):
+    runner, workers = cluster
+    marker = ("select l_returnflag, count(*) from lineitem "
+              "group by l_returnflag order by l_returnflag")
+    res = runner.execute(marker)
+    assert len(res.rows) == 3
+    # completed_queries has the cluster query, queryable over plain SQL
+    res = runner.local.execute(
+        "select query_id, state, mode from "
+        f"system.runtime.completed_queries where query = '{marker}'")
+    assert res.rows and res.rows[-1][1] == "FINISHED"
+    assert res.rows[-1][2] == "cluster"
+    qid = res.rows[-1][0]
+    assert qid.startswith("cq_")
+    # per-task operator stats rode the history record
+    res = runner.local.execute(
+        "select operator, rows from system.runtime.operator_stats "
+        f"where query_id = '{qid}'")
+    assert res.rows
+    # nodes table lists every worker with a fresh heartbeat age
+    res = runner.local.execute(
+        "select node_id, state, heartbeat_age_s, coordinator "
+        "from system.runtime.nodes")
+    by_id = {r[0]: r for r in res.rows}
+    for w in workers:
+        assert w.node_id in by_id, by_id
+        _, state, age, coord = by_id[w.node_id]
+        assert state == "ACTIVE" and age < 30.0 and not coord
+    assert by_id["coordinator"][3]
+    # cluster queries appear in system.runtime.queries too
+    res = runner.local.execute(
+        "select state from system.runtime.queries "
+        f"where query = '{marker}'")
+    assert res.rows and res.rows[-1][0] == "FINISHED"
+
+
+def test_straggler_detection_with_delayed_task(cluster, monkeypatch):
+    from presto_tpu.server import worker as worker_mod
+    runner, _ = cluster
+    sql = "select count(*) from lineitem"
+    runner.execute(sql)                  # warm compiles before timing
+
+    orig = worker_mod._TaskExecutor._TableScanNode
+
+    def delayed(self, node):
+        # partition 0 of the scan stage straggles; the others get a
+        # small floor so the stage median clears the detector's noise
+        # floor deterministically
+        time.sleep(1.2 if self.partition == 0 else 0.05)
+        return orig(self, node)
+
+    monkeypatch.setattr(worker_mod._TaskExecutor, "_TableScanNode",
+                        delayed)
+    before = _counter("straggler_detected_total")
+    res = runner.execute(sql)
+    assert res.rows[0][0] > 0
+    assert _counter("straggler_detected_total") >= before + 1
+    flagged = [t for t in TASKS.snapshot() if t.get("straggler")]
+    assert flagged
+    assert any(t["task_id"].endswith(".0") for t in flagged)
+    # flagged rows visible over plain SQL
+    res = runner.local.execute(
+        "select task_id from system.runtime.tasks "
+        "where straggler = true")
+    assert res.rows
+
+
+def test_stage_monitor_skew_detection():
+    from presto_tpu.exec.cluster import StageMonitor
+    before = _counter("skewed_stage_total")
+    mon = StageMonitor("cq_skewtest")
+    statuses = [
+        {"taskId": "cq_skewtest.0.0", "state": "FINISHED",
+         "elapsedMs": 100.0, "rowsOut": 5000, "bytesOut": 10},
+        {"taskId": "cq_skewtest.0.1", "state": "FINISHED",
+         "elapsedMs": 100.0, "rowsOut": 100, "bytesOut": 10},
+        {"taskId": "cq_skewtest.0.2", "state": "FINISHED",
+         "elapsedMs": 100.0, "rowsOut": 100, "bytesOut": 10},
+    ]
+    summary = mon.finalize(statuses)
+    assert _counter("skewed_stage_total") == before + 1
+    assert summary["skewed_stages"] and 0 in summary["skewed_stages"]
+    assert summary["progress"][0] == 100.0
+    # balanced stage: no flag, and finalize is idempotent per stage
+    mon2 = StageMonitor("cq_noskew")
+    balanced = [dict(s, taskId=f"cq_noskew.0.{i}", rowsOut=1000)
+                for i, s in enumerate(statuses)]
+    assert not mon2.finalize(balanced)["skewed_stages"]
+    assert _counter("skewed_stage_total") == before + 1
+
+
+def test_cluster_failed_query_lands_in_history(cluster):
+    from presto_tpu.exec.cluster import QueryFailedError
+    runner, _ = cluster
+    sql = ("select sum(l_orderkey % (l_orderkey - l_orderkey)) "
+           "from lineitem")
+    with pytest.raises(QueryFailedError):
+        runner.execute(sql)
+    rec = next(r for r in reversed(HISTORY.snapshot())
+               if r.get("query") == sql)
+    assert rec["state"] == "FAILED" and rec["mode"] == "cluster"
+    assert rec["error"]
+
+
+# -- metric-name lint (CI wiring) --------------------------------------------
+
+def test_check_metric_names_passes_on_source(capsys):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import check_metric_names
+    finally:
+        sys.path.pop(0)
+    assert check_metric_names.main(
+        [os.path.join(repo, "presto_tpu")]) == 0
+
+
+def test_check_metric_names_flags_bad_names(tmp_path, capsys):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import check_metric_names
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "REGISTRY.counter('CamelCase_total').inc()\n"
+        "REGISTRY.counter('no_unit_suffix').inc()\n"
+        "REGISTRY.gauge('dup_total').set(1)\n"
+        "REGISTRY.counter('dup_total').inc()\n")
+    assert check_metric_names.main([str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "snake_case" in err and "unit suffix" in err
+    assert "dup_total" in err

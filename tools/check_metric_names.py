@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Metric-name lint: walk the source for ``counter(``/``gauge(``/
+``histogram(`` call sites and fail on bad or conflicting names.
+
+The metrics registry creates metrics on first use, so a typo'd or
+re-typed name never errors at runtime — it silently forks a second
+series. This tool makes the naming contract enforceable in CI (it runs
+inside the tier-1 suite, tests/test_obs_ops.py, next to
+tools/check_tier1_time.py's time budget):
+
+- names must be ``snake_case`` (f-string call sites are checked on
+  their literal parts; dotted suffixes like
+  ``operator_batches_total.<kind>`` are label encodings and validated
+  on the family before the first dot);
+- the family must end in a unit suffix: ``_total``, ``_seconds`` or
+  ``_bytes``;
+- one family, one type: the same name registered as both a counter and
+  a gauge (anywhere in the tree) is an error.
+
+Usage:
+    python tools/check_metric_names.py [src_dir ...]   # default: presto_tpu/
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_KINDS = ("counter", "gauge", "histogram")
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*(\*[a-z0-9_]*)*$")
+_UNIT_SUFFIXES = ("_total", "_seconds", "_bytes")
+
+
+def _name_pattern(arg: ast.expr) -> Optional[str]:
+    """The metric-name argument as a string pattern: literal strings
+    verbatim, f-strings with each interpolation collapsed to ``*``;
+    None when the name is fully dynamic (a variable)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _check_name(pattern: str) -> Optional[str]:
+    family = pattern.split(".", 1)[0]
+    if not _SNAKE.match(family.replace("*", "x")):
+        return f"{pattern!r}: family {family!r} is not snake_case"
+    if not family.endswith(_UNIT_SUFFIXES):
+        return (f"{pattern!r}: family {family!r} lacks a unit suffix "
+                f"({'/'.join(_UNIT_SUFFIXES)})")
+    return None
+
+
+def scan_file(path: str) -> Tuple[List[Tuple[str, str, int]], List[str]]:
+    """-> ([(pattern, kind, lineno)], [parse errors])."""
+    with open(path, encoding="utf-8", errors="replace") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [], [f"{path}: {e}"]
+    out: List[Tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _KINDS and node.args):
+            continue
+        pattern = _name_pattern(node.args[0])
+        if pattern is not None:
+            out.append((pattern, node.func.attr, node.lineno))
+    return out, []
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("src", nargs="*", default=None,
+                    help="source directories (default: presto_tpu/ "
+                         "next to this script's repo root)")
+    args = ap.parse_args(argv)
+    roots = args.src or [os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "presto_tpu")]
+
+    errors: List[str] = []
+    families: Dict[str, Tuple[str, str]] = {}   # family -> (kind, where)
+    n_sites = 0
+    for root in roots:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__",)]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                sites, errs = scan_file(path)
+                errors.extend(errs)
+                for pattern, kind, lineno in sites:
+                    n_sites += 1
+                    where = f"{path}:{lineno}"
+                    bad = _check_name(pattern)
+                    if bad:
+                        errors.append(f"{where}: {bad}")
+                        continue
+                    family = pattern.split(".", 1)[0]
+                    prev = families.get(family)
+                    if prev is not None and prev[0] != kind:
+                        errors.append(
+                            f"{where}: {family!r} registered as {kind} "
+                            f"but as {prev[0]} at {prev[1]}")
+                    elif prev is None:
+                        families[family] = (kind, where)
+
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        print(f"{len(errors)} metric-name error(s) across {n_sites} "
+              f"call sites", file=sys.stderr)
+        return 1
+    print(f"ok: {n_sites} metric call sites, "
+          f"{len(families)} families")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
