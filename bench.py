@@ -176,12 +176,11 @@ def bench_q6(sf: float):
     import jax
     import jax.numpy as jnp
     from presto_tpu import types as T
-    from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.expr.compiler import compile_filter, compile_projection
     from presto_tpu.ops.aggregation import AggSpec, global_aggregate
     import __graft_entry__ as ge
 
-    conn = TpchConnector(sf=sf)
+    conn = _shared_tpch(sf)
     dev, host, total, _, _ = _stage(conn, "lineitem", ge._Q6_COLS,
                                     1 << 20, True)
 
@@ -232,10 +231,9 @@ def bench_q1(sf: float):
     import jax
     from presto_tpu import types as T
     from presto_tpu.batch import Batch, Column, Schema, concat_batches
-    from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.ops.aggregation import AggSpec, grouped_aggregate
 
-    conn = TpchConnector(sf=sf)
+    conn = _shared_tpch(sf)
     dev, host, total, schema, _ = _stage(conn, "lineitem", _Q1_COLS,
                                          1 << 20, True)
     rf_vocab = dev[0].columns[0].dictionary
@@ -341,10 +339,9 @@ def bench_q3(sf: float):
     import jax
     import jax.numpy as jnp
     from presto_tpu.batch import Batch, bucket_capacity, concat_batches
-    from presto_tpu.connectors.tpch import TpchConnector
     from presto_tpu.ops.scatter_agg import segment_sum_exact
 
-    conn = TpchConnector(sf=sf)
+    conn = _shared_tpch(sf)
     li_cols = ["l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"]
     o_cols = ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]
     c_cols = ["c_custkey", "c_mktsegment"]
@@ -513,15 +510,8 @@ order by l_returnflag, l_linestatus
 
 
 def bench_q1sql(sf: float):
-    from presto_tpu.connectors.spi import CatalogManager
-    from presto_tpu.connectors.tpch import TpchConnector
-    from presto_tpu.exec.runner import LocalRunner
-
-    conn = TpchConnector(sf=sf)
-    catalogs = CatalogManager()
-    catalogs.register("tpch", _CachingConnector(conn))
-    runner = LocalRunner(catalogs=catalogs, catalog="tpch",
-                         rows_per_batch=1 << 20)
+    conn = _shared_tpch(sf)
+    runner = _shared_runner("tpch", sf)
     _, host, total, _, vocabs = _stage(conn, "lineitem", _Q1_COLS,
                                        1 << 20, False)
     rf_vocab, ls_vocab = vocabs[0], vocabs[1]
@@ -598,70 +588,132 @@ limit 100
 """
 
 
-class _CachingConnector:
-    """Delegating connector that memoizes generated device batches, so the
-    engine's timed run reads pre-staged pages — the same footing as the
-    NumPy proxy and the reference harness (AbstractOperatorBenchmark reads
-    pre-staged in-memory pages)."""
+#: shared connector/runner instances across query configs: q55 and q27
+#: used to each rebuild the SF10 TPC-DS dataset from scratch (~230s of
+#: wall per config, mostly datagen); one TpcdsConnector + one engine
+#: runner per scale factor means the tables generate once, and the
+#: engine-side device scan cache (exec/scancache.py) carries hot split
+#: data from one config's warmup into the next config's run
+_SHARED_CONNS: dict = {}
+_SHARED_RUNNERS: dict = {}
 
-    def __init__(self, inner):
-        self._inner = inner
-        self._cache = {}
-        self.name = inner.name
 
-    @property
-    def metadata(self):
-        return self._inner.metadata
+def _shared_tpch(sf: float):
+    from presto_tpu.connectors.tpch import TpchConnector
+    key = ("tpch", sf)
+    if key not in _SHARED_CONNS:
+        _SHARED_CONNS[key] = TpchConnector(sf=sf)
+    return _SHARED_CONNS[key]
 
-    @property
-    def split_manager(self):
-        return self._inner.split_manager
 
-    def page_source(self, split, columns, pushdown=None,
-                    rows_per_batch=1 << 17):
-        key = (split.table.table, tuple(columns), split.info, rows_per_batch)
-        if key not in self._cache:
-            self._cache[key] = list(self._inner.page_source(
-                split, columns, rows_per_batch=rows_per_batch).batches())
-        batches = self._cache[key]
+def _shared_tpcds(sf: float):
+    from presto_tpu.connectors.tpcds import TpcdsConnector
+    key = ("tpcds", sf)
+    if key not in _SHARED_CONNS:
+        _SHARED_CONNS[key] = TpcdsConnector(sf=sf)
+    return _SHARED_CONNS[key]
 
-        class _PS:
-            def batches(self):
-                return iter(batches)
-        return _PS()
+
+def _shared_runner(catalog: str, sf: float):
+    """One LocalRunner per (catalog, sf), mounted over the shared
+    connector; the device scan cache persists across configs so the
+    engine's timed runs read device-resident pages — the same footing
+    as the NumPy proxy and the reference harness
+    (AbstractOperatorBenchmark reads pre-staged in-memory pages)."""
+    from presto_tpu.connectors.spi import CatalogManager
+    from presto_tpu.exec.runner import LocalRunner
+    key = (catalog, sf)
+    if key not in _SHARED_RUNNERS:
+        conn = (_shared_tpch(sf) if catalog == "tpch"
+                else _shared_tpcds(sf))
+        catalogs = CatalogManager()
+        catalogs.register(catalog, conn)
+        # 2^22-row scan batches for the TPC-DS macro configs: the
+        # device-resident scan cache makes big batches free on re-runs
+        # (no host re-decode per query), and 4x fewer batches means 4x
+        # fewer per-batch tunnel dispatches and fused-chain liveness
+        # syncs — the round-5/6 notes put per-batch dispatch latency
+        # among q55/q27's dominant costs. Stays 16x under the 2^26
+        # capacity that faulted a fused kernel on v5e (round 2) and 2x
+        # under the 2^23 staging chunks the hand configs already use.
+        rpb = (1 << 22) if catalog == "tpcds" else (1 << 20)
+        runner = LocalRunner(catalogs=catalogs, catalog=catalog,
+                             rows_per_batch=rpb)
+        # SF10 q1sql/q27 column sets run ~2-3.5GB of decoded device
+        # columns each; the default 2GB cap would thrash between
+        # configs (the limit is process-wide, so set it on the cache —
+        # it is deliberately not a session property)
+        from presto_tpu.exec.scancache import CACHE
+        CACHE.set_limit(6 << 30)
+        # 4 scan threads: 4-way split datagen/decode overlap on the
+        # cold pass (the warm pass reads the cache either way)
+        runner.session.properties["scan_threads"] = 4
+        _SHARED_RUNNERS[key] = runner
+    return _SHARED_RUNNERS[key]
+
+
+#: per-table UNION of every proxy config's columns, so one generation
+#: pass serves both q55 and q27 (the raw arrays cache undecoded;
+#: dictionary decode happens per request below)
+_DS_PROXY_COLS = {
+    "date_dim": ("d_date_sk", "d_moy", "d_year"),
+    "item": ("i_item_sk", "i_item_id", "i_brand_id", "i_brand",
+             "i_manager_id"),
+    "store": ("s_store_sk", "s_state"),
+    "customer_demographics": ("cd_demo_sk", "cd_gender",
+                              "cd_marital_status",
+                              "cd_education_status"),
+    "store_sales": ("ss_sold_date_sk", "ss_item_sk",
+                    "ss_ext_sales_price", "ss_cdemo_sk", "ss_store_sk",
+                    "ss_quantity", "ss_list_price", "ss_coupon_amt",
+                    "ss_sales_price"),
+}
+_NP_COLS_CACHE: dict = {}
 
 
 def _np_cols(conn, table, cols, decode=()):
     """One table's columns as host numpy arrays (dict columns decoded to
-    object arrays when listed in ``decode``), generated host-side."""
+    object arrays when listed in ``decode``), generated host-side ONCE
+    per (connector, table) — the union of every config's columns — and
+    served from cache thereafter."""
     from presto_tpu.connectors.spi import TableHandle
 
-    th = TableHandle("tpcds", "default", table)
-    parts = {c: [] for c in cols}
-    n = 0
-    for split in conn.split_manager.splits(th, 1):
-        ps = conn.page_source(split, cols, rows_per_batch=1 << 20)
-        for _, data, cn in ps.host_chunks():
-            for c in cols:
-                arr, vocab = data[c]
-                arr = np.asarray(arr)
-                if c in decode and vocab is not None and vocab != "text":
-                    arr = np.asarray(tuple(vocab), dtype=object)[arr]
-                parts[c].append(arr)
-            n += cn
-    return {c: np.concatenate(v) for c, v in parts.items()}, n
+    key = (id(conn), table)
+    got = _NP_COLS_CACHE.get(key)
+    if got is None:
+        gen_cols = list(_DS_PROXY_COLS.get(table, ()))
+        for c in cols:
+            if c not in gen_cols:
+                gen_cols.append(c)
+        th = TableHandle("tpcds", "default", table)
+        parts = {c: [] for c in gen_cols}
+        vocabs: dict = {}
+        n = 0
+        for split in conn.split_manager.splits(th, 1):
+            ps = conn.page_source(split, gen_cols, rows_per_batch=1 << 20)
+            for _, data, cn in ps.host_chunks():
+                for c in gen_cols:
+                    arr, vocab = data[c]
+                    parts[c].append(np.asarray(arr))
+                    vocabs[c] = vocab
+                n += cn
+        got = ({c: np.concatenate(v) for c, v in parts.items()},
+               vocabs, n)
+        _NP_COLS_CACHE[key] = got
+    raw, vocabs, n = got
+    out = {}
+    for c in cols:
+        arr = raw[c]
+        vocab = vocabs.get(c)
+        if c in decode and vocab is not None and vocab != "text":
+            arr = np.asarray(tuple(vocab), dtype=object)[arr]
+        out[c] = arr
+    return out, n
 
 
 def bench_q55(sf: float):
-    from presto_tpu.connectors.spi import CatalogManager
-    from presto_tpu.connectors.tpcds import TpcdsConnector
-    from presto_tpu.exec.runner import LocalRunner
-
-    conn = TpcdsConnector(sf=sf)
-    catalogs = CatalogManager()
-    catalogs.register("tpcds", _CachingConnector(conn))
-    runner = LocalRunner(catalogs=catalogs, catalog="tpcds",
-                         rows_per_batch=1 << 20)
+    conn = _shared_tpcds(sf)
+    runner = _shared_runner("tpcds", sf)
 
     dd, n_dd = _np_cols(conn, "date_dim", ["d_date_sk", "d_moy", "d_year"])
     it, n_it = _np_cols(conn, "item",
@@ -705,24 +757,29 @@ def bench_q55(sf: float):
         return rows
 
     got, dev_s = _time(run_engine)
+    # the scan-cache warm/cold sub-metric (acceptance: warm re-run of a
+    # scan-heavy query measurably beats its cold run): the timed run
+    # above hit the device-resident cache; one more run with the
+    # scan_cache=false escape hatch pays the decode+staging wall again
+    # (kernels stay jit-warm, so the delta isolates the input side)
+    t0 = time.perf_counter()
+    nocache = runner.execute(_DS_Q55,
+                             properties={"scan_cache": False}).rows
+    nocache_s = time.perf_counter() - t0
+    assert nocache == got, "scan_cache=false changed q55 results"
     want, np_s = _time_proxy(run_numpy)
     assert len(got) == len(want), (got[:3], want[:3])
     for g, w in zip(got, want):
         assert int(g[0]) == w[0] and str(g[1]) == w[1], (g, w)
         assert abs(float(g[2]) - w[2]) <= 1e-6 * max(abs(w[2]), 1.0), (g, w)
-    return total, dev_s, np_s
+    return total, dev_s, np_s, {
+        "scan_cache_warm_s": round(dev_s, 4),
+        "scan_cache_cold_s": round(nocache_s, 4)}
 
 
 def bench_q27(sf: float):
-    from presto_tpu.connectors.spi import CatalogManager
-    from presto_tpu.connectors.tpcds import TpcdsConnector
-    from presto_tpu.exec.runner import LocalRunner
-
-    conn = TpcdsConnector(sf=sf)
-    catalogs = CatalogManager()
-    catalogs.register("tpcds", _CachingConnector(conn))
-    runner = LocalRunner(catalogs=catalogs, catalog="tpcds",
-                         rows_per_batch=1 << 20)
+    conn = _shared_tpcds(sf)
+    runner = _shared_runner("tpcds", sf)
 
     dd, n_dd = _np_cols(conn, "date_dim", ["d_date_sk", "d_year"])
     it, n_it = _np_cols(conn, "item", ["i_item_sk", "i_item_id"],
@@ -885,7 +942,9 @@ def main() -> None:
         if alarm_ok:
             signal.alarm(int(max(budget_s * 1.05 - elapsed, 120)))
         try:
-            total, dev_s, np_s = fn(sf)
+            out = fn(sf)
+            total, dev_s, np_s = out[:3]
+            extra = out[3] if len(out) > 3 else {}
         except _ConfigTimeout:
             print(f"[bench] {name} exceeded its time slot; skipping",
                   file=sys.stderr, flush=True)
@@ -904,6 +963,7 @@ def main() -> None:
             "vs_baseline": round(pinned_s / dev_s, 3),
             "proxy_s_pinned": round(pinned_s, 4),
             "proxy_s_measured": round(np_s, 4),
+            **extra,
         })
         emit(results)
 

@@ -327,6 +327,28 @@ class Batch:
             )
         return Batch(self.schema, cols, new_mask)
 
+    def pad(self, capacity: int) -> "Batch":
+        """Grow to a larger capacity with dead padding lanes — the
+        inverse of compact. The scan pipeline pads a split's ragged
+        final chunk up to the stream's standard bucket so shape-keyed
+        executables (ops/jitcache) are reused instead of recompiled per
+        residual size. Padding lanes are dead (row_mask/validity False),
+        so results are unchanged."""
+        if capacity <= self.capacity:
+            return self
+        extra = capacity - self.capacity
+
+        def grow(a):
+            widths = [(0, extra)] + [(0, 0)] * (a.ndim - 1)
+            return jnp.pad(a, widths)
+
+        cols = [
+            Column(c.type, jax.tree_util.tree_map(grow, c.data),
+                   grow(c.validity), c.dictionary)
+            for c in self.columns
+        ]
+        return Batch(self.schema, cols, grow(self.row_mask))
+
     def __repr__(self) -> str:
         return f"Batch({self.schema!r}, capacity={self.capacity})"
 

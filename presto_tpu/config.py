@@ -137,6 +137,10 @@ class NodeConfig:
         self.discovery_uri = props.get("discovery.uri")
         self.catalog = props.get("session.catalog", "tpch")
         self.schema = props.get("session.schema", "default")
+        #: process-wide device scan-cache resident limit
+        #: (exec/scancache.py); None keeps the built-in default
+        raw_sc = props.get("scan-cache.max-bytes")
+        self.scan_cache_bytes = int(raw_sc) if raw_sc else None
         #: session property defaults: session.<name>=<value>
         self.session_defaults = {
             k[len("session."):]: v for k, v in props.items()
@@ -176,6 +180,9 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
     from .plugin import load_plugins_from_config
     load_plugins_from_config(cfg.props)
     catalogs = load_catalogs(etc_dir)
+    if cfg.scan_cache_bytes is not None:
+        from .exec.scancache import CACHE
+        CACHE.set_limit(cfg.scan_cache_bytes)
     runner = LocalRunner(catalogs=catalogs, catalog=cfg.catalog,
                          schema=cfg.schema)
     runner.session.properties.update(cfg.session_defaults)

@@ -33,7 +33,7 @@ from .. import types as T
 from ..batch import Batch, Column, Schema
 from .spi import (
     Connector, ConnectorMetadata, ConnectorSplitManager, PageSource, Split,
-    TableHandle, TableStats,
+    TableHandle, TableStats, notify_data_change,
 )
 
 
@@ -101,6 +101,28 @@ class FileConnectorBase(Connector):
         #: per-table partition-field cache: page_source runs once per
         #: split and must not re-walk the directory tree per file
         self._pfields_cache: Dict[str, List[Tuple[str, T.Type]]] = {}
+        # per-table data versions for the device scan cache: a counter
+        # bumped on this connector's OWN writes, combined with the
+        # table's (file, mtime) fingerprint so files rewritten behind
+        # the connector's back change the version too — the same
+        # externally-visible contract as the (path, mtime)-keyed reader
+        # cache below
+        self._vseq = 0
+        self._versions: Dict[str, int] = {}
+
+    def _data_changed(self, name: str) -> None:
+        self._vseq += 1
+        self._versions[name] = self._vseq
+        notify_data_change(self, name)
+
+    def data_version(self, table: str):
+        try:
+            files = tuple(
+                (os.path.relpath(f, self.root), os.path.getmtime(f))
+                for f in self.table_files(table))
+        except (OSError, KeyError):
+            files = ()
+        return (self._versions.get(table, 0), files)
 
     # -- format hooks --------------------------------------------------------
     def open_reader(self, path: str):
@@ -246,6 +268,7 @@ class FileConnectorBase(Connector):
         os.makedirs(path)
         self._declared_parts[name] = list(partitioned_by)
         self._pfields_cache.pop(name, None)
+        self._data_changed(name)
 
     def drop_table(self, name: str, if_exists: bool = False) -> None:
         import shutil
@@ -258,6 +281,7 @@ class FileConnectorBase(Connector):
             raise KeyError(f"table {name!r} does not exist")
         self._declared_parts.pop(name, None)
         self._pfields_cache.pop(name, None)
+        self._data_changed(name)
 
     def append(self, name: str, batch: Batch) -> int:
         part_keys = self._declared_parts.get(name)
@@ -272,7 +296,12 @@ class FileConnectorBase(Connector):
         self._pfields_cache.pop(name, None)
         if not part_keys:
             path = os.path.join(base, f"part-{fid}{self.extension}")
-            return self.write_file(path, batch.schema, [batch])
+            n = self.write_file(path, batch.schema, [batch])
+            # bump AFTER the file lands: a concurrent scan between the
+            # bump and the write would cache pre-write data under the
+            # post-write version and serve it forever
+            self._data_changed(name)
+            return n
         # route rows into key=value directories (HivePageSink role);
         # partition columns move to the path, data columns to the files
         names = list(batch.schema.names)
@@ -308,6 +337,7 @@ class FileConnectorBase(Connector):
             os.makedirs(d, exist_ok=True)
             path = os.path.join(d, f"part-{fid}{self.extension}")
             n += self.write_file(path, data_schema, [sub])
+        self._data_changed(name)   # after every partition file landed
         return n
 
 

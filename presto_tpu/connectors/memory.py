@@ -13,7 +13,7 @@ from typing import Dict, Iterator, List, Optional, Sequence
 from ..batch import Batch, Schema
 from .spi import (
     Connector, ConnectorMetadata, ConnectorSplitManager, PageSource,
-    Split, TableHandle, TableStats,
+    Split, TableHandle, TableStats, notify_data_change,
 )
 
 
@@ -61,6 +61,18 @@ class MemoryConnector(Connector):
         self.schemas: Dict[str, Schema] = {}
         self._metadata = _Metadata(self)
         self._split_manager = _SplitManager()
+        # monotonic per-table data versions: the scan-cache key surface
+        # (spi.Connector.data_version); bumped on every write
+        self._vseq = 0
+        self._versions: Dict[str, int] = {}
+
+    def _data_changed(self, name: str) -> None:
+        self._vseq += 1
+        self._versions[name] = self._vseq
+        notify_data_change(self, name)
+
+    def data_version(self, table: str):
+        return self._versions.get(table, 0)
 
     @property
     def metadata(self) -> ConnectorMetadata:
@@ -87,8 +99,11 @@ class MemoryConnector(Connector):
 
     def transaction_restore(self, snap) -> None:
         tables, schemas = snap
+        touched = set(self.tables) | set(tables)
         self.tables = {t: list(bs) for t, bs in tables.items()}
         self.schemas = dict(schemas)
+        for t in touched:            # rollback changes data too
+            self._data_changed(t)
 
     # -- write surface (reference spi/connector/ConnectorPageSink.java) ------
     def create_table(self, name: str, schema: Schema,
@@ -99,6 +114,7 @@ class MemoryConnector(Connector):
             raise ValueError(f"table {name!r} already exists")
         self.tables[name] = []
         self.schemas[name] = schema
+        self._data_changed(name)
 
     def drop_table(self, name: str, if_exists: bool = False) -> None:
         if name not in self.tables:
@@ -107,6 +123,7 @@ class MemoryConnector(Connector):
             raise KeyError(f"table {name!r} does not exist")
         del self.tables[name]
         del self.schemas[name]
+        self._data_changed(name)
 
     def append(self, name: str, batch: Batch) -> int:
         if name not in self.tables:
@@ -120,4 +137,5 @@ class MemoryConnector(Connector):
         # re-label columns with the table's canonical names
         relabeled = Batch(expected, batch.columns, batch.row_mask)
         self.tables[name].append(relabeled)
+        self._data_changed(name)
         return relabeled.host_count()

@@ -109,6 +109,22 @@ class Connector:
     def split_manager(self) -> ConnectorSplitManager:
         raise NotImplementedError
 
+    def data_version(self, table: str) -> Optional[Any]:
+        """Data-version token for one table, or None when the connector
+        cannot attest one. The engine's cross-query device scan cache
+        (exec/scancache.py) keys cached split data by this token:
+
+        - None (the default) disables caching for the table — correct
+          for live/views-of-state sources (system.runtime) and for
+          connectors whose underlying data can change without the
+          connector seeing the write;
+        - immutable generators (tpch/tpcds) return a constant;
+        - writable connectors return a counter bumped on every write,
+          through the same code path that invalidates their own stats
+          caches (and that calls :func:`notify_data_change`).
+        """
+        return None
+
     def page_source(
         self,
         split: Split,
@@ -117,6 +133,29 @@ class Connector:
         rows_per_batch: int = 1 << 17,
     ) -> PageSource:
         raise NotImplementedError
+
+
+# -- data-change notification -------------------------------------------------
+# The engine-side hook connector writes flow through so cross-connector
+# caches (the device scan cache, exec/scancache.py) invalidate on the
+# SAME path that invalidates a connector's own stats/schema caches.
+# Listener registration is process-wide and append-only (like the
+# reference's event-listener plumbing, but synchronous and in-process).
+
+_DATA_CHANGE_LISTENERS: List[Any] = []
+
+
+def on_data_change(listener) -> None:
+    """Register ``listener(connector, table_name)`` to run after every
+    connector write (append / create / drop / transaction restore)."""
+    _DATA_CHANGE_LISTENERS.append(listener)
+
+
+def notify_data_change(connector: "Connector", table: str) -> None:
+    """Connectors call this from their write paths, right where they
+    invalidate their own caches."""
+    for listener in list(_DATA_CHANGE_LISTENERS):
+        listener(connector, table)
 
 
 class CatalogManager:

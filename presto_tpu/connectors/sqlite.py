@@ -26,7 +26,7 @@ from .. import types as T
 from ..batch import Batch, Schema, bucket_capacity
 from .spi import (
     ColumnStats, Connector, ConnectorMetadata, ConnectorSplitManager,
-    PageSource, Split, TableHandle, TableStats,
+    PageSource, Split, TableHandle, TableStats, notify_data_change,
 )
 
 #: SQLite declared-type affinity -> engine type (reference
@@ -192,6 +192,22 @@ class SqliteConnector(Connector):
         # connector's own writes (ADVICE r5 — planning must not re-scan
         # sqlite per optimizer estimate)
         self._stats_cache: Dict[str, TableStats] = {}
+        # monotonic per-table data versions (scan-cache key surface),
+        # bumped by the SAME writes that invalidate the stats cache
+        self._vseq = 0
+        self._versions: Dict[str, int] = {}
+
+    def data_version(self, table: str):
+        # the write counter covers THIS connector's writes; sqlite's
+        # own PRAGMA data_version covers commits from OTHER connections
+        # to the same database file (it bumps per foreign commit seen
+        # by this connection), so externally-modified tables miss
+        # instead of serving stale cached splits
+        try:
+            ext = self._db().execute("pragma data_version").fetchone()[0]
+        except sqlite3.Error:
+            ext = None
+        return (self._versions.get(table, 0), ext)
 
     def _db(self) -> sqlite3.Connection:
         db = getattr(self._local, "db", None)
@@ -220,7 +236,16 @@ class SqliteConnector(Connector):
 
     def _invalidate(self, table: str) -> None:
         self._schema_cache.pop(table, None)
+        self._note_write(table)
+
+    def _note_write(self, table: str) -> None:
+        """One write happened: drop the priced stats, bump the data
+        version, and notify engine-side caches (the device scan cache
+        invalidates through this same path)."""
         self._stats_cache.pop(table, None)
+        self._vseq += 1
+        self._versions[table] = self._vseq
+        notify_data_change(self, table)
 
     def _stats(self, table: str) -> TableStats:
         got = self._stats_cache.get(table)
@@ -302,7 +327,7 @@ class SqliteConnector(Connector):
             f'insert into {_q(name)} values ({ph})',
             [tuple(conv(v) for v in r) for r in rows])
         self._db().commit()
-        self._stats_cache.pop(name, None)
+        self._note_write(name)
         return len(rows)
 
     def drop_table(self, name: str, if_exists: bool = False) -> None:

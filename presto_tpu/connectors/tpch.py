@@ -636,6 +636,11 @@ class TpchConnector(Connector):
         self._splits = _SplitManager(sf)
         self._gen = _Gen(sf)
 
+    def data_version(self, table: str):
+        # stateless generator: any split regenerates identically for the
+        # connector's whole lifetime, so the device scan cache may hold it
+        return 0
+
     @property
     def metadata(self) -> ConnectorMetadata:
         return self._metadata

@@ -418,28 +418,13 @@ class _Executor:
         return self.run(node.child)
 
     # -- leaves ---------------------------------------------------------------
-    def _TableScanNode(self, node: TableScanNode) -> Iterator[Batch]:
-        """Split-parallel scan with async host-side prefetch: worker
-        threads run the connector page sources (generation / file decode
-        / host->device staging) ahead of the consumer, so device compute
-        overlaps input production — the role of the reference's split
-        pipeline (execution/SqlTaskExecution.java:390 one driver per
-        split + BufferingSplitSource prefetch).
-
-        Delivery is in deterministic split order (per-split reorder
-        queues): physical row order feeds order-sensitive downstream
-        semantics (ROWS window frames with ties, LIMIT-without-ORDER),
-        so prefetch must not reshuffle it run to run."""
-        import queue as _queue
-        import threading
-
-        conn = self.session.catalogs.get(node.catalog)
-
+    def _scan_pushdown_fn(self, node: TableScanNode):
+        """Closure yielding a scan's EFFECTIVE pushdown, re-evaluated
+        per split: dynamic (join build) bounds may arrive while earlier
+        splits are already streaming — later splits still benefit (the
+        reference's dynamic filters race the probe scan the same way).
+        Shared with the cluster worker's task executor."""
         def current_pushdown():
-            """Re-evaluated per split: dynamic (join build) bounds may
-            arrive while earlier splits are already streaming — later
-            splits still benefit (the reference's dynamic filters race
-            the probe scan the same way)."""
             pushdown = node.pushdown or None
             dyn = self.dynamic_pushdown.get(node)
             if dyn:
@@ -456,10 +441,28 @@ class _Executor:
                 pushdown = tuple((n, lo, hi)
                                  for n, (lo, hi) in merged.items())
             return pushdown
+        return current_pushdown
 
-        n_threads = int(self.session.properties.get("scan_threads", 2))
+    def _TableScanNode(self, node: TableScanNode) -> Iterator[Batch]:
+        """Split-parallel scan through the device scan cache + async
+        prefetching pipeline (exec/scancache.py): hot split data replays
+        from device memory across queries, cold splits decode/stage on
+        background threads ahead of the consumer so device compute
+        overlaps input production — the role of the reference's split
+        pipeline (execution/SqlTaskExecution.java:390 one driver per
+        split + BufferingSplitSource prefetch).
+
+        Delivery is in deterministic split order (per-split reorder
+        queues): physical row order feeds order-sensitive downstream
+        semantics (ROWS window frames with ties, LIMIT-without-ORDER),
+        so prefetch must not reshuffle it run to run."""
+        from . import scancache
+
+        conn = self.session.catalogs.get(node.catalog)
+        current_pushdown = self._scan_pushdown_fn(node)
+        opts = scancache.options_from_session(self.session)
         splits = conn.split_manager.splits(
-            node.table, max(n_threads, 1))
+            node.table, max(opts.threads, 1))
         lifespan = self.lifespan_splits.get(node)
         if lifespan is not None:
             # grouped execution: only this bucket's splits this pass
@@ -474,77 +477,11 @@ class _Executor:
                     node.table.table, i, t0 - t_query0,
                     _time.perf_counter() - t0, batches)
 
-        if n_threads <= 1 or len(splits) <= 1:
-            for i, split in enumerate(splits):
-                t0 = _time.perf_counter()
-                nb = 0
-                src = conn.page_source(split, list(node.columns),
-                                       pushdown=current_pushdown(),
-                                       rows_per_batch=self.rows_per_batch)
-                for b in src.batches():
-                    self._check_cancel()
-                    nb += 1
-                    yield b
-                record_split(i, t0, nb)
-            return
-
-        DONE = object()
-        stop = threading.Event()     # consumer gone (e.g. LIMIT satisfied)
-        # one bounded queue per split; the consumer drains them in split
-        # order while workers fill later splits ahead of it
-        queues = [_queue.Queue(maxsize=4) for _ in splits]
-        pending = _queue.Queue()
-        for i in range(len(splits)):
-            pending.put(i)
-
-        def put(q, item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except _queue.Full:
-                    continue
-            return False
-
-        def worker():
-            while not stop.is_set():
-                try:
-                    i = pending.get_nowait()
-                except _queue.Empty:
-                    return
-                try:
-                    t0 = _time.perf_counter()
-                    nb = 0
-                    src = conn.page_source(
-                        splits[i], list(node.columns),
-                        pushdown=current_pushdown(),
-                        rows_per_batch=self.rows_per_batch)
-                    for b in src.batches():
-                        nb += 1
-                        if not put(queues[i], b):
-                            return
-                    record_split(i, t0, nb)
-                except BaseException as e:  # surfaced on the consumer side
-                    put(queues[i], e)
-                    return
-                put(queues[i], DONE)
-
-        workers = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(min(n_threads, len(splits)))]
-        for w in workers:
-            w.start()
-        try:
-            for q in queues:
-                while True:
-                    item = q.get()
-                    if item is DONE:
-                        break
-                    if isinstance(item, BaseException):
-                        raise item
-                    self._check_cancel()
-                    yield item
-        finally:
-            stop.set()
+        yield from scancache.scan_splits(
+            conn, node.catalog, list(node.columns), splits,
+            current_pushdown, self.rows_per_batch, opts,
+            record_split=record_split, check_cancel=self._check_cancel,
+            stats=self.stats, static_pushdown=node.pushdown or None)
 
     def _ValuesNode(self, node: ValuesNode) -> Iterator[Batch]:
         data = {

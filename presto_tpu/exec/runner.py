@@ -307,10 +307,15 @@ class LocalRunner:
                     from ..planner.printer import format_trace_summary
                     text += "\n" + format_trace_summary(trace_spans)
                 if stats is not None:
-                    from ..planner.printer import format_skew_summary
+                    from ..planner.printer import (
+                        format_scan_cache_summary, format_skew_summary,
+                    )
                     skew = format_skew_summary(stats)
                     if skew:
                         text += "\n" + skew
+                    sc = format_scan_cache_summary(stats)
+                    if sc:
+                        text += "\n" + sc
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.split("\n")])
         if isinstance(stmt, A.ShowCatalogs):

@@ -41,6 +41,23 @@ class StatsCollector:
         #: event/SplitMonitor.java split-completion events): dicts with
         #: table, split, wall_ms, batches, started_at
         self.splits: List[Dict] = []
+        #: device scan-cache outcome per split (exec/scancache.py) and
+        #: cumulative consumer-side prefetch stall — the EXPLAIN ANALYZE
+        #: scan-cache line's feed
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.prefetch_stall_s = 0.0
+        import threading
+        # record_cache fires from concurrent prefetch worker threads;
+        # an unsynchronized += would drop increments
+        self._cache_lock = threading.Lock()
+
+    def record_cache(self, hit: bool) -> None:
+        with self._cache_lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
 
     def record_split(self, table: str, split_no: int, started_at: float,
                      wall_s: float, batches: int) -> None:

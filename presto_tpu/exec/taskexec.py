@@ -43,6 +43,11 @@ class TaskHandle:
         self.device_seconds = 0.0
         self.quanta = 0
         self.closed = False
+        #: input-stall seconds accrued DURING the current quantum (the
+        #: scan prefetcher's consumer waits, exec/scancache.py): credited
+        #: back when the quantum closes so device-time fairness bills
+        #: compute, not waiting on host-side decode
+        self.stall_credit = 0.0
 
     @property
     def level(self) -> int:
@@ -69,6 +74,11 @@ class DeviceScheduler:
         self._waiting: List[TaskHandle] = []
         self._running: Optional[TaskHandle] = None
         self._running_depth = 0
+        #: ident of the thread executing the current quantum's fn():
+        #: stall credits only attach when the STALLED thread is the one
+        #: being billed (a query running outside the scheduler must not
+        #: discount another query's quantum)
+        self._running_thread: Optional[int] = None
 
     def task(self, name: str = "") -> TaskHandle:
         h = TaskHandle(self, name)
@@ -106,6 +116,7 @@ class DeviceScheduler:
                 self._cv.wait(timeout=1.0)
             self._waiting.remove(handle)
             self._running = handle
+            self._running_thread = threading.get_ident()
             self._running_depth += 1
         t0 = time.perf_counter()
         _WAIT_SECONDS.observe(t0 - t_wait)
@@ -118,15 +129,34 @@ class DeviceScheduler:
             dt = time.perf_counter() - t0
             if span is not None:
                 span.finish()
-            _DEVICE_SECONDS.inc(dt)
             _QUANTA.inc()
             with self._cv:
-                handle.device_seconds += dt
+                # input-stall credit (note_stall): time this quantum
+                # spent blocked on the scan prefetcher is not device
+                # time — billing it would climb an input-bound query up
+                # the levels for compute it never dispatched
+                credit = min(handle.stall_credit, dt)
+                handle.stall_credit = 0.0
+                billed = dt - credit
+                _DEVICE_SECONDS.inc(billed)
+                handle.device_seconds += billed
                 handle.quanta += 1
                 self._running_depth -= 1
                 if self._running_depth == 0:
                     self._running = None
+                    self._running_thread = None
                 self._cv.notify_all()
+
+    def note_stall(self, seconds: float) -> None:
+        """Record input-stall time (the scan pipeline's consumer waited
+        on a prefetch queue) against the currently-running quantum —
+        only when the caller IS that quantum's thread, so a query
+        stalling outside the scheduler (init plans, fair_scheduling off)
+        never discounts another query's bill."""
+        with self._cv:
+            if self._running is not None \
+                    and self._running_thread == threading.get_ident():
+                self._running.stall_credit += seconds
 
 
 #: process-wide scheduler (one real device per process)

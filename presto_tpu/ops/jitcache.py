@@ -265,6 +265,19 @@ def compact_jit(batch, capacity: int):
     return _compact(capacity)(batch)
 
 
+_pad = _entry_cache(
+    "pad_capacity",
+    lambda capacity: jax.jit(lambda b: b.pad(capacity)))
+
+
+def pad_capacity_jit(batch, capacity: int):
+    """Jitted Batch.pad — grow a ragged batch (a split's residual final
+    chunk) to the scan stream's standard bucket with dead lanes, so
+    downstream operators reuse one executable per shape instead of
+    compiling one per residual size."""
+    return _pad(capacity)(batch)
+
+
 from .join import prepare_direct  # noqa: E402
 
 

@@ -178,6 +178,26 @@ def format_skew_summary(stats, straggler_ratio: float = 3.0,
     return "\n".join(["Skew (splits per table):"] + lines)
 
 
+def format_scan_cache_summary(stats) -> str:
+    """Scan-cache section appended to EXPLAIN ANALYZE: split-level
+    device-cache outcomes for THIS query, the process-wide resident
+    set, and how long the consumer stalled waiting on the prefetcher
+    (input-bound queries show a large stall; compute-bound show ~0).
+    Empty string when the query touched no cacheable scans."""
+    hits = getattr(stats, "cache_hits", 0)
+    misses = getattr(stats, "cache_misses", 0)
+    stall_s = getattr(stats, "prefetch_stall_s", 0.0)
+    # stall alone still reports: the input-bound diagnostic is
+    # independent of cacheability (uncacheable sources, scan_cache=false)
+    if not hits and not misses and stall_s < 1e-4:
+        return ""
+    from ..exec.scancache import CACHE
+    return (f"Scan cache: {hits} split hit{'s' if hits != 1 else ''} / "
+            f"{misses} miss{'es' if misses != 1 else ''}, resident "
+            f"{CACHE.resident_bytes / 1048576.0:,.1f} MiB; "
+            f"prefetch stall {stall_s * 1e3:,.1f}ms")
+
+
 def _label(n: PlanNode) -> str:
     cols = ", ".join(f"{f.name}:{f.type.display()}" for f in n.fields)
     if isinstance(n, TableScanNode):

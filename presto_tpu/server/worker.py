@@ -238,12 +238,18 @@ class _TaskExecutor(local_exec._Executor):
         self.partition = partition
 
     def _TableScanNode(self, node) -> Iterator[Batch]:
+        # same cache + prefetch pipeline as the local executor: repeated
+        # queries hit device memory on every node, and cold splits
+        # decode/stage on background threads while this task's kernels
+        # run (exec/scancache.py)
+        from ..exec import scancache
         conn = self.session.catalogs.get(node.catalog)
-        for split in self.assigned_splits:
-            src = conn.page_source(split, list(node.columns),
-                                   pushdown=node.pushdown or None,
-                                   rows_per_batch=self.rows_per_batch)
-            yield from src.batches()
+        opts = scancache.options_from_session(self.session)
+        yield from scancache.scan_splits(
+            conn, node.catalog, list(node.columns),
+            list(self.assigned_splits), self._scan_pushdown_fn(node),
+            self.rows_per_batch, opts, stats=self.stats,
+            static_pushdown=node.pushdown or None)
 
     def _RemoteSourceNode(self, node) -> Iterator[Batch]:
         locations: List[str] = []

@@ -88,6 +88,10 @@ def test_sql_over_orc(runner, orc_dir):
     assert res.rows[0] == (N, want_sum, 0, N - 1)
 
 
+@pytest.mark.slow   # 296s call on the tier-1 host (35% of the whole
+#                     suite, check_tier1_time r7): grouped agg over the
+#                     ORC table compiles a one-off kernel set; the fast
+#                     ORC coverage (scan/pushdown/nulls/types) stays
 def test_sql_filter_group(runner, orc_dir):
     _, t = orc_dir
     res = runner.execute(

@@ -8,8 +8,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+try:                                    # jax >= 0.6: top-level export,
+    from jax import shard_map as _shard_map     # kwarg is check_vma
+    _CHECK_KW = "check_vma"
+except ImportError:                     # jax 0.4.x: experimental module,
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"             # same switch as exec/distributed
 from jax.sharding import PartitionSpec as P
+
+
+def shard_map(f, **kw):
+    """Version-portable shard_map: call sites use the modern check_vma
+    spelling; older jax gets it translated to check_rep."""
+    if "check_vma" in kw and _CHECK_KW != "check_vma":
+        kw[_CHECK_KW] = kw.pop("check_vma")
+    return _shard_map(f, **kw)
 
 from presto_tpu import types as T
 from presto_tpu.batch import Batch

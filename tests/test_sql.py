@@ -30,6 +30,16 @@ def runner():
 def oracle(runner):
     """SQLite loaded with the same generated TPC-H data."""
     conn = sqlite3.connect(":memory:")
+    try:
+        conn.execute("select floor(1.5)")
+    except sqlite3.OperationalError:
+        # sqlite built without SQLITE_ENABLE_MATH_FUNCTIONS (the default
+        # before 3.35 and in many distro builds): supply the oracle's
+        # floor() in Python so the feature test compares, not crashes
+        import math
+        conn.create_function(
+            "floor", 1,
+            lambda v: math.floor(v) if v is not None else None)
     tpch = runner.session.catalogs.get("tpch")
     for t in TABLES:
         schema = tpch_schema(t)
@@ -89,8 +99,18 @@ def compare(runner, oracle, sql, oracle_sql=None, rel=1e-9):
                 assert gv == wv, (gr, wr)
 
 
+# q21 alone costs 137s on the tier-1 host (16% of the whole suite,
+# check_tier1_time r7: quadruple-correlated EXISTS/NOT EXISTS compiles
+# a one-off kernel set) — it runs with the slow tier; the other 21
+# TPC-H queries keep oracle coverage in tier-1
+_TPCH_PARAMS = [
+    pytest.param(*t, marks=pytest.mark.slow) if t[0] == "q21" else t
+    for t in TPCH_QUERIES
+]
+
+
 @pytest.mark.parametrize(
-    "name,sql,oracle_sql", TPCH_QUERIES, ids=[t[0] for t in TPCH_QUERIES])
+    "name,sql,oracle_sql", _TPCH_PARAMS, ids=[t[0] for t in TPCH_QUERIES])
 def test_tpch(runner, oracle, name, sql, oracle_sql):
     compare(runner, oracle, sql, oracle_sql, rel=1e-6)
 
