@@ -141,6 +141,11 @@ class NodeConfig:
         #: (exec/scancache.py); None keeps the built-in default
         raw_sc = props.get("scan-cache.max-bytes")
         self.scan_cache_bytes = int(raw_sc) if raw_sc else None
+        #: deterministic fault-injection spec (exec/failpoints.py
+        #: grammar, ';'-separated) — chaos/soak runs arm failpoints
+        #: straight from config.properties, same as the
+        #: PRESTO_TPU_FAILPOINTS env var
+        self.failpoints = props.get("failpoints")
         #: session property defaults: session.<name>=<value>
         self.session_defaults = {
             k[len("session."):]: v for k, v in props.items()
@@ -183,6 +188,9 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
     if cfg.scan_cache_bytes is not None:
         from .exec.scancache import CACHE
         CACHE.set_limit(cfg.scan_cache_bytes)
+    if cfg.failpoints:
+        from .exec.failpoints import FAILPOINTS
+        FAILPOINTS.configure_from_spec(cfg.failpoints)
     runner = LocalRunner(catalogs=catalogs, catalog=cfg.catalog,
                          schema=cfg.schema)
     runner.session.properties.update(cfg.session_defaults)

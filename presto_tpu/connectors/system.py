@@ -78,7 +78,7 @@ _SCHEMAS: Dict[str, List] = {
         ("elapsed_ms", T.DOUBLE), ("cpu_ms", T.DOUBLE),
         ("device_sync_ms", T.DOUBLE), ("planning_ms", T.DOUBLE),
         ("peak_memory_bytes", T.BIGINT), ("rows", T.BIGINT),
-        ("mode", V), ("plan_summary", V)],
+        ("mode", V), ("plan_summary", V), ("retries", T.BIGINT)],
     "operator_stats": [
         ("query_id", V), ("operator", V), ("rows", T.BIGINT),
         ("batches", T.BIGINT), ("wall_ms", T.DOUBLE),
@@ -236,7 +236,8 @@ class SystemConnector(Connector):
                      float(r.get("planning_ms") or 0.0),
                      int(r.get("peak_memory_bytes") or 0),
                      int(r.get("rows") or 0),
-                     r.get("mode", ""), r.get("plan_summary", ""))
+                     r.get("mode", ""), r.get("plan_summary", ""),
+                     int(r.get("retries") or 0))
                     for r in HISTORY.snapshot()]
         if table == "operator_stats":
             from ..obs.history import HISTORY

@@ -17,20 +17,42 @@ simplified to uniform assignment):
   hash-routed by the producer (buffer index = consumer partition);
 - ``single`` fragments: one task on the least-loaded worker.
 
-Failure handling (reference failuredetector/HeartbeatFailureDetector):
-a background heartbeat pings ``/v1/info``; nodes failing
-``max_consecutive`` pings are excluded from scheduling, and queries with
-tasks on a dead node fail fast rather than hang.
+Failure handling (reference failuredetector/HeartbeatFailureDetector +
+execution/scheduler retry; Presto's fault-tolerant execution spooled
+the same way our ``retain=True`` output buffers do):
+
+- a background heartbeat pings ``/v1/info``; nodes failing
+  ``max_consecutive`` pings are excluded from scheduling;
+- ``retry_policy=TASK`` (default): a FAILED task or a task lost with
+  its worker is re-created (same deterministic fragment + splits, new
+  attempt id) on a healthy node with exponential backoff, bounded by
+  ``task_retry_attempts``; every transitive downstream consumer is
+  re-created too, re-reading retained upstream buffers from token 0 —
+  so one socket blip or one dead host costs a partial re-run, not the
+  query;
+- ``retry_policy=QUERY``: any task failure re-plans and re-runs the
+  whole query (``query_retry_attempts`` times);
+- ``retry_policy=NONE``: fail fast (the pre-fault-tolerance behavior);
+- speculative execution: a task the ``StageMonitor`` flags as a
+  straggler gets a duplicate attempt on another node;
+  first-finished-wins and the loser is aborted (attempt-id-versioned
+  buffers make duplicate rows impossible by construction);
+- drain-aware scheduling: nodes reporting ``SHUTTING_DOWN`` (worker
+  graceful shutdown, ``PUT /v1/info/state``) finish their running
+  tasks but receive no new ones;
+- ``query_max_run_time``: a coordinator-side deadline that DELETE-
+  aborts every task of the query on expiry.
 """
 from __future__ import annotations
 
 import json
+import re
 import statistics
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..connectors.spi import Split
 from ..obs.log import LOG
@@ -41,12 +63,43 @@ from ..planner.fragmenter import (
     FragmentedPlan, OutputSpec, PlanFragment, fragment_plan,
 )
 from ..planner.plan import PlanNode, RemoteSourceNode, TableScanNode
+from .failpoints import FAILPOINTS
 from .local import QueryResult
 from .runner import LocalRunner
 
 
 class QueryFailedError(RuntimeError):
     pass
+
+
+class _QueryRetry(Exception):
+    """Internal: ``retry_policy=QUERY`` requested a whole-query rerun."""
+
+
+#: duration strings accepted by ``query_max_run_time`` (reference
+#: io.airlift.units.Duration): bare numbers are seconds
+_DURATION_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(ms|s|m|h)?\s*$")
+_DURATION_UNITS = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, None: 1.0}
+
+
+def parse_duration_s(value) -> Optional[float]:
+    """'500ms' | '30s' | '5m' | '2h' | 12.5 -> seconds; None/'' -> None."""
+    if value is None or value == "":
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _DURATION_RE.match(str(value))
+    if m is None:
+        raise ValueError(f"bad duration {value!r} (want e.g. 30s, 500ms)")
+    return float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+
+
+def _retry_policy(session) -> str:
+    p = str(session.properties.get("retry_policy", "TASK")).upper()
+    if p not in ("TASK", "QUERY", "NONE"):
+        raise ValueError(
+            f"retry_policy must be TASK, QUERY or NONE, got {p!r}")
+    return p
 
 
 class HeartbeatFailureDetector:
@@ -83,6 +136,9 @@ class HeartbeatFailureDetector:
         """The worker's ``/v1/info`` doc on success (always truthy),
         None on failure."""
         try:
+            # failpoint: simulate a missed heartbeat (FailpointError
+            # falls into the generic failure path below)
+            FAILPOINTS.hit("heartbeat.ping", key=url)
             with urllib.request.urlopen(f"{url}/v1/info",
                                         timeout=5) as resp:
                 return json.loads(resp.read()) or {"state": "ACTIVE"}
@@ -165,6 +221,11 @@ class ClusterMemoryManager:
 
 _STRAGGLERS_DETECTED = REGISTRY.counter("straggler_detected_total")
 _SKEWED_STAGES = REGISTRY.counter("skewed_stage_total")
+_TASK_RETRIES = REGISTRY.counter("task_retry_total")
+_QUERY_RETRIES = REGISTRY.counter("query_retry_total")
+_SPEC_LAUNCHED = REGISTRY.counter("speculative_launched_total")
+_SPEC_WON = REGISTRY.counter("speculative_won_total")
+_NODES_DRAINED = REGISTRY.counter("node_drained_total")
 
 
 class StageMonitor:
@@ -277,10 +338,549 @@ class StageMonitor:
                         rows=[int(r) for r in rows])
         return self.summary()
 
+    @property
+    def stragglers(self) -> Set[str]:
+        """Task ids flagged as stragglers so far — the speculative
+        execution layer's launch feed."""
+        return set(self._stragglers)
+
     def summary(self) -> Dict[str, object]:
         return {"progress": dict(sorted(self.progress.items())),
                 "stragglers": sorted(self._stragglers),
                 "skewed_stages": dict(sorted(self._skew.items()))}
+
+
+#: matches the upstream-task reference an ExchangeFailedError embeds in
+#: a failed consumer's error string (server/worker.py) — the retry
+#: layer's pointer to WHICH attempt to replace
+_UPSTREAM_RE = re.compile(r"upstream task (\S+?)[\s:]")
+
+
+class _TaskAttempt:
+    """One live attempt of one logical task (a (fragment, partition)
+    slot). Attempt ids are versioned into the task id — every attempt
+    owns its own worker-side output buffer, so consumers can never
+    interleave pages from two attempts."""
+
+    __slots__ = ("key", "attempt", "worker", "url", "task_id",
+                 "speculative")
+
+    def __init__(self, key, attempt, worker, url, task_id,
+                 speculative=False):
+        self.key = key                  # (fragment_id, partition)
+        self.attempt = attempt
+        self.worker = worker
+        self.url = url
+        self.task_id = task_id
+        self.speculative = speculative
+
+
+class _QueryExecution:
+    """One cluster query's task graph with fault tolerance: scheduling,
+    status-poll driven retry/rescheduling, speculative straggler
+    attempts, drain-aware worker choice, and the query deadline. The
+    coordinator-side core of the reference's SqlQueryScheduler +
+    SqlStageExecution retry machinery, collapsed onto the deterministic
+    re-executable task docs this engine already ships."""
+
+    def __init__(self, runner: "ClusterRunner", fp: FragmentedPlan,
+                 init_values: List[object], workers: List[str],
+                 exec_id: str, monitor: StageMonitor,
+                 deadline: Optional[float] = None):
+        self.runner = runner
+        self.fp = fp
+        self.init_values = init_values
+        self.workers = list(workers)
+        self.exec_id = exec_id
+        self.monitor = monitor
+        self.deadline = deadline        # time.monotonic() cutoff
+        session = runner.session
+        self.policy = _retry_policy(session)
+        self.max_task_retries = int(
+            session.properties.get("task_retry_attempts", 2))
+        self.backoff_s = float(
+            session.properties.get("task_retry_backoff_s", 0.05))
+        from ..planner.planner import bool_property
+        self.spec_enabled = self.policy == "TASK" and bool_property(
+            session, "speculative_execution", True)
+        # retained buffers let a re-created consumer re-read a healthy
+        # upstream attempt's complete output from token 0 — the
+        # in-memory stand-in for reference spooled exchange storage
+        self.retain = self.policy == "TASK"
+        # -- graph ------------------------------------------------------------
+        self.frag_of: Dict[int, PlanFragment] = {
+            f.id: f for f in fp.fragments}
+        self.consumer_fid: Dict[int, int] = {}
+        for f in fp.fragments:
+            for node in _walk(f.root):
+                if isinstance(node, RemoteSourceNode):
+                    for fid in node.fragment_ids:
+                        self.consumer_fid[fid] = f.id
+        self.task_count: Dict[int, int] = {}
+        self.splits_of: Dict[Tuple[int, int], List[Split]] = {}
+        self.parts: Dict[int, List[Tuple[int, int]]] = {}
+        self.n_buffers_of: Dict[int, int] = {}
+        #: initial placement mirrors the pre-fault-tolerance scheduler:
+        #: source tasks follow their split assignment, fixed stages put
+        #: one task per worker, single stages take the first worker
+        self.placement: Dict[Tuple[int, int], str] = {}
+        for f in fp.fragments:
+            if f.partitioning == "source":
+                keys = []
+                part = 0
+                for w, splits in zip(self.workers,
+                                     runner._assign_splits(
+                                         f, self.workers)):
+                    if not splits:
+                        continue
+                    key = (f.id, part)
+                    self.splits_of[key] = splits
+                    self.placement[key] = w
+                    keys.append(key)
+                    part += 1
+                self.parts[f.id] = keys
+            elif f.partitioning == "fixed":
+                self.parts[f.id] = [(f.id, p)
+                                    for p in range(len(self.workers))]
+                for p, w in enumerate(self.workers):
+                    self.placement[(f.id, p)] = w
+            else:
+                self.parts[f.id] = [(f.id, 0)]
+                self.placement[(f.id, 0)] = self.workers[0]
+            self.task_count[f.id] = len(self.parts[f.id])
+        for f in fp.fragments:
+            self.n_buffers_of[f.id] = self.task_count.get(
+                self.consumer_fid.get(f.id, -1), 1)
+        self.root_fid = fp.root.id
+        # -- live state -------------------------------------------------------
+        self.tasks: Dict[Tuple[int, int], _TaskAttempt] = {}
+        self.spec: Dict[Tuple[int, int], _TaskAttempt] = {}
+        self.spec_done: Set[Tuple[int, int]] = set()
+        self.attempt_no: Dict[Tuple[int, int], int] = {}
+        self.retries_used: Dict[Tuple[int, int], int] = {}
+        self.bad_workers: Set[str] = set()
+        self._sched: Optional[List[str]] = None
+        self.retries = 0
+        self.spec_launched = 0
+        self.spec_won = 0
+        self.events: List[Dict[str, object]] = []
+
+    # -- scheduling -----------------------------------------------------------
+    def schedule_all(self) -> None:
+        """Create every task, upstream-first (the fragments list is in
+        dependency order: children were cut before their consumers)."""
+        self._sched = None
+        for f in self.fp.fragments:
+            with TRACER.span("stage", query_id=self.exec_id,
+                             stage_id=f.id,
+                             partitioning=f.partitioning):
+                for key in self.parts[f.id]:
+                    self._launch(key,
+                                 preferred=self.placement.get(key))
+
+    def _task_id(self, key: Tuple[int, int], attempt: int) -> str:
+        base = f"{self.exec_id}.{key[0]}.{key[1]}"
+        return base if attempt == 0 else f"{base}.a{attempt}"
+
+    def _sources_for(self, f: PlanFragment) -> Dict[int, List[str]]:
+        out: Dict[int, List[str]] = {}
+        for node in _walk(f.root):
+            if isinstance(node, RemoteSourceNode):
+                for fid in node.fragment_ids:
+                    out[fid] = [self.tasks[k].url
+                                for k in self.parts[fid]]
+        return out
+
+    def _schedulable(self) -> List[str]:
+        """The runner's schedulable set, swept at most once per
+        scheduling burst / recovery round (``schedule_all`` and
+        ``poll`` invalidate). With heartbeat off the runner sweep
+        probes every worker synchronously (~5s per unreachable host),
+        so per-launch sweeps would serialize exactly the dead-worker
+        recovery they serve."""
+        if self._sched is None:
+            self._sched = self.runner._schedulable_workers()
+        return self._sched
+
+    def _pick_worker(self, exclude: Set[str] = frozenset()) -> str:
+        """A schedulable worker for a (re)launch: heartbeat-alive, not
+        draining, not observed bad this query; prefer workers outside
+        ``exclude`` (the failed attempt's host), least-loaded first."""
+        cands = [w for w in self._schedulable()
+                 if w not in self.bad_workers]
+        if not cands:
+            cands = [w for w in self.runner.detector.active()
+                     if w not in self.bad_workers]
+        if not cands:
+            raise QueryFailedError(
+                "no active workers to (re)schedule task")
+        load: Dict[str, int] = {}
+        for at in self.tasks.values():
+            load[at.worker] = load.get(at.worker, 0) + 1
+        preferred = [w for w in cands if w not in exclude] or cands
+        return min(preferred, key=lambda w: (load.get(w, 0),
+                                             cands.index(w)))
+
+    def _launch(self, key: Tuple[int, int],
+                preferred: Optional[str] = None,
+                exclude: Set[str] = frozenset(),
+                speculative: bool = False) -> _TaskAttempt:
+        """Create one attempt of ``key`` on a healthy worker; workers
+        that refuse the create are marked bad and another is tried."""
+        f = self.frag_of[key[0]]
+        tried: Set[str] = set()
+        while True:
+            worker = preferred if preferred is not None \
+                and preferred not in tried \
+                and preferred not in self.bad_workers \
+                else self._pick_worker(exclude | tried)
+            attempt = self.attempt_no.get(key, -1) + 1
+            self.attempt_no[key] = attempt
+            task_id = self._task_id(key, attempt)
+            retain = self.retain and key[0] != self.root_fid
+            try:
+                url = self.runner._create_task(
+                    worker, self.exec_id, f, key[1],
+                    self.n_buffers_of[f.id],
+                    self.splits_of.get(key, []),
+                    self._sources_for(f), self.init_values,
+                    task_id=task_id, retain=retain)
+            except QueryFailedError:
+                # the chosen worker is unreachable: exclude it and try
+                # the next one (its running tasks are recovered by the
+                # status-poll path, not here)
+                tried.add(worker)
+                self.bad_workers.add(worker)
+                continue
+            except urllib.error.HTTPError as e:
+                # HTTP-level refusal that survived _request's 5xx retry
+                # budget — e.g. a 503 from a worker that began draining
+                # between the schedulable sweep and this create: treat
+                # the worker as bad and pick another. 4xx refusals are
+                # deterministic (a malformed doc would fail everywhere)
+                # so they fail the query with the worker's verdict.
+                if e.code >= 500:
+                    tried.add(worker)
+                    self.bad_workers.add(worker)
+                    continue
+                detail = e.read().decode(errors="replace")
+                raise QueryFailedError(
+                    f"worker refused task create "
+                    f"({e.code}): {detail}") from None
+            at = _TaskAttempt(key, attempt, worker, url, task_id,
+                              speculative=speculative)
+            if speculative:
+                self.spec[key] = at
+            else:
+                self.tasks[key] = at
+            return at
+
+    # -- views ----------------------------------------------------------------
+    def root_url(self) -> str:
+        return self.tasks[(self.root_fid, 0)].url
+
+    def all_urls(self) -> List[str]:
+        return [at.url for at in self.tasks.values()] + \
+               [at.url for at in self.spec.values()]
+
+    def summary(self) -> Dict[str, object]:
+        return {"policy": self.policy, "retries": self.retries,
+                "speculative_launched": self.spec_launched,
+                "speculative_won": self.spec_won,
+                "events": list(self.events)}
+
+    # -- recovery -------------------------------------------------------------
+    def _delete(self, at: _TaskAttempt) -> None:
+        try:
+            self.runner._request(at.url, method="DELETE", retries=0,
+                                 timeout=5)
+        except Exception:
+            pass
+
+    def abort_all(self) -> None:
+        """Query-level abort: DELETE /v1/query/{id} on every worker —
+        the cancellation-propagation path (deadline, QUERY retry)."""
+        for url in set(list(self.runner.worker_urls)
+                       + [at.worker for at in self.tasks.values()]):
+            try:
+                self.runner._request(
+                    f"{url}/v1/query/{self.exec_id}", method="DELETE",
+                    retries=0, timeout=5)
+            except Exception:
+                continue
+
+    def check_deadline(self) -> None:
+        if self.deadline is not None \
+                and time.monotonic() > self.deadline:
+            self.abort_all()
+            raise QueryFailedError(
+                "query exceeded query_max_run_time "
+                f"({self.runner.session.properties.get('query_max_run_time')})"
+            )
+
+    def _probe(self):
+        """One status sweep over current attempts. Returns
+        ``(statuses, failed, spec_status)`` where ``failed`` maps key ->
+        human reason for FAILED/ABORTED/lost primaries and
+        ``spec_status`` maps key -> status doc or None (lost)."""
+        statuses: List[dict] = []
+        failed: Dict[Tuple[int, int], str] = {}
+        spec_status: Dict[Tuple[int, int], Optional[dict]] = {}
+        dead: Set[str] = set()
+
+        def fetch(at: _TaskAttempt) -> Tuple[Optional[dict], str]:
+            if at.worker in dead:
+                return None, f"worker {at.worker} unreachable"
+            try:
+                return self.runner._request(at.url, retries=1,
+                                            timeout=5), ""
+            except urllib.error.HTTPError as e:
+                # the worker ANSWERED: the task is unknown there
+                # (tombstone evicted, worker restarted) — the TASK is
+                # lost, the worker is not; don't poison bad_workers
+                return None, (f"task {at.task_id} unknown to "
+                              f"{at.worker} (HTTP {e.code})")
+            except Exception as e:
+                dead.add(at.worker)
+                self.bad_workers.add(at.worker)
+                return None, f"worker {at.worker} unreachable: {e}"
+
+        for key, at in list(self.tasks.items()):
+            st, why = fetch(at)
+            if st is None:
+                failed[key] = f"lost task {at.task_id} ({why})"
+                continue
+            statuses.append(st)
+            if st.get("state") in ("FAILED", "ABORTED"):
+                failed[key] = (f"task {at.task_id} "
+                               f"{st.get('state', '').lower()}: "
+                               f"{st.get('error')}")
+        for key, at in list(self.spec.items()):
+            spec_status[key] = fetch(at)[0]
+        return statuses, failed, spec_status
+
+    def _resolve_speculation(self, statuses: List[dict],
+                             failed: Dict[Tuple[int, int], str],
+                             spec_status) -> None:
+        """First-finished-wins between a primary and its speculative
+        duplicate; the loser is aborted. A winner's downstream
+        consumers are re-created against its buffer."""
+        by_id = {st.get("taskId"): st for st in statuses}
+        for key, sst in list(spec_status.items()):
+            spec = self.spec.get(key)
+            if spec is None:
+                continue
+            primary = self.tasks[key]
+            pst = by_id.get(primary.task_id)
+            if sst is None or (sst.get("state")
+                               in ("FAILED", "ABORTED")):
+                # the duplicate died: drop it, the primary carries on
+                del self.spec[key]
+                self._delete(spec)
+                continue
+            if pst is not None and pst.get("state") == "FINISHED" \
+                    and key not in failed:
+                del self.spec[key]
+                self._delete(spec)
+                LOG.log("speculative_lost", query_id=self.exec_id,
+                        task_id=spec.task_id)
+                continue
+            if sst.get("state") == "FINISHED":
+                # speculative win: promote the duplicate, rewire every
+                # downstream consumer to its buffer, abort the loser
+                del self.spec[key]
+                self.tasks[key] = spec
+                failed.pop(key, None)
+                self.spec_won += 1
+                _SPEC_WON.inc()
+                self.events.append(
+                    {"kind": "speculative_won", "task": spec.task_id,
+                     "worker": spec.worker})
+                LOG.log("speculative_won", query_id=self.exec_id,
+                        task_id=spec.task_id, loser=primary.task_id)
+                self._recreate_downstream({key[0]})
+                self._delete(primary)
+
+    def _downstream_fids(self, fids: Set[int]) -> List[int]:
+        out: Set[int] = set()
+        frontier = set(fids)
+        while frontier:
+            nxt = {self.consumer_fid[f] for f in frontier
+                   if f in self.consumer_fid}
+            nxt -= out
+            out |= nxt
+            frontier = nxt
+        return [f.id for f in self.fp.fragments if f.id in out]
+
+    def _recreate_downstream(self, fids: Set[int]) -> None:
+        """Re-create every task transitively downstream of ``fids`` (in
+        dependency order) so their exchange clients re-read the current
+        upstream attempts' retained buffers from token 0."""
+        for fid in self._downstream_fids(fids):
+            for key in self.parts[fid]:
+                old = self.tasks[key]
+                sp = self.spec.pop(key, None)
+                if sp is not None:
+                    self._delete(sp)
+                self._delete(old)
+                self._launch(key, preferred=old.worker)
+
+    def _recover(self, failed: Dict[Tuple[int, int], str]) -> None:
+        """Apply the retry policy to this round's failures."""
+        if not failed:
+            return
+        qid = self.exec_id.split("r")[0]
+        mm = self.runner.memory_manager
+        if mm is not None and (self.exec_id in mm.killed
+                               or qid in mm.killed):
+            # the cluster memory manager killed this query on purpose —
+            # resurrecting it would fight the OOM killer
+            raise QueryFailedError(
+                "Query killed: exceeded cluster memory limit "
+                f"({next(iter(failed.values()))})")
+        reason = next(iter(failed.values()))
+        if self.policy == "NONE":
+            raise QueryFailedError(reason)
+        if self.policy == "QUERY":
+            raise _QueryRetry(reason)
+        self.check_deadline()
+        # an ExchangeFailedError names the upstream attempt that died:
+        # the real fault is THERE; its consumer is collateral and is
+        # re-created by the cascade without burning its own budget
+        by_id = {at.task_id: key for key, at in self.tasks.items()}
+        extra: Dict[Tuple[int, int], str] = {}
+        for key, why in failed.items():
+            m = _UPSTREAM_RE.search(why or "")
+            if not m:
+                continue
+            tid = m.group(1)
+            ukey = by_id.get(tid)
+            if ukey is None:
+                parts = tid.split(".")
+                if len(parts) >= 3 and parts[1].isdigit() \
+                        and parts[2].isdigit():
+                    ukey = (int(parts[1]), int(parts[2]))
+            if ukey is not None and ukey in self.tasks:
+                extra[ukey] = why
+        failed = dict(failed)
+        failed.update(extra)
+        collateral = set()
+        failed_fids = {k[0] for k in failed}
+        for fid in self._downstream_fids(failed_fids):
+            for key in self.parts[fid]:
+                collateral.add(key)
+        billed = {k: v for k, v in failed.items()
+                  if k not in collateral}
+        if not billed:       # pure collateral (stale consumer errors)
+            billed = dict(failed)
+        max_used = 0
+        for key, why in billed.items():
+            used = self.retries_used.get(key, 0) + 1
+            self.retries_used[key] = used
+            max_used = max(max_used, used)
+            if used > self.max_task_retries:
+                raise QueryFailedError(
+                    f"task {self.tasks[key].task_id} failed after "
+                    f"{used} attempts: {why}")
+        time.sleep(min(self.backoff_s * (2 ** (max_used - 1)), 2.0))
+        # replace failed attempts upstream-first, then cascade to every
+        # transitive consumer (they re-read retained buffers)
+        replace = {k for k in failed if k not in collateral} \
+            or set(failed)
+        for f in self.fp.fragments:
+            for key in self.parts[f.id]:
+                if key not in replace:
+                    continue
+                old = self.tasks[key]
+                sp = self.spec.pop(key, None)
+                self._delete(old)
+                self.retries += 1
+                _TASK_RETRIES.inc()
+                if sp is not None:
+                    # the straggler hedge outlived its primary: promote
+                    # the duplicate (probed healthy this round —
+                    # _resolve_speculation already dropped dead ones)
+                    # instead of restarting the work from zero
+                    self.tasks[key] = at = sp
+                else:
+                    at = self._launch(key, exclude={old.worker})
+                self.events.append(
+                    {"kind": "task_retry", "task": at.task_id,
+                     "from": old.worker, "to": at.worker,
+                     "attempt": at.attempt,
+                     "reason": failed.get(key, "")})
+                LOG.log("task_retried", query_id=self.exec_id,
+                        task_id=old.task_id, new_task_id=at.task_id,
+                        from_worker=old.worker, to_worker=at.worker,
+                        attempt=at.attempt,
+                        reason=failed.get(key, ""))
+        self._recreate_downstream({k[0] for k in replace})
+
+    def _maybe_speculate(self, statuses: List[dict]) -> None:
+        if not self.spec_enabled:
+            return
+        stragglers = self.monitor.stragglers
+        if not stragglers:
+            return
+        by_id = {at.task_id: (key, at)
+                 for key, at in self.tasks.items()}
+        states = {st.get("taskId"): st.get("state") for st in statuses}
+        for tid in stragglers:
+            ent = by_id.get(tid)
+            if ent is None:
+                continue
+            key, at = ent
+            if key in self.spec or key in self.spec_done \
+                    or states.get(tid) != "RUNNING":
+                continue
+            if not any(w != at.worker and w not in self.bad_workers
+                       for w in self._schedulable()):
+                # no second host right now: don't create a duplicate
+                # that _launch would land on the straggler's own
+                # already-slow worker; re-check next round (a node
+                # may finish draining or rejoin)
+                continue
+            try:
+                dup = self._launch(key, exclude={at.worker},
+                                   speculative=True)
+            except QueryFailedError:
+                continue          # no second host available: skip
+            if dup.worker == at.worker:
+                # a one-node cluster cannot speculate usefully; mark
+                # the key done so the next poll round doesn't land
+                # another create/abort churn on the already-slow host
+                self.spec.pop(key, None)
+                self._delete(dup)
+                self.spec_done.add(key)
+                continue
+            self.spec_done.add(key)
+            self.spec_launched += 1
+            _SPEC_LAUNCHED.inc()
+            self.events.append(
+                {"kind": "speculative_launched", "task": dup.task_id,
+                 "straggler": tid, "worker": dup.worker})
+            LOG.log("speculative_launched", query_id=self.exec_id,
+                    straggler_task_id=tid, task_id=dup.task_id,
+                    worker=dup.worker)
+
+    def poll(self) -> int:
+        """One recovery round: deadline, status sweep, speculation
+        resolution/launch, failure recovery. Returns the number of
+        recovery actions taken (retries + speculation changes)."""
+        self.check_deadline()
+        self._sched = None
+        before = self.retries + self.spec_launched + self.spec_won
+        statuses, failed, spec_status = self._probe()
+        self.monitor.observe(statuses)
+        self._resolve_speculation(statuses, failed, spec_status)
+        self._recover(failed)
+        self._maybe_speculate(statuses)
+        return (self.retries + self.spec_launched + self.spec_won) \
+            - before
+
+    def cleanup(self) -> None:
+        for at in list(self.tasks.values()) + list(self.spec.values()):
+            self._delete(at)
 
 
 class ClusterRunner:
@@ -304,6 +904,13 @@ class ClusterRunner:
         self._seq = 0
         #: worker url -> node id learned from /v1/info (node federator)
         self._node_ids: Dict[str, str] = {}
+        #: worker url -> last seen /v1/info state — the drain-aware
+        #: scheduling feed (SHUTTING_DOWN nodes finish their running
+        #: tasks but are never assigned new ones)
+        self._node_states: Dict[str, str] = {}
+        #: monitor/recovery info of the last _run_fragments call (the
+        #: cluster EXPLAIN ANALYZE feed)
+        self._last_run_info: Dict[str, object] = {}
         NODES.update("coordinator", state="ACTIVE", coordinator=True,
                      uri="", active_tasks=0, mem_pool_peak_bytes=0)
         self.detector = HeartbeatFailureDetector(
@@ -338,8 +945,16 @@ class ClusterRunner:
         node-labeled series on the coordinator's ``/v1/metrics``."""
         nid = str(info.get("nodeId") or url)
         self._node_ids[url] = nid
+        state = str(info.get("state", "ACTIVE"))
+        if state == "SHUTTING_DOWN" \
+                and self._node_states.get(url) != "SHUTTING_DOWN":
+            # ACTIVE -> SHUTTING_DOWN transition: the node entered its
+            # drain window; the scheduler stops assigning to it
+            _NODES_DRAINED.inc()
+            LOG.log("node_draining", node_id=nid, uri=url)
+        self._node_states[url] = state
         tasks = info.get("tasks") or {}
-        NODES.update(nid, state=str(info.get("state", "ACTIVE")),
+        NODES.update(nid, state=state,
                      coordinator=False, uri=url,
                      active_tasks=int(tasks.get("RUNNING", 0) or 0),
                      mem_pool_peak_bytes=int(
@@ -354,11 +969,33 @@ class ClusterRunner:
                 info = self._request(f"{url}/v1/info", retries=0,
                                      timeout=5)
             except Exception:
+                self._node_states[url] = "UNREACHABLE"
                 nid = self._node_ids.get(url)
                 if nid:
                     NODES.update(nid, seen=False, state="UNREACHABLE")
                 continue
             self._note_node_info(url, info)
+
+    def _schedulable_workers(self) -> List[str]:
+        """Workers eligible for NEW task assignment: heartbeat-alive and
+        not draining (reference NodeScheduler skips nodes the
+        GracefulShutdownHandler flagged SHUTTING_DOWN). Drain state
+        merges two feeds: the ``/v1/info`` heartbeat sweep and the
+        discovery announcements (a draining worker pushes
+        SHUTTING_DOWN immediately, ahead of the next sweep)."""
+        urls = self.detector.active()
+        if not self._heartbeat_on:
+            # no background federator: one synchronous sweep so drain
+            # state and system.runtime.nodes are fresh for this query
+            self.poll_nodes(urls)
+        draining = {u for u, s in
+                    (self.discovery.states() if self.discovery
+                     is not None else {}).items()
+                    if s == "SHUTTING_DOWN"}
+        return [u for u in urls
+                if u not in draining
+                and self._node_states.get(u)
+                not in ("SHUTTING_DOWN", "UNREACHABLE")]
 
     # -- HTTP helpers --------------------------------------------------------
     #: transient-failure budget for one remote-task call (reference
@@ -415,6 +1052,10 @@ class ClusterRunner:
         from ..sql.parser import parse_statement
         from ..sql import ast as A
         stmt = parse_statement(sql)
+        if isinstance(stmt, A.Explain) and stmt.analyze \
+                and isinstance(stmt.statement, A.Query) \
+                and stmt.type == "logical" and stmt.format == "text":
+            return self._explain_analyze(stmt.statement, sql)
         if not isinstance(stmt, A.Query):
             return self.local.execute(sql)
         plan = self.local.plan(sql)
@@ -427,25 +1068,62 @@ class ClusterRunner:
         fragmented = fragment_plan(plan.root)
         return self._run_fragments(fragmented, init_values, sql)
 
+    def _explain_analyze(self, query_stmt, sql: str) -> QueryResult:
+        """Cluster EXPLAIN ANALYZE: run the inner query on the cluster,
+        then render the plan plus the stage summary and the
+        fault-tolerance section (retries/speculation) — the cluster
+        analogue of the local runner's trace/skew/scan-cache sections."""
+        from .. import types as T
+        from ..planner.planner import plan_query
+        from ..planner.optimizer import optimize
+        from ..planner.printer import format_retry_summary, print_plan
+        from .local import run_init_plans, _Executor
+        t0 = time.perf_counter()
+        plan = optimize(plan_query(query_stmt, self.session),
+                        self.session)
+        ex = _Executor(self.session, self.rows_per_batch)
+        run_init_plans(ex, plan)
+        fragmented = fragment_plan(plan.root)
+        out = self._run_fragments(fragmented, ex.init_values, sql)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        text = print_plan(plan)
+        info = dict(self._last_run_info)
+        text += (f"\nCluster: {len(fragmented.fragments)} stages, "
+                 f"{len(out.rows):,} rows, total {wall_ms:,.0f}ms")
+        retry = format_retry_summary(info)
+        if retry:
+            text += "\n" + retry
+        return QueryResult(["Query Plan"], [T.VARCHAR],
+                           [(line,) for line in text.split("\n")])
+
     # -- scheduling ----------------------------------------------------------
+    def _schedulable_or_raise(self) -> List[str]:
+        if not self.detector.active():
+            raise QueryFailedError("no active workers")
+        workers = self._schedulable_workers()
+        if not workers:
+            raise QueryFailedError(
+                "no schedulable workers (all draining)")
+        return workers
+
     def _run_fragments(self, fp: FragmentedPlan,
                        init_values: List[object],
                        sql: str = "") -> QueryResult:
-        workers = self.detector.active()
-        if not workers:
-            raise QueryFailedError("no active workers")
+        workers = self._schedulable_or_raise()
         self._seq += 1
         qid = f"cq_{self._seq:06d}"
         REGISTRY.counter("cluster_queries_total").inc()
-        if not self._heartbeat_on:
-            # no background heartbeat federating node state (embedded/
-            # test setups): one synchronous sweep keeps
-            # system.runtime.nodes fresh; with the heartbeat on, its
-            # 5s on_info feed already does this without adding N RTTs
-            # to every query
-            self.poll_nodes(workers)
         from ..connectors.system import QueryLogEntry
         from ..events import QueryCompletedEvent
+        # validate session properties BEFORE the RUNNING log entry is
+        # appended: a bad value must raise without leaving a phantom
+        # forever-RUNNING row in system.runtime.queries
+        policy = _retry_policy(self.session)
+        q_budget = int(self.session.properties.get(
+            "query_retry_attempts", 1)) if policy == "QUERY" else 0
+        max_run = parse_duration_s(
+            self.session.properties.get("query_max_run_time"))
+        deadline = (time.monotonic() + max_run) if max_run else None
         entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0,
                               create_time=time.time())
         with self.local._state_lock:
@@ -455,13 +1133,53 @@ class ClusterRunner:
             if len(self.local.query_log) > 1000:
                 del self.local.query_log[:-500]
         monitor = StageMonitor(qid)
+        total_retries = 0
         t0 = time.perf_counter()
         error: Optional[str] = None
         try:
             with TRACER.span("query", query_id=qid, mode="cluster",
                              workers=len(workers)):
-                out = self._schedule_and_collect(
-                    fp, init_values, workers, qid, monitor)
+                for qtry in range(q_budget + 1):
+                    # QUERY-policy reruns use a distinct exec id so the
+                    # rerun's tasks never share worker-side query state
+                    # (device-scheduler handles, query-level aborts)
+                    # with still-draining tasks of the aborted attempt
+                    exec_id = qid if qtry == 0 else f"{qid}r{qtry}"
+                    monitor = StageMonitor(qid)
+                    run = _QueryExecution(self, fp, init_values,
+                                          workers, exec_id, monitor,
+                                          deadline=deadline)
+                    try:
+                        run.schedule_all()
+                        out = self._collect(fp, run)
+                        break
+                    except _QueryRetry as e:
+                        run.abort_all()
+                        if qtry >= q_budget:
+                            raise QueryFailedError(
+                                f"query failed after {qtry + 1} "
+                                f"attempts: {e}") from None
+                        _QUERY_RETRIES.inc()
+                        LOG.log("query_retried", query_id=qid,
+                                attempt=qtry + 1, reason=str(e))
+                        time.sleep(min(
+                            float(self.session.properties.get(
+                                "task_retry_backoff_s", 0.05))
+                            * (2 ** qtry), 2.0))
+                        workers = self._schedulable_or_raise()
+                    finally:
+                        # final status sweep BEFORE the task DELETEs:
+                        # frozen elapsed/rows feed the last straggler
+                        # pass, the skew pass, and the query-history
+                        # operator records
+                        monitor.finalize(
+                            self._task_statuses(run.all_urls()))
+                        self._harvest_spans(run.all_urls())
+                        run.cleanup()
+                        total_retries += run.retries
+                        self._last_run_info = {
+                            **run.summary(), "retries": total_retries,
+                            "query_retries": qtry}
             entry.state = "FINISHED"
             return out
         except Exception as e:
@@ -481,6 +1199,7 @@ class ClusterRunner:
                     f"stage{f.id}[{f.partitioning}]"
                     for f in fp.fragments),
                 "stages": summary,
+                "retries": total_retries,
                 "operators": [
                     {"operator": "task " + str(st.get("taskId", "")),
                      "rows": int(st.get("rowsOut") or 0),
@@ -498,83 +1217,7 @@ class ClusterRunner:
                 LOG.log("query_completed", query_id=qid, mode="cluster",
                         state=entry.state,
                         elapsed_ms=round(entry.elapsed_ms, 3),
-                        error=error, **summary)
-
-    def _schedule_and_collect(self, fp: FragmentedPlan,
-                              init_values: List[object],
-                              workers: List[str], qid: str,
-                              monitor: Optional[StageMonitor] = None
-                              ) -> QueryResult:
-        # task counts per fragment
-        consumer_of: Dict[int, int] = {}
-        for f in fp.fragments:
-            for node in _walk(f.root):
-                if isinstance(node, RemoteSourceNode):
-                    for fid in node.fragment_ids:
-                        consumer_of[fid] = f.id
-        task_count: Dict[int, int] = {}
-        splits_for: Dict[int, List[List[Split]]] = {}
-        for f in fp.fragments:
-            if f.partitioning == "single":
-                task_count[f.id] = 1
-            elif f.partitioning == "fixed":
-                task_count[f.id] = len(workers)
-            else:   # source: split assignment decides
-                assignment = self._assign_splits(f, workers)
-                splits_for[f.id] = assignment
-                task_count[f.id] = sum(1 for a in assignment if a)
-        # create tasks upstream-first (fragments list is already in
-        # dependency order: children were cut before their consumers)
-        task_urls: Dict[int, List[str]] = {}
-        all_tasks: List[str] = []
-        try:
-            for f in fp.fragments:
-                n_buffers = task_count.get(consumer_of.get(f.id, -1), 1)
-                sources = {
-                    fid: task_urls[fid]
-                    for node in _walk(f.root)
-                    if isinstance(node, RemoteSourceNode)
-                    for fid in node.fragment_ids
-                }
-                urls: List[str] = []
-                with TRACER.span("stage", query_id=qid, stage_id=f.id,
-                                 partitioning=f.partitioning):
-                    # tasks created inside the stage span: their wire
-                    # trace context parents them under this stage
-                    if f.partitioning == "source":
-                        assignment = splits_for[f.id]
-                        part = 0
-                        for w, splits in zip(workers, assignment):
-                            if not splits:
-                                continue
-                            urls.append(self._create_task(
-                                w, qid, f, part, n_buffers, splits,
-                                sources, init_values))
-                            part += 1
-                    elif f.partitioning == "fixed":
-                        for part, w in enumerate(workers):
-                            urls.append(self._create_task(
-                                w, qid, f, part, n_buffers, [], sources,
-                                init_values))
-                    else:
-                        urls.append(self._create_task(
-                            workers[0], qid, f, 0, n_buffers, [],
-                            sources, init_values))
-                task_urls[f.id] = urls
-                all_tasks.extend(urls)
-            return self._collect(fp, task_urls, all_tasks, monitor)
-        finally:
-            if monitor is not None:
-                # final status sweep BEFORE the task DELETEs: frozen
-                # elapsed/rows feed the last straggler pass, the skew
-                # pass, and the query-history operator records
-                monitor.finalize(self._task_statuses(all_tasks))
-            self._harvest_spans(all_tasks)
-            for u in all_tasks:
-                try:
-                    self._request(u, method="DELETE")
-                except Exception:
-                    pass
+                        error=error, retries=total_retries, **summary)
 
     def _task_statuses(self, all_tasks: List[str]) -> List[dict]:
         """Best-effort status fetch for every task (single attempt —
@@ -627,14 +1270,21 @@ class ClusterRunner:
     def _create_task(self, worker: str, qid: str, f: PlanFragment,
                      partition: int, n_buffers: int,
                      splits: List[Split], sources: Dict[int, List[str]],
-                     init_values: List[object]) -> str:
-        task_id = f"{qid}.{f.id}.{partition}"
+                     init_values: List[object],
+                     task_id: Optional[str] = None,
+                     retain: bool = False) -> str:
+        if task_id is None:
+            task_id = f"{qid}.{f.id}.{partition}"
         doc = {
             "fragment": codec.encode(f.root),
             "output": {
                 "kind": f.output.kind if f.output else "single",
                 "keys": list(f.output.keys) if f.output else [],
                 "n_buffers": n_buffers,
+                # retain=True: acked pages survive so a re-created
+                # consumer attempt can re-read from token 0 (the
+                # fault-tolerance precondition)
+                "retain": bool(retain),
             },
             "splits": [codec.encode(s) for s in splits],
             "sources": {str(k): v for k, v in sources.items()},
@@ -661,20 +1311,27 @@ class ClusterRunner:
 
     # -- result collection ---------------------------------------------------
     def _collect(self, fp: FragmentedPlan,
-                 task_urls: Dict[int, List[str]],
-                 all_tasks: List[str],
-                 monitor: Optional[StageMonitor] = None) -> QueryResult:
+                 run: _QueryExecution) -> QueryResult:
         from .pages import deserialize_page
-        root = fp.root
-        (root_url,) = task_urls[root.id]
-        out_node = root.root
+        from ..server.worker import unframe_pages
+        out_node = fp.root.root
         names = [f.name for f in out_node.fields]
         types = [f.type for f in out_node.fields]
         rows: List[tuple] = []
         token = 0
+        cur = run.root_url()
         while True:
+            run.check_deadline()
+            if run.root_url() != cur:
+                # the root task was re-created (retry cascade or a
+                # speculative win): restart collection from token 0 —
+                # every attempt owns its own buffer, so discarding the
+                # old attempt's rows makes duplicates impossible
+                cur = run.root_url()
+                token = 0
+                rows = []
             req = urllib.request.Request(
-                f"{root_url}/results/0/{token}?max_wait=2")
+                f"{cur}/results/0/{token}?max_wait=2")
             try:
                 with urllib.request.urlopen(req, timeout=30) as resp:
                     body = resp.read()
@@ -682,53 +1339,25 @@ class ClusterRunner:
                         "X-Buffer-Complete") == "true"
                     token = int(resp.headers.get("X-Next-Token", token))
             except urllib.error.HTTPError as e:
+                # the root answered with a failure (its buffer failed or
+                # the task is gone): one recovery round decides between
+                # retry and propagating the real error
                 detail = e.read().decode(errors="replace")
-                self._fail_tasks(all_tasks)
-                raise QueryFailedError(detail) from None
-            except urllib.error.URLError as e:
-                self._check_tasks(all_tasks)
-                raise QueryFailedError(str(e)) from None
-            from ..server.worker import unframe_pages
+                if not run.poll() and run.root_url() == cur:
+                    raise QueryFailedError(detail) from None
+                continue
+            except Exception as e:
+                # transport error: the root's worker may be gone; the
+                # recovery round reschedules its tasks elsewhere
+                if not run.poll() and run.root_url() == cur:
+                    raise QueryFailedError(str(e)) from None
+                continue
             for page in unframe_pages(body):
                 rows.extend(deserialize_page(page).to_pylist())
             if complete:
                 break
-            self._check_tasks(all_tasks, monitor)
+            run.poll()
         return QueryResult(names=names, types=types, rows=rows)
-
-    def _check_tasks(self, all_tasks: List[str],
-                     monitor: Optional[StageMonitor] = None) -> None:
-        # failure-path diagnostic probes: single attempt with a short
-        # timeout — this path runs when something already looks wrong,
-        # and burning the full retry budget per task against a dead
-        # worker turns fail-fast into minutes of hanging. The liveness
-        # polls double as the straggler monitor's status feed.
-        statuses: List[dict] = []
-        failed: Optional[dict] = None
-        for u in all_tasks:
-            try:
-                st = self._request(u, retries=0, timeout=5)
-            except Exception as e:
-                raise QueryFailedError(
-                    f"lost task {u}: {e}") from None
-            statuses.append(st)
-            if failed is None \
-                    and st.get("state") in ("FAILED", "ABORTED"):
-                failed = st
-        if monitor is not None:
-            monitor.observe(statuses)
-        if failed is not None:
-            raise QueryFailedError(
-                f"task {failed.get('taskId')} failed: "
-                f"{failed.get('error')}")
-
-    def _fail_tasks(self, all_tasks: List[str]) -> None:
-        try:
-            self._check_tasks(all_tasks)
-        except QueryFailedError as e:
-            raise e
-        except Exception:
-            pass
 
 
 def _walk(node: PlanNode):

@@ -18,30 +18,47 @@ from typing import Dict, List, Tuple
 
 
 class DiscoveryNodeManager:
-    """Coordinator-side registry of announced workers."""
+    """Coordinator-side registry of announced workers. Announcements
+    carry the node's lifecycle state: a draining worker (graceful
+    shutdown) re-announces as ``SHUTTING_DOWN`` so the scheduler stops
+    assigning new tasks to it without waiting for the next ``/v1/info``
+    heartbeat sweep."""
 
     def __init__(self, ttl_s: float = 15.0):
         self.ttl_s = ttl_s
-        self._nodes: Dict[str, Tuple[str, float]] = {}
+        self._nodes: Dict[str, Tuple[str, float, str]] = {}
         self._lock = threading.Lock()
 
-    def announce(self, node_id: str, url: str) -> None:
+    def announce(self, node_id: str, url: str,
+                 state: str = "ACTIVE") -> None:
         with self._lock:
-            self._nodes[node_id] = (url, time.monotonic())
+            self._nodes[node_id] = (url, time.monotonic(),
+                                    state or "ACTIVE")
 
     def active_urls(self) -> List[str]:
+        """Fresh announcements, draining nodes included — they still
+        serve their running tasks' buffers; ``states()`` is the
+        scheduler's don't-assign filter."""
         now = time.monotonic()
         with self._lock:
-            return sorted(url for url, seen in self._nodes.values()
+            return sorted(url for url, seen, _ in self._nodes.values()
                           if now - seen <= self.ttl_s)
+
+    def states(self) -> Dict[str, str]:
+        """url -> last announced lifecycle state."""
+        with self._lock:
+            return {url: state
+                    for url, _, state in self._nodes.values()}
 
     def nodes(self) -> List[dict]:
         now = time.monotonic()
         with self._lock:
             return [{"nodeId": nid, "uri": url,
                      "age_s": round(now - seen, 3),
+                     "state": state,
                      "active": now - seen <= self.ttl_s}
-                    for nid, (url, seen) in sorted(self._nodes.items())]
+                    for nid, (url, seen, state)
+                    in sorted(self._nodes.items())]
 
 
 class Announcer:
@@ -53,12 +70,21 @@ class Announcer:
         self.node_id = node_id
         self.self_url = self_url
         self.interval_s = interval_s
+        self.state = "ACTIVE"
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
+    def set_state(self, state: str) -> None:
+        """Change the announced lifecycle state and push it out
+        immediately (a draining worker must not wait one announce
+        interval before the scheduler stops feeding it)."""
+        self.state = state
+        self.announce_once()
+
     def announce_once(self) -> bool:
         body = json.dumps({"nodeId": self.node_id,
-                           "uri": self.self_url}).encode()
+                           "uri": self.self_url,
+                           "state": self.state}).encode()
         req = urllib.request.Request(
             f"{self.discovery_uri}/v1/announce", data=body,
             method="POST", headers={"Content-Type": "application/json"})

@@ -1,0 +1,208 @@
+"""Deterministic fault-injection harness (named failpoints).
+
+The role of the reference's ``@Failpoint``-style fault hooks and of
+kernel failpoint frameworks: production code calls
+``FAILPOINTS.hit("site.name", key=...)`` at interesting seams — worker
+task run, exchange pull, heartbeat ping, scan decode — and the call is
+a dictionary miss (near-zero cost) unless a test, the
+``PRESTO_TPU_FAILPOINTS`` environment variable, or a
+``failpoints=`` line in ``etc/config.properties`` armed that site.
+
+Armed sites trigger deterministically:
+
+- ``times``/``skip`` — trigger on hits ``skip+1 .. skip+times``
+  (``times=None`` = unlimited), so "fail the first task, then recover"
+  is one line of config;
+- ``probability`` + ``seed`` — a per-rule ``random.Random(seed)``
+  makes probabilistic chaos runs replayable bit-for-bit given the same
+  hit sequence;
+- ``match`` — a regex applied to the hit's ``key`` (task id, url,
+  split) so a rule can target one partition (``\\.0\\.0$``) or one
+  node (``@worker-2$``).
+
+Actions: ``error`` (raise :class:`FailpointError`), ``sleep`` (inject
+latency — the straggler story), and ``callback`` (test API only — run
+arbitrary harness code, e.g. kill a worker's HTTP server mid-query).
+Multiple rules may be armed on one site; every matching rule fires in
+configuration order.
+
+Spec grammar (env var / config value), ``;``-separated entries::
+
+    site.name=action[:arg][,times:N][,skip:N][,prob:P][,seed:S][,match:RE]
+
+    PRESTO_TPU_FAILPOINTS='worker.task_run=error:boom,times:1;\
+exchange.pull=sleep:0.5,prob:0.1,seed:7'
+
+Every recovery path in exec/cluster.py is CI-testable against this
+harness without a real multi-host TPU cluster (tools/chaos_smoke.py).
+"""
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["FailpointError", "FailpointRegistry", "FAILPOINTS"]
+
+
+class FailpointError(RuntimeError):
+    """An injected failure (never raised by real engine conditions)."""
+
+
+class _Rule:
+    __slots__ = ("site", "action", "message", "sleep_s", "times", "skip",
+                 "probability", "pattern", "rng", "callback", "hits",
+                 "triggers")
+
+    def __init__(self, site: str, action: str, message: Optional[str],
+                 sleep_s: float, times: Optional[int], skip: int,
+                 probability: Optional[float], match: Optional[str],
+                 seed: int, callback: Optional[Callable]):
+        if action not in ("error", "sleep", "callback"):
+            raise ValueError(f"unknown failpoint action {action!r}")
+        if action == "callback" and callback is None:
+            raise ValueError("callback action requires callback=")
+        self.site = site
+        self.action = action
+        self.message = message or f"injected failure at {site}"
+        self.sleep_s = float(sleep_s)
+        self.times = times            # None = unlimited triggers
+        self.skip = int(skip)
+        self.probability = probability
+        self.pattern = re.compile(match) if match else None
+        # seeded per-rule RNG: probabilistic runs replay exactly given
+        # the same hit sequence (the determinism contract of the harness)
+        self.rng = random.Random(seed)
+        self.callback = callback
+        self.hits = 0                 # matching hits seen
+        self.triggers = 0             # times the action actually fired
+
+    def _should_trigger(self, key: str) -> bool:
+        if self.pattern is not None and not self.pattern.search(key):
+            return False
+        self.hits += 1
+        if self.hits <= self.skip:
+            return False
+        if self.times is not None and self.triggers >= self.times:
+            return False
+        if self.probability is not None \
+                and self.rng.random() >= self.probability:
+            return False
+        self.triggers += 1
+        return True
+
+
+class FailpointRegistry:
+    """Process-wide named-failpoint table. ``hit`` is the production
+    call site; everything else is the test/config API."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: Dict[str, List[_Rule]] = {}
+
+    # -- configuration (test API) --------------------------------------------
+    def configure(self, site: str, action: str = "error",
+                  message: Optional[str] = None, sleep_s: float = 0.0,
+                  times: Optional[int] = 1, skip: int = 0,
+                  probability: Optional[float] = None,
+                  match: Optional[str] = None, seed: int = 0,
+                  callback: Optional[Callable] = None) -> None:
+        """Arm one rule on ``site`` (appends — multiple rules per site
+        evaluate in configuration order)."""
+        rule = _Rule(site, action, message, sleep_s, times, skip,
+                     probability, match, seed, callback)
+        with self._lock:
+            self._rules.setdefault(site, []).append(rule)
+
+    def configure_from_spec(self, spec: str) -> None:
+        """Parse the ``;``-separated spec grammar (env var / config)."""
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" not in entry:
+                raise ValueError(f"malformed failpoint entry {entry!r}")
+            site, value = entry.split("=", 1)
+            parts = value.split(",")
+            action, _, arg = parts[0].partition(":")
+            kw: Dict = {}
+            if action == "sleep":
+                kw["sleep_s"] = float(arg or "0")
+            elif action == "error":
+                if arg:
+                    kw["message"] = arg
+            else:
+                raise ValueError(
+                    f"failpoint spec only supports error/sleep "
+                    f"actions, got {action!r} (callback is test-only)")
+            for opt in parts[1:]:
+                k, _, v = opt.partition(":")
+                k = k.strip()
+                if k == "times":
+                    kw["times"] = None if v == "inf" else int(v)
+                elif k == "skip":
+                    kw["skip"] = int(v)
+                elif k == "prob":
+                    kw["probability"] = float(v)
+                elif k == "seed":
+                    kw["seed"] = int(v)
+                elif k == "match":
+                    kw["match"] = v
+                else:
+                    raise ValueError(f"unknown failpoint option {k!r}")
+            self.configure(site.strip(), action=action, **kw)
+
+    def clear(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            if site is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(site, None)
+
+    # -- introspection -------------------------------------------------------
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return sum(r.hits for r in self._rules.get(site, ()))
+
+    def triggers(self, site: str) -> int:
+        with self._lock:
+            return sum(r.triggers for r in self._rules.get(site, ()))
+
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    # -- the production call site --------------------------------------------
+    def hit(self, site: str, key: str = "", **ctx) -> None:
+        """Evaluate ``site``'s rules against ``key``. No rules armed
+        anywhere = one falsy check; no rules on this site = one dict
+        miss. May raise :class:`FailpointError`, sleep, or run a test
+        callback (callbacks run outside the lock and receive
+        ``key=...`` plus the caller's context kwargs)."""
+        if not self._rules:
+            return
+        fired: List[_Rule] = []
+        with self._lock:
+            rules = self._rules.get(site)
+            if not rules:
+                return
+            for r in rules:
+                if r._should_trigger(key):
+                    fired.append(r)
+        for r in fired:
+            if r.action == "sleep":
+                time.sleep(r.sleep_s)
+            elif r.action == "callback":
+                r.callback(key=key, **ctx)
+            else:
+                raise FailpointError(f"failpoint {site}: {r.message}")
+
+
+#: the process-wide registry
+FAILPOINTS = FailpointRegistry()
+
+_env_spec = os.environ.get("PRESTO_TPU_FAILPOINTS")
+if _env_spec:
+    FAILPOINTS.configure_from_spec(_env_spec)

@@ -63,6 +63,7 @@ from ..batch import Batch, bucket_capacity
 from ..connectors import spi
 from ..memory import QueryMemoryPool, batch_device_bytes
 from ..obs.metrics import REGISTRY
+from .failpoints import FAILPOINTS
 
 _HITS = REGISTRY.counter("scan_cache_hit_total")
 _MISSES = REGISTRY.counter("scan_cache_miss_total")
@@ -385,6 +386,12 @@ def scan_splits(conn, catalog: str, columns: Sequence[str],
         acc = [] if keys else None
         nb = 0
         for b in src.batches():
+            # failpoint: abort mid-decode (chaos tests prove a failed/
+            # aborted scan never reaches the put() below — a partial
+            # column set must not become a resident cache entry)
+            FAILPOINTS.hit("scan.decode",
+                           key=f"{catalog}.{split.table.table}.{i}",
+                           split=i, batch=nb)
             b = stage(b)
             nb += 1
             if acc is not None:
@@ -393,6 +400,9 @@ def scan_splits(conn, catalog: str, columns: Sequence[str],
         if record_split is not None:
             record_split(i, t0, nb)
         if acc is not None:
+            # only complete split streams insert: every early exit above
+            # (decode error, failpoint, abort/GeneratorExit from the
+            # consumer) skips this line by construction
             CACHE.put(keys[0], conn, acc)
 
     # serial warm fast path: splits already resident replay in order

@@ -43,6 +43,10 @@ class TaskHandle:
         self.device_seconds = 0.0
         self.quanta = 0
         self.closed = False
+        #: query-level abort (worker DELETE /v1/query): a task thread
+        #: blocked waiting for its device turn must notice the abort
+        #: promptly instead of running one more quantum for a dead query
+        self.aborted = threading.Event()
         #: input-stall seconds accrued DURING the current quantum (the
         #: scan prefetcher's consumer waits, exec/scancache.py): credited
         #: back when the quantum closes so device-time fairness bills
@@ -109,12 +113,20 @@ class DeviceScheduler:
         this task's turn; account its wall time as device time."""
         if handle is None:
             return fn()
+        if handle.aborted.is_set():
+            from ..errors import QueryCancelledError
+            raise QueryCancelledError("query aborted")
         t_wait = time.perf_counter()
         with self._cv:
             self._waiting.append(handle)
-            while not self._eligible(handle):
-                self._cv.wait(timeout=1.0)
-            self._waiting.remove(handle)
+            try:
+                while not self._eligible(handle):
+                    if handle.aborted.is_set():
+                        from ..errors import QueryCancelledError
+                        raise QueryCancelledError("query aborted")
+                    self._cv.wait(timeout=1.0)
+            finally:
+                self._waiting.remove(handle)
             self._running = handle
             self._running_thread = threading.get_ident()
             self._running_depth += 1

@@ -24,7 +24,8 @@ from typing import Dict, List, Optional
 RECORD_COLUMNS = (
     "query_id", "state", "user", "query", "error", "error_code",
     "create_time", "elapsed_ms", "cpu_ms", "device_sync_ms",
-    "planning_ms", "peak_memory_bytes", "rows", "mode", "plan_summary")
+    "planning_ms", "peak_memory_bytes", "rows", "mode", "plan_summary",
+    "retries")
 
 
 class QueryHistory:
@@ -89,6 +90,9 @@ def attach_history(events, history: Optional[QueryHistory] = None) -> None:
         rec.setdefault("elapsed_ms", round(ev.elapsed_ms, 3))
         rec.setdefault("create_time", ev.create_time)
         rec.setdefault("mode", "local")
+        # task retries this query survived (cluster fault tolerance,
+        # exec/cluster.py); local queries have no retry layer -> 0
+        rec.setdefault("retries", 0)
         h.add(rec)
 
     events.register(on_query_completed)

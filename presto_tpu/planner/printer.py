@@ -198,6 +198,41 @@ def format_scan_cache_summary(stats) -> str:
             f"prefetch stall {stall_s * 1e3:,.1f}ms")
 
 
+def format_retry_summary(info) -> str:
+    """Fault-tolerance section appended to cluster EXPLAIN ANALYZE:
+    task retries, speculative attempts, and the per-event detail the
+    recovery layer recorded (exec/cluster._QueryExecution.summary()).
+    Empty string when the query ran clean — the common case must not
+    grow the plan output."""
+    retries = int(info.get("retries") or 0)
+    q_retries = int(info.get("query_retries") or 0)
+    launched = int(info.get("speculative_launched") or 0)
+    won = int(info.get("speculative_won") or 0)
+    if not (retries or q_retries or launched or won):
+        return ""
+    head = (f"Fault tolerance [{info.get('policy', 'TASK')}]: "
+            f"{retries} task retr{'y' if retries == 1 else 'ies'}, "
+            f"{launched} speculative launched, {won} won"
+            + (f", {q_retries} query rerun"
+               f"{'' if q_retries == 1 else 's'}" if q_retries else ""))
+    lines = [head]
+    for ev in info.get("events") or ():
+        kind = ev.get("kind", "")
+        if kind == "task_retry":
+            lines.append(
+                f"  retry {ev.get('task')} (attempt "
+                f"{ev.get('attempt')}) {ev.get('from')} -> "
+                f"{ev.get('to')}: {str(ev.get('reason', ''))[:120]}")
+        elif kind == "speculative_launched":
+            lines.append(f"  speculate {ev.get('task')} on "
+                         f"{ev.get('worker')} (straggler "
+                         f"{ev.get('straggler')})")
+        elif kind == "speculative_won":
+            lines.append(f"  speculative win {ev.get('task')} on "
+                         f"{ev.get('worker')}")
+    return "\n".join(lines)
+
+
 def _label(n: PlanNode) -> str:
     cols = ", ".join(f"{f.name}:{f.type.display()}" for f in n.fields)
     if isinstance(n, TableScanNode):

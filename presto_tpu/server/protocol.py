@@ -29,6 +29,7 @@ import math
 import queue
 import secrets
 import threading
+import time
 import urllib.parse
 from decimal import Decimal
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -281,11 +282,19 @@ class _Handler(BaseHTTPRequestHandler):
             n = int(self.headers.get("Content-Length", 0))
             doc = json.loads(self.rfile.read(n) or b"{}")
             self._srv.discovery.announce(doc.get("nodeId", ""),
-                                         doc.get("uri", ""))
+                                         doc.get("uri", ""),
+                                         doc.get("state", "ACTIVE"))
             self._reply(202, {"announced": True})
             return
         if self.path != "/v1/statement":
             self._reply(404, {"error": "not found"})
+            return
+        if self._srv.shutting_down:
+            # drain window (reference server/GracefulShutdownHandler on
+            # the coordinator): running statements page out normally,
+            # new ones are refused so a rolling restart never strands a
+            # client mid-queue
+            self._reply(503, {"error": "coordinator is shutting down"})
             return
         if not self._authenticate():
             return
@@ -313,6 +322,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         if self.path == "/v1/service":
             self._reply(200, {"services": self._srv.discovery.nodes()})
+            return
+        if self.path.split("?")[0].rstrip("/") == "/v1/info":
+            # lifecycle surface, symmetric with the worker's: load
+            # balancers / rolling-restart tooling watch the state flip
+            # to SHUTTING_DOWN and drain traffic away
+            self._reply(200, {
+                "nodeId": "coordinator",
+                "state": ("SHUTTING_DOWN" if self._srv.shutting_down
+                          else "ACTIVE"),
+                "queries": {
+                    "RUNNING": sum(
+                        1 for q in list(self._srv.queries.values())
+                        if q.state in ("QUEUED", "RUNNING"))},
+            })
             return
         if self.path.split("?")[0].rstrip("/") == "/v1/metrics":
             # Prometheus scrape surface (unauthenticated, like
@@ -387,6 +410,23 @@ class _Handler(BaseHTTPRequestHandler):
             headers["X-Presto-Clear-Session"] = k
         self._reply(200, self._results_doc(q, token, page=page),
                     headers)
+
+    def do_PUT(self) -> None:
+        # lifecycle changes need the same credentials as statements: an
+        # unauthenticated peer must not be able to drain the server
+        if not self._authenticate():
+            return
+        parts = self.path.strip("/").split("/")
+        if parts == ["v1", "info", "state"]:
+            n = int(self.headers.get("Content-Length", 0))
+            state = json.loads(self.rfile.read(n) or b'""')
+            if state == "SHUTTING_DOWN":
+                self._srv.begin_shutdown()
+                self._reply(200, {"state": "SHUTTING_DOWN"})
+            else:
+                self._reply(400, {"error": f"bad state {state!r}"})
+            return
+        self._reply(404, {"error": "not found"})
 
     def do_DELETE(self) -> None:
         if not self._authenticate():
@@ -481,6 +521,7 @@ class PrestoTpuServer:
             runner = LocalRunner()
         self.runner = runner
         self.queries: Dict[str, _Query] = {}
+        self.shutting_down = False
         self._seq = 0
         self._lock = threading.Lock()
         # admission: the default config keeps one query running at a
@@ -517,6 +558,34 @@ class PrestoTpuServer:
 
     def start(self) -> None:
         self._thread.start()
+
+    def begin_shutdown(self) -> None:
+        """Drain: refuse new statements (503), let running queries page
+        out, then stop the server (the coordinator half of the worker's
+        GracefulShutdownHandler-style drain)."""
+        self.shutting_down = True
+
+        def drain():
+            # terminal state is set when the last page is ENQUEUED, not
+            # when the client fetched it: wait for page queues to empty
+            # too, under a grace window so an abandoned client cannot
+            # pin the drain forever
+            grace_until = None
+            while True:
+                qs = list(self.queries.values())
+                if any(q.state in ("QUEUED", "RUNNING") for q in qs):
+                    grace_until = None
+                elif not any(not q._pages.empty() for q in qs):
+                    break
+                else:
+                    now = time.monotonic()
+                    if grace_until is None:
+                        grace_until = now + 30.0
+                    elif now > grace_until:
+                        break
+                time.sleep(0.2)
+            self.stop()
+        threading.Thread(target=drain, daemon=True).start()
 
     def stop(self) -> None:
         self.httpd.shutdown()

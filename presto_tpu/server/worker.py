@@ -26,16 +26,20 @@ REST surface (mirrors reference TaskResource):
 from __future__ import annotations
 
 import json
+import queue as _queue
 import struct
 import threading
 import time
+import urllib.error
 import urllib.request
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..batch import Batch
 from ..connectors.spi import CatalogManager, Split
 from ..exec import local as local_exec
+from ..exec.failpoints import FAILPOINTS, FailpointError
 from ..obs.log import LOG
 from ..obs.metrics import REGISTRY, TASKS
 from ..obs.trace import TRACER
@@ -50,6 +54,7 @@ PAGES_CONTENT_TYPE = "application/x-presto-tpu-pages"
 _EXCHANGE_SENT_BYTES = REGISTRY.counter("exchange_sent_bytes_total")
 _EXCHANGE_SENT_PAGES = REGISTRY.counter("exchange_sent_pages_total")
 _EXCHANGE_RECV_BYTES = REGISTRY.counter("exchange_received_bytes_total")
+_EXCHANGE_WAIT = REGISTRY.histogram("exchange_wait_seconds")
 
 _query_handles: Dict[str, list] = {}
 _query_handles_lock = threading.Lock()
@@ -91,10 +96,20 @@ def unframe_pages(body: bytes) -> List[bytes]:
 
 
 class OutputBuffer:
-    """Per-task partitioned output with token/ack reread semantics."""
+    """Per-task partitioned output with token/ack reread semantics.
 
-    def __init__(self, n_buffers: int):
+    With ``retain=True`` (set by the coordinator when
+    ``retry_policy=TASK``) acked pages are NOT dropped: a consumer task
+    that is restarted by the retry/speculation layer re-reads this
+    attempt's complete output from token 0 — the in-memory stand-in for
+    the reference's spooled-exchange storage that makes task-level
+    retry possible at all. Buffers are attempt-versioned by
+    construction: every attempt is its own task id with its own buffer,
+    so a consumer can never interleave pages from two attempts."""
+
+    def __init__(self, n_buffers: int, retain: bool = False):
         self.n = n_buffers
+        self.retain = retain
         self.pages: List[List[Tuple[int, bytes]]] = \
             [[] for _ in range(n_buffers)]
         self.next_token = [0] * n_buffers
@@ -126,8 +141,11 @@ class OutputBuffer:
             self.cond.notify_all()
 
     def fail(self, message: str) -> None:
+        # first failure wins: an abort racing (or following) a real
+        # error must not overwrite the diagnostic a late poller needs
         with self.cond:
-            self.failed = message
+            if self.failed is None:
+                self.failed = message
             self.cond.notify_all()
 
     def get(self, buffer_id: int, token: int, max_wait_s: float,
@@ -136,9 +154,10 @@ class OutputBuffer:
         Returns (pages, next_token, complete)."""
         deadline = time.monotonic() + max_wait_s
         with self.cond:
-            # ack: drop everything the client has by token
-            q = self.pages[buffer_id]
-            self.pages[buffer_id] = [e for e in q if e[0] >= token]
+            if not self.retain:
+                # ack: drop everything the client has by token
+                q = self.pages[buffer_id]
+                self.pages[buffer_id] = [e for e in q if e[0] >= token]
             while True:
                 if self.failed is not None:
                     raise RuntimeError(self.failed)
@@ -161,18 +180,50 @@ class OutputBuffer:
                 self.cond.wait(remaining)
 
 
+class ExchangeFailedError(RuntimeError):
+    """A pull exchange lost its upstream. Distinguishable from a plain
+    timeout, and the message embeds the upstream TASK id — the
+    coordinator's retry layer parses it out of the failed consumer's
+    status doc to know *which* upstream attempt to replace."""
+
+    def __init__(self, message: str, task_id: Optional[str] = None,
+                 url: Optional[str] = None):
+        super().__init__(message)
+        self.task_id = task_id
+        self.url = url
+
+
 class ExchangeClient:
     """Pulls pages from every task of an upstream fragment (reference
     operator/ExchangeClient.java:55 + HttpPageBufferClient.java:88):
-    one prefetch thread per upstream location, merged into one queue."""
+    one prefetch thread per upstream location, merged into one queue.
+
+    Failure semantics (the retry layer's feed): an HTTP error from the
+    upstream (its buffer failed, or the task is gone) fails the pull
+    IMMEDIATELY; transport errors (dead worker process) fail after
+    ``fail_fast_s`` of consecutive failures rather than the old
+    generic 300 s deadline — both as :class:`ExchangeFailedError`
+    naming the upstream task."""
+
+    #: consecutive-transport-failure budget before an upstream is
+    #: declared lost (session property ``exchange_failure_timeout_s``)
+    TRANSPORT_FAILURE_TIMEOUT_S = 45.0
 
     def __init__(self, locations: List[str], buffer_id: int,
-                 timeout_s: float = 300.0):
-        import queue as _q
+                 timeout_s: float = 300.0,
+                 fail_fast_s: Optional[float] = None,
+                 cancel_event: Optional[threading.Event] = None):
         self.locations = locations
         self.buffer_id = buffer_id
         self.timeout_s = timeout_s
-        self.queue: "_q.Queue" = _q.Queue(maxsize=64)
+        self.fail_fast_s = (self.TRANSPORT_FAILURE_TIMEOUT_S
+                            if fail_fast_s is None else float(fail_fast_s))
+        #: abort propagation: a DELETEd task must stop waiting on its
+        #: upstreams NOW — an exchange wait runs inside a device-
+        #: scheduler quantum, and a cancelled task parked there would
+        #: hold the device hostage for the whole transport window
+        self.cancel_event = cancel_event
+        self.queue: "_queue.Queue" = _queue.Queue(maxsize=64)
         self.stop = threading.Event()
         self._threads = [
             threading.Thread(target=self._pull, args=(u,), daemon=True)
@@ -181,9 +232,19 @@ class ExchangeClient:
 
     def _pull(self, url: str) -> None:
         token = 0
+        task_id = url.rsplit("/v1/task/", 1)[-1]
         deadline = time.monotonic() + self.timeout_s
+        first_err: Optional[float] = None
         try:
             while not self.stop.is_set():
+                try:
+                    FAILPOINTS.hit("exchange.pull", key=url,
+                                   task_id=task_id)
+                except FailpointError as e:
+                    raise ExchangeFailedError(
+                        f"exchange pull from upstream task {task_id} "
+                        f"failed: {e}", task_id=task_id, url=url) \
+                        from None
                 req = urllib.request.Request(
                     f"{url}/results/{self.buffer_id}/{token}?max_wait=2")
                 try:
@@ -193,20 +254,69 @@ class ExchangeClient:
                             "X-Buffer-Complete") == "true"
                         token = int(resp.headers.get("X-Next-Token",
                                                      token))
-                except Exception as e:  # retry until deadline
-                    if time.monotonic() > deadline:
-                        self.queue.put(e)
-                        return
+                except urllib.error.HTTPError as e:
+                    # the upstream answered: its task failed, was
+                    # aborted, or is unknown — not transient, surface
+                    # the real cause now (satellite of the retry layer:
+                    # a generic deadline here left the coordinator
+                    # unable to tell WHICH attempt died)
+                    try:
+                        detail = json.loads(
+                            e.read() or b"{}").get("error") or ""
+                    except Exception:
+                        detail = ""
+                    raise ExchangeFailedError(
+                        f"upstream task {task_id} failed: HTTP "
+                        f"{e.code}: {detail or e.reason}",
+                        task_id=task_id, url=url) from None
+                except Exception as e:  # transport: bounded retry
+                    now = time.monotonic()
+                    if first_err is None:
+                        first_err = now
+                    if now - first_err >= self.fail_fast_s \
+                            or now > deadline:
+                        raise ExchangeFailedError(
+                            f"upstream task {task_id} unreachable "
+                            f"for {now - first_err:.1f}s: {e}",
+                            task_id=task_id, url=url) from None
                     time.sleep(0.2)
                     continue
+                first_err = None
                 deadline = time.monotonic() + self.timeout_s
                 for page in unframe_pages(body):
                     _EXCHANGE_RECV_BYTES.inc(len(page))
                     self.queue.put(page)
                 if complete:
                     break
+        except BaseException as e:   # surfaced on the consumer side
+            self.queue.put(e)
         finally:
             self.queue.put(None)   # this upstream is drained
+
+    def _next(self):
+        """Next queue item; waits cancellably and records the wait as
+        an input stall (credited back to the device scheduler — time
+        blocked on the network is not device time)."""
+        try:
+            return self.queue.get_nowait()
+        except _queue.Empty:
+            pass
+        from ..exec import taskexec
+        t0 = time.monotonic()
+        try:
+            while True:
+                if self.cancel_event is not None \
+                        and self.cancel_event.is_set():
+                    from ..errors import QueryCancelledError
+                    raise QueryCancelledError("task aborted")
+                try:
+                    return self.queue.get(timeout=0.25)
+                except _queue.Empty:
+                    continue
+        finally:
+            dt = time.monotonic() - t0
+            _EXCHANGE_WAIT.observe(dt)
+            taskexec.GLOBAL.note_stall(dt)
 
     def batches(self) -> Iterator[Batch]:
         for t in self._threads:
@@ -214,7 +324,7 @@ class ExchangeClient:
         done = 0
         try:
             while done < len(self._threads):
-                item = self.queue.get()
+                item = self._next()
                 if item is None:
                     done += 1
                     continue
@@ -255,7 +365,13 @@ class _TaskExecutor(local_exec._Executor):
         locations: List[str] = []
         for fid in node.fragment_ids:
             locations.extend(self.sources.get(fid, ()))
-        client = ExchangeClient(locations, self.partition)
+        fail_fast = float(self.session.properties.get(
+            "exchange_failure_timeout_s",
+            ExchangeClient.TRANSPORT_FAILURE_TIMEOUT_S))
+        client = ExchangeClient(locations, self.partition,
+                                fail_fast_s=fail_fast,
+                                cancel_event=getattr(
+                                    self, "cancel_event", None))
         schema = local_exec._plan_schema(node)
         for b in client.batches():
             # positional contract: upstream emits the same field layout
@@ -285,7 +401,13 @@ class Task:
         self.root = codec.decode(doc["fragment"])
         self.output_kind = doc["output"]["kind"]
         self.output_keys = list(doc["output"].get("keys", ()))
-        self.buffer = OutputBuffer(int(doc["output"]["n_buffers"]))
+        self.buffer = OutputBuffer(
+            int(doc["output"]["n_buffers"]),
+            retain=bool(doc["output"].get("retain", False)))
+        #: set by DELETE-abort; checked between quanta (and, via the
+        #: executor's cancel_event, inside scans) so an aborted task
+        #: stops burning device time instead of running to completion
+        self._abort = threading.Event()
         self.splits = [codec.decode(s) for s in doc.get("splits", [])]
         self.sources = {int(k): list(v)
                         for k, v in doc.get("sources", {}).items()}
@@ -346,10 +468,17 @@ class Task:
                                   stage_id=fid,
                                   partition=self.partition,
                                   node_id=self.node_id):
+                FAILPOINTS.hit("worker.task_run",
+                               key=f"{self.task_id}@{self.node_id}",
+                               task_id=self.task_id,
+                               node_id=self.node_id)
                 ex = _TaskExecutor(self.session, self.rows_per_batch,
                                    self.splits, self.sources,
                                    self.partition)
                 self.pool = ex.pool  # visible to /v1/info memory report
+                # abort propagation: the executor checks this event per
+                # scan batch, so a DELETE interrupts a task mid-scan
+                ex.cancel_event = self._abort
                 ex.init_values = self.init_values
                 ex.mark_shared([self.root])
                 # fair device scheduling across concurrent tasks: one
@@ -358,6 +487,9 @@ class Task:
                 it = ex.run(self.root)
                 sentinel = object()
                 while True:
+                    if self._abort.is_set():
+                        from ..errors import QueryCancelledError
+                        raise QueryCancelledError("task aborted")
                     batch = handle.scheduler.run_quantum(
                         handle, lambda: next(it, sentinel))
                     if batch is sentinel:
@@ -385,17 +517,26 @@ class Task:
             self.buffer.finish()
             self._set_state("FINISHED")
         except Exception as e:   # noqa: BLE001 - reported to coordinator
-            self.error = f"{type(e).__name__}: {e}"
-            self._set_state("FAILED")
-            self.buffer.fail(self.error)
-            LOG.log("task_failed", query_id=qid, task_id=self.task_id,
-                    node_id=self.node_id, error=self.error)
+            if self._abort.is_set():
+                # a DELETE-abort interrupted the run loop: ABORTED (set
+                # by abort()) is the verdict, not FAILED, and the
+                # buffer already carries "task aborted"
+                self.buffer.fail("task aborted")
+            else:
+                self.error = f"{type(e).__name__}: {e}"
+                self._set_state("FAILED")
+                self.buffer.fail(self.error)
+                LOG.log("task_failed", query_id=qid,
+                        task_id=self.task_id, node_id=self.node_id,
+                        error=self.error)
         finally:
             _release_query_handle(qid)
 
     def abort(self) -> None:
         if self.state in ("PLANNED", "RUNNING"):
+            self._abort.set()
             self._set_state("ABORTED")
+            self.error = self.error or "task aborted"
             self.buffer.fail("task aborted")
 
     def status(self, include_spans: bool = False) -> dict:
@@ -451,6 +592,10 @@ class _Handler(BaseHTTPRequestHandler):
         if parts[:2] == ["v1", "task"] and len(parts) == 3:
             task = self.worker.tasks.get(parts[2])
             if task is None:
+                tomb = self.worker.done.get(parts[2])
+                if tomb is not None:
+                    self._json(200, dict(tomb))
+                    return
                 self._json(404, {"error": "no such task"})
                 return
             self._json(200, task.status(
@@ -460,7 +605,25 @@ class _Handler(BaseHTTPRequestHandler):
                 and parts[3] == "results"):
             task = self.worker.tasks.get(parts[2])
             if task is None:
-                self._json(404, {"error": "no such task"})
+                # terminal-state tombstone: a late poller (an exchange
+                # client that out-lived the task) gets the REAL verdict
+                # — a clean complete page for FINISHED, the persisted
+                # failure for FAILED/ABORTED — never a bare 404 it
+                # would misread as a transient drop
+                tomb = self.worker.done.get(parts[2])
+                if tomb is None:
+                    self._json(404, {"error": "no such task"})
+                    return
+                if tomb.get("state") == "FINISHED":
+                    self.send_response(200)
+                    self.send_header("Content-Type", PAGES_CONTENT_TYPE)
+                    self.send_header("Content-Length", "0")
+                    self.send_header("X-Next-Token", parts[5])
+                    self.send_header("X-Buffer-Complete", "true")
+                    self.end_headers()
+                    return
+                self._json(500, {"error": tomb.get("error")
+                                 or f"task {tomb.get('state')}"})
                 return
             buf, token = int(parts[4]), int(parts[5])
             wait = 2.0
@@ -515,6 +678,7 @@ class _Handler(BaseHTTPRequestHandler):
             task = self.worker.tasks.pop(parts[2], None)
             if task is not None:
                 task.abort()
+                self.worker.retire(task)
             self._json(200, {"aborted": task is not None})
             return
         if parts[:2] == ["v1", "query"] and len(parts) == 3:
@@ -540,6 +704,9 @@ class WorkerServer:
             catalogs.register("system", SystemConnector(catalogs))
         self.catalogs = catalogs
         self.tasks: Dict[str, Task] = {}
+        #: terminal-state tombstones of deleted tasks (bounded), so late
+        #: status/results polls see the real verdict instead of a 404
+        self.done: "OrderedDict[str, dict]" = OrderedDict()
         self.started_at = time.time()
         self.shutting_down = False
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -577,10 +744,23 @@ class WorkerServer:
         existing = self.tasks.get(task_id)
         if existing is not None:
             return existing
+        self.done.pop(task_id, None)
         task = Task(task_id, doc, self.catalogs, node_id=self.node_id)
         self.tasks[task_id] = task
         task.start()
         return task
+
+    def retire(self, task: Task) -> None:
+        """Record a deleted task's terminal state (bounded tombstone
+        map — the persistence half of OutputBuffer failure state)."""
+        self.done[task.task_id] = {
+            "taskId": task.task_id, "state": task.state,
+            "error": task.error,
+            "elapsedMs": round(task._elapsed_now(), 1),
+            "rowsOut": task.rows_out, "bytesOut": task.bytes_out,
+        }
+        while len(self.done) > 512:
+            self.done.popitem(last=False)
 
     def info(self) -> dict:
         # per-query reserved bytes ride the heartbeat payload — the feed
@@ -608,17 +788,34 @@ class WorkerServer:
         }
 
     def abort_query(self, query_id: str) -> int:
+        """Query-level abort: every task of the query is aborted AND
+        freed from the task map (tombstoned), so a cancelled query
+        releases its buffers instead of squatting until eviction."""
         n = 0
         for t in list(self.tasks.values()):
-            if t.task_id.split(".")[0] == query_id \
-                    and t.state in ("PLANNED", "RUNNING"):
+            if t.task_id.split(".")[0] != query_id:
+                continue
+            if t.state in ("PLANNED", "RUNNING"):
                 t.abort()
                 n += 1
+            self.tasks.pop(t.task_id, None)
+            self.retire(t)
+        # wake any task thread of this query blocked in the device
+        # scheduler's wait queue (exec/taskexec.py): the shared
+        # per-query handle carries the abort
+        with _query_handles_lock:
+            ent = _query_handles.get(query_id)
+            if ent is not None:
+                ent[0].aborted.set()
         return n
 
     def begin_shutdown(self) -> None:
         """Drain: refuse new tasks, wait for active ones, then stop."""
         self.shutting_down = True
+        if self._announcer is not None:
+            # push the drain state to discovery immediately — the
+            # scheduler must stop assigning before the next heartbeat
+            self._announcer.set_state("SHUTTING_DOWN")
 
         def drain():
             while any(t.state in ("PLANNED", "RUNNING")
@@ -652,6 +849,8 @@ def main() -> None:
         node_id = node_id or cfg.node_id
         port = port or cfg.http_port
         discovery_uri = discovery_uri or cfg.discovery_uri
+        if cfg.failpoints:
+            FAILPOINTS.configure_from_spec(cfg.failpoints)
     w = WorkerServer(catalogs=catalogs, host=args.host, port=port,
                      node_id=node_id, tpch_sf=args.tpch_sf)
     print(json.dumps({"nodeId": w.node_id, "port": w.port}), flush=True)

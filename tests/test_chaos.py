@@ -1,0 +1,27 @@
+"""Tier-1 chaos smoke: every cluster recovery path under seeded
+failpoints, with row-exact parity against the fault-free run.
+
+Thin pytest wrapper over tools/chaos_smoke.py (also runnable directly
+from the CLI) — a 3-worker in-process cluster survives one injected
+task failure, one exchange drop, one 15s straggler (speculative win),
+and one worker death; ``retry_policy=NONE`` still fails fast. Recovery
+is asserted observable through ``system.runtime.metrics`` and the
+query-history ``retries`` column inside the tool itself."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+
+def test_chaos_smoke():
+    import chaos_smoke
+    summary = chaos_smoke.run_chaos(sf=0.01)
+    assert summary["ok"] is True
+    scenarios = summary["scenarios"]
+    assert scenarios["task_failure"]["task_retries"] >= 1
+    assert scenarios["exchange_drop"]["task_retries"] >= 1
+    assert scenarios["straggler"]["speculative_won"] >= 1
+    assert scenarios["worker_death"]["task_retries"] >= 1
+    assert "retry_none" in scenarios

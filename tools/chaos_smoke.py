@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Chaos smoke: a 3-worker in-process cluster under seeded failpoints.
+
+Drives every recovery path of the fault-tolerance layer
+(presto_tpu/exec/cluster.py + exec/failpoints.py) without a real
+multi-host TPU cluster, and asserts ROW-EXACT parity with the
+fault-free run after each injected fault:
+
+- ``task_failure``   — one task FAILs at start (``worker.task_run``
+  error); the coordinator re-creates it on a healthy worker.
+- ``exchange_drop``  — one exchange pull dies mid-stream
+  (``exchange.pull`` error); the ExchangeFailedError names the upstream
+  attempt and the retry layer replaces exactly that producer.
+- ``straggler``      — one source task sleeps 15s (``worker.task_run``
+  sleep); the StageMonitor flags it, a speculative duplicate launches
+  on another node and wins, the loser is aborted.
+- ``retry_none``     — same task fault under ``retry_policy=NONE``
+  fails fast (the pre-fault-tolerance behavior, still available).
+- ``worker_death``   — a failpoint callback kills one worker's HTTP
+  server mid-query; its tasks (same deterministic splits) reschedule
+  onto the survivors.
+
+Recovery is asserted observable: ``task_retry_total`` and
+``speculative_won_total`` move, via ``system.runtime.metrics`` over
+plain SQL.
+
+Run directly (prints a JSON summary) or from the tier-1 suite
+(tests/test_chaos.py):
+
+    JAX_PLATFORMS=cpu python tools/chaos_smoke.py [--sf 0.01]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+QUERY = ("select l_returnflag, l_linestatus, count(*) c, "
+         "sum(l_quantity) q, sum(l_extendedprice) e from lineitem "
+         "where l_shipdate <= date '1998-09-02' "
+         "group by 1, 2 order by 1, 2")
+
+
+def _metric_sql(runner, name: str) -> float:
+    res = runner.local.execute(
+        "select value from system.runtime.metrics "
+        f"where name = '{name}'")
+    return float(res.rows[0][0]) if res.rows else 0.0
+
+
+def _assert_rows_equal(got, want, scenario: str) -> None:
+    assert len(got) == len(want), \
+        f"{scenario}: {len(got)} rows vs {len(want)}"
+    for gr, wr in zip(got, want):
+        for gv, wv in zip(gr, wr):
+            if isinstance(wv, float):
+                # partial-agg pages merge in arrival order; float sums
+                # are reproducible only to rounding, like test_cluster
+                assert abs(gv - wv) <= max(abs(wv), 1.0) * 1e-6, \
+                    (scenario, gr, wr)
+            else:
+                assert gv == wv, (scenario, gr, wr)
+
+
+def run_chaos(sf: float = 0.01, query: str = QUERY,
+              verbose: bool = False) -> dict:
+    from presto_tpu.exec.cluster import ClusterRunner, QueryFailedError
+    from presto_tpu.exec.failpoints import FAILPOINTS
+    from presto_tpu.server.worker import WorkerServer
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(msg, file=sys.stderr, flush=True)
+
+    workers = [WorkerServer(tpch_sf=sf) for _ in range(3)]
+    for w in workers:
+        w.start()
+    urls = [f"http://127.0.0.1:{w.port}" for w in workers]
+    runner = ClusterRunner(urls, tpch_sf=sf, heartbeat=False)
+    summary: dict = {"sf": sf, "scenarios": {}}
+    FAILPOINTS.clear()
+    try:
+        # fault-free reference (first run also warms the jit caches so
+        # fault-run timings measure recovery, not compilation)
+        t0 = time.perf_counter()
+        want = runner.execute(query).rows
+        runner.execute(query)
+        summary["baseline_s"] = round(time.perf_counter() - t0, 3)
+        log(f"baseline: {len(want)} rows in {summary['baseline_s']}s")
+
+        def scenario(name: str):
+            t = time.perf_counter()
+
+            def finish(**extra):
+                FAILPOINTS.clear()
+                summary["scenarios"][name] = {
+                    "elapsed_s": round(time.perf_counter() - t, 3),
+                    **extra}
+                log(f"{name}: ok {summary['scenarios'][name]}")
+            return finish
+
+        # -- (a) one task failure -> task-level retry ---------------------
+        finish = scenario("task_failure")
+        before = _metric_sql(runner, "task_retry_total")
+        FAILPOINTS.configure("worker.task_run", action="error",
+                             message="chaos: task failure", times=1)
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "task_failure")
+        retries = _metric_sql(runner, "task_retry_total") - before
+        assert retries >= 1, "task failure did not trigger a retry"
+        finish(task_retries=retries)
+
+        # -- (b) exchange drop mid-stream -> upstream replaced ------------
+        finish = scenario("exchange_drop")
+        before = _metric_sql(runner, "task_retry_total")
+        FAILPOINTS.configure("exchange.pull", action="error",
+                             message="chaos: exchange drop", times=1)
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "exchange_drop")
+        retries = _metric_sql(runner, "task_retry_total") - before
+        assert retries >= 1, "exchange drop did not trigger a retry"
+        finish(task_retries=retries)
+
+        # -- (c) 10x straggler -> speculative attempt wins ----------------
+        finish = scenario("straggler")
+        before = _metric_sql(runner, "speculative_won_total")
+        # partition 0 of the source stage sleeps far past the stage
+        # median; attempt suffixes keep the duplicate out of the rule
+        FAILPOINTS.configure("worker.task_run", action="sleep",
+                             sleep_s=15.0, match=r"\.0\.0@", times=1)
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "straggler")
+        won = _metric_sql(runner, "speculative_won_total") - before
+        assert won >= 1, "straggler did not produce a speculative win"
+        finish(speculative_won=won)
+
+        # -- (d) retry_policy=NONE fails fast -----------------------------
+        finish = scenario("retry_none")
+        FAILPOINTS.configure("worker.task_run", action="error",
+                             message="chaos: fail fast", times=1)
+        runner.session.properties["retry_policy"] = "NONE"
+        try:
+            failed = False
+            try:
+                runner.execute(query)
+            except QueryFailedError as e:
+                failed = True
+                assert "chaos: fail fast" in str(e), str(e)
+            assert failed, "retry_policy=NONE still recovered"
+        finally:
+            del runner.session.properties["retry_policy"]
+        finish()
+
+        # -- (e) worker death mid-query -> reschedule on survivors --------
+        # (last: the victim stays dead for the rest of the run)
+        finish = scenario("worker_death")
+        before = _metric_sql(runner, "task_retry_total")
+        victim = workers[-1]
+
+        def kill(key="", **ctx):
+            victim.httpd.shutdown()
+            victim.httpd.server_close()
+            # a real worker death takes its task threads with it; the
+            # in-process stand-in kills the network surface above and
+            # the compute below, so zombies don't hold the shared
+            # device scheduler
+            for t in list(victim.tasks.values()):
+                t.abort()
+
+        FAILPOINTS.configure("worker.task_run", action="callback",
+                             callback=kill, times=1,
+                             match=f"@{victim.node_id}$")
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "worker_death")
+        retries = _metric_sql(runner, "task_retry_total") - before
+        assert retries >= 1, "worker death did not trigger a retry"
+        # the dead node must be out of the schedulable set now
+        assert f"http://127.0.0.1:{victim.port}" \
+            not in runner._schedulable_workers()
+        finish(task_retries=retries)
+
+        # the retry count is part of the query history record
+        res = runner.local.execute(
+            "select retries from system.runtime.completed_queries "
+            "where mode = 'cluster' order by create_time")
+        assert res.rows and any(int(r[0]) >= 1 for r in res.rows), \
+            "no completed_queries record carries a retry count"
+        summary["ok"] = True
+        return summary
+    finally:
+        FAILPOINTS.clear()
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sf", type=float, default=0.01,
+                    help="TPC-H scale factor (default 0.01)")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    summary = run_chaos(sf=args.sf, verbose=not args.quiet)
+    print(json.dumps(summary, indent=2))
+    return 0 if summary.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
