@@ -907,12 +907,29 @@ def main() -> None:
         config (not just at the end) so that if the driver kills this
         process mid-run, the last stdout line is still a complete summary
         of every config that finished — round 4 lost ALL its numbers by
-        printing only at exit (BENCH_r04: rc=124, parsed=null)."""
+        printing only at exit (BENCH_r04: rc=124, parsed=null).
+        BENCH_OUT=path additionally overwrites that file with the same
+        summary — the input tools/check_bench_regression.py diffs
+        against the latest committed BENCH_r*.json."""
         headline = dict(next((r for r in results if "_q1_" in r["metric"]),
                              results[0]))
         headline["sub_metrics"] = [r for r in results
                                    if r["metric"] != headline["metric"]]
-        print(json.dumps(headline), flush=True)
+        line = json.dumps(headline)
+        print(line, flush=True)
+        out_path = os.environ.get("BENCH_OUT")
+        if out_path:
+            try:
+                # write-then-rename: a driver SIGKILL mid-write must not
+                # leave a truncated summary (the whole point of emitting
+                # per config is surviving exactly that kill)
+                tmp = out_path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(line + "\n")
+                os.replace(tmp, out_path)
+            except OSError as e:
+                print(f"[bench] BENCH_OUT write failed: {e}",
+                      file=sys.stderr)
 
     results = []
     global _PROXY_RUNS

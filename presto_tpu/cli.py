@@ -120,12 +120,27 @@ def main(argv=None) -> int:
                          "exit; in-process spans only — point a remote "
                          "worker at the same trace with "
                          "PRESTO_TPU_TRACE=1")
+    ap.add_argument("--profile-out", default=None, metavar="DIR",
+                    help="deep-profile mode: enable span tracing AND "
+                         "the `profile` session property (device-time "
+                         "attribution), capture a jax.profiler trace "
+                         "of the executed statements, and write "
+                         "DIR/merged_trace.json with host spans and "
+                         "XLA device tracks on one Perfetto timeline; "
+                         "embedded server only — with --server the "
+                         "device runs in the server process")
     ap.add_argument("--history-out", default=None, metavar="PATH",
                     help="append one JSON line per completed query "
                          "(the system.runtime.completed_queries "
                          "record) to this file; embedded server only — "
                          "with --server, configure HISTORY in the "
                          "server process")
+    ap.add_argument("--history-max-bytes", type=int, default=None,
+                    metavar="N",
+                    help="rotate the --history-out file past N bytes "
+                         "(one .1 generation kept; default 64 MiB, "
+                         "0 = unbounded). Dropped records count in "
+                         "history_records_dropped_total")
     ap.add_argument("--slow-query-log", type=float, default=None,
                     metavar="SECONDS",
                     help="emit the full history record of queries "
@@ -135,17 +150,28 @@ def main(argv=None) -> int:
                          "server only, like --history-out")
     args = ap.parse_args(argv)
 
-    if args.trace_out:
+    if args.trace_out or args.profile_out:
         from .obs.trace import TRACER
         TRACER.enable(True)
     if args.history_out or args.slow_query_log is not None:
         from .obs.history import HISTORY
         HISTORY.configure(sink_path=args.history_out,
-                          slow_threshold_s=args.slow_query_log)
+                          slow_threshold_s=args.slow_query_log,
+                          max_sink_bytes=args.history_max_bytes)
         if args.slow_query_log is not None:
             from .obs.log import LOG
             if not LOG.enabled:
                 LOG.configure(stream=sys.stderr)
+    profiling = False
+    if args.profile_out:
+        import os
+        os.makedirs(args.profile_out, exist_ok=True)
+        try:
+            import jax
+            jax.profiler.start_trace(args.profile_out)
+            profiling = True
+        except Exception as e:   # profile capture must not block queries
+            print(f"device profiler unavailable: {e}", file=sys.stderr)
 
     embedded = None
     url = args.server
@@ -160,6 +186,10 @@ def main(argv=None) -> int:
     client = StatementClient(url, user=args.user, catalog=args.catalog,
                              schema=args.schema, password=args.password)
     try:
+        if args.profile_out:
+            # device-time attribution for everything this session runs
+            # (ops/jitcache bracketing + per-operator charges)
+            client.execute("SET SESSION profile = true")
         if args.execute is not None:
             for stmt in args.execute.split(";"):
                 if stmt.strip():
@@ -183,6 +213,26 @@ def main(argv=None) -> int:
                                   output_format=args.output_format)
         return 0
     finally:
+        if profiling:
+            import os
+
+            import jax
+
+            from .obs.profiler import write_merged_trace
+            from .obs.trace import TRACER
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            merged = os.path.join(args.profile_out, "merged_trace.json")
+            try:
+                write_merged_trace(merged, TRACER.export(),
+                                   args.profile_out)
+                print(f"wrote merged host+device trace to {merged} "
+                      "(open in ui.perfetto.dev)", file=sys.stderr)
+            except Exception as e:   # must not mask the query outcome
+                print(f"merged-trace write failed: {e}",
+                      file=sys.stderr)
         if args.trace_out:
             from .obs.trace import TRACER, write_chrome_trace
             write_chrome_trace(args.trace_out, TRACER.export())

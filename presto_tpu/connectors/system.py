@@ -34,7 +34,11 @@ namespace like the rest of the engine's two-level names):
 - ``completed_queries`` — the persistent query history
                  (obs/history.py), local and cluster queries
 - ``operator_stats``    — per-operator (local) / per-task (cluster)
-                 rows/batches/wall from the same history records
+                 rows/batches/wall from the same history records, plus
+                 profiled device_time_s/flops/hbm_bytes
+- ``executables``       — per compiled jit entry: compile seconds,
+                 invocations, device time, XLA cost/memory analysis
+                 (obs/profiler.py)
 
 These double as the ``system.runtime.*`` names: the engine flattens
 schemas, so ``system.runtime.queries`` and ``system.default.queries``
@@ -71,7 +75,9 @@ _SCHEMAS: Dict[str, List] = {
     "metrics": [("name", V), ("kind", V), ("value", T.DOUBLE)],
     "nodes": [("node_id", V), ("state", V), ("coordinator", T.BOOLEAN),
               ("heartbeat_age_s", T.DOUBLE), ("active_tasks", T.BIGINT),
-              ("mem_pool_peak_bytes", T.BIGINT), ("uri", V)],
+              ("mem_pool_peak_bytes", T.BIGINT),
+              ("hbm_in_use_bytes", T.BIGINT),
+              ("hbm_peak_bytes", T.BIGINT), ("uri", V)],
     "completed_queries": [
         ("query_id", V), ("state", V), ("user", V), ("query", V),
         ("error", V), ("error_code", V), ("create_time", T.DOUBLE),
@@ -82,7 +88,20 @@ _SCHEMAS: Dict[str, List] = {
     "operator_stats": [
         ("query_id", V), ("operator", V), ("rows", T.BIGINT),
         ("batches", T.BIGINT), ("wall_ms", T.DOUBLE),
-        ("bytes", T.BIGINT)],
+        ("bytes", T.BIGINT), ("device_time_s", T.DOUBLE),
+        ("flops", T.DOUBLE), ("hbm_bytes", T.BIGINT)],
+    # per compiled jit entry (ops/jitcache + fused chains): compile
+    # cost, invocation/device-time ledger, and lazy XLA introspection
+    # (cost_analysis FLOPs/bytes, memory_analysis sizes) — the feed is
+    # obs/profiler.EXECUTABLES (reference: the generated-class caches
+    # behind PageFunctionCompiler, made queryable)
+    "executables": [
+        ("name", V), ("static_key", V), ("compiles", T.BIGINT),
+        ("compile_seconds", T.DOUBLE), ("invocations", T.BIGINT),
+        ("device_time_s", T.DOUBLE), ("flops", T.DOUBLE),
+        ("bytes_accessed", T.DOUBLE), ("arg_bytes", T.BIGINT),
+        ("output_bytes", T.BIGINT), ("temp_bytes", T.BIGINT),
+        ("generated_code_bytes", T.BIGINT)],
 }
 
 
@@ -218,12 +237,26 @@ class SystemConnector(Connector):
                          float(n.get("heartbeat_age_s", 0.0)),
                          int(n.get("active_tasks", 0) or 0),
                          int(n.get("mem_pool_peak_bytes", 0) or 0),
+                         int(n.get("hbm_in_use_bytes", 0) or 0),
+                         int(n.get("hbm_peak_bytes", 0) or 0),
                          n.get("uri", ""))
                         for n in rows]
-            # no cluster federation running: local device view
+            # no cluster federation running: local device view, with a
+            # live HBM sample per device (memory_stats-less backends,
+            # e.g. XLA:CPU, report 0)
             import jax
-            return [(f"device-{d.id}", "active", d.id == 0, 0.0, 0, 0,
-                     "") for d in jax.devices()]
+
+            from ..obs.profiler import sample_hbm
+            # key by device id, not by re-deriving sample_hbm's label
+            # string — the two recipes must not be able to drift apart
+            hbm = {d["device_id"]: d for d in sample_hbm()}
+            out = []
+            for d in jax.devices():
+                h = hbm.get(getattr(d, "id", 0), {})
+                out.append((f"device-{d.id}", "active", d.id == 0, 0.0,
+                            0, 0, int(h.get("bytes_in_use", 0)),
+                            int(h.get("peak_bytes_in_use", 0)), ""))
+            return out
         if table == "completed_queries":
             from ..obs.history import HISTORY
             return [(r.get("query_id", ""), r.get("state", ""),
@@ -249,8 +282,23 @@ class SystemConnector(Connector):
                                 int(op.get("rows") or 0),
                                 int(op.get("batches") or 0),
                                 float(op.get("wall_ms") or 0.0),
-                                int(op.get("bytes") or 0)))
+                                int(op.get("bytes") or 0),
+                                float(op.get("device_time_s") or 0.0),
+                                float(op.get("flops") or 0.0),
+                                int(op.get("hbm_bytes") or 0)))
             return out
+        if table == "executables":
+            from ..obs.profiler import EXECUTABLES
+            return [(e["name"], e["static_key"], int(e["compiles"]),
+                     float(e["compile_seconds"]),
+                     int(e["invocations"]),
+                     float(e["device_time_s"]),
+                     None if e["flops"] is None else float(e["flops"]),
+                     None if e["bytes_accessed"] is None
+                     else float(e["bytes_accessed"]),
+                     e["arg_bytes"], e["output_bytes"], e["temp_bytes"],
+                     e["generated_code_bytes"])
+                    for e in EXECUTABLES.snapshot(analyze=True)]
         raise KeyError(table)
 
     def page_source(self, split: Split, columns: Sequence[str],

@@ -954,11 +954,29 @@ class ClusterRunner:
             LOG.log("node_draining", node_id=nid, uri=url)
         self._node_states[url] = state
         tasks = info.get("tasks") or {}
-        NODES.update(nid, state=state,
-                     coordinator=False, uri=url,
-                     active_tasks=int(tasks.get("RUNNING", 0) or 0),
-                     mem_pool_peak_bytes=int(
-                         info.get("memPoolPeakBytes", 0) or 0))
+        fields = dict(
+            state=state, coordinator=False, uri=url,
+            active_tasks=int(tasks.get("RUNNING", 0) or 0),
+            mem_pool_peak_bytes=int(
+                info.get("memPoolPeakBytes", 0) or 0))
+        # worker-sampled device.memory_stats() riding the heartbeat —
+        # the feed of system.runtime.nodes' HBM columns and the
+        # node_hbm_* series on the coordinator /v1/metrics scrape.
+        # Only nodes whose backend actually reported stats get the
+        # fields: a stats-less (CPU) node must stay absent from the
+        # node_hbm_* series, not publish zeros
+        hbm = info.get("hbm") or {}
+        drop = ()
+        if int(hbm.get("devices", 0) or 0) > 0:
+            fields["hbm_in_use_bytes"] = int(hbm.get("bytesInUse", 0)
+                                             or 0)
+            fields["hbm_peak_bytes"] = int(hbm.get("peakBytes", 0) or 0)
+        else:
+            # a node that stops reporting device stats (restarted under
+            # the same id on a stats-less backend) must not keep serving
+            # its previous incarnation's sample
+            drop = ("hbm_in_use_bytes", "hbm_peak_bytes")
+        NODES.update(nid, drop=drop, **fields)
 
     def poll_nodes(self, urls: Optional[List[str]] = None) -> None:
         """One synchronous federation sweep (the background heartbeat
@@ -1093,6 +1111,16 @@ class ClusterRunner:
         retry = format_retry_summary(info)
         if retry:
             text += "\n" + retry
+        from ..planner.planner import bool_property
+        if bool_property(self.session, "profile", False):
+            # in-process workers share this process's EXECUTABLES
+            # registry, so the section shows the run's compiled
+            # kernels; remote workers keep theirs queryable on their
+            # own system.runtime.executables table
+            from ..planner.printer import format_executables_registry
+            exes = format_executables_registry()
+            if exes:
+                text += "\n" + exes
         return QueryResult(["Query Plan"], [T.VARCHAR],
                            [(line,) for line in text.split("\n")])
 

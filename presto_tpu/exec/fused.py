@@ -142,7 +142,12 @@ def fused_pipeline(stages: Tuple[object, ...]):
         cur = _apply_stages(probe, stages, preps, builds, dyns, errs)
         return cur, _merge_errs(errs)
 
-    return jax.jit(run)
+    # _TimedEntry: the fused chain is an executable like any jitcache
+    # entry — compile time, invocations, and (under a profile context)
+    # device time land in obs.profiler.EXECUTABLES, attributed to the
+    # join node whose frame dispatches the chain
+    from ..ops.jitcache import _TimedEntry
+    return _TimedEntry("fused_pipeline", jax.jit(run), stages)
 
 
 @functools.lru_cache(maxsize=None)
@@ -187,4 +192,6 @@ def fused_prefilter(stages: Tuple[object, ...],
         count = jnp.sum(cur.row_mask.astype(jnp.int32))
         return cur, _merge_errs(errs), count
 
-    return jax.jit(run)
+    from ..ops.jitcache import _TimedEntry
+    return _TimedEntry("fused_prefilter", jax.jit(run),
+                       (stages, pre_keys, semi_keys))

@@ -107,44 +107,56 @@ def run_init_plans(ex, plan: LogicalPlan) -> None:
 def execute_plan(plan: LogicalPlan, session: Session,
                  rows_per_batch: int = 1 << 17, stats=None,
                  collect_rows: bool = True, cancel_event=None) -> QueryResult:
+    from ..obs.profiler import profiled
     from .taskexec import GLOBAL as scheduler
     ex = _Executor(session, rows_per_batch, stats=stats)
     ex.cancel_event = cancel_event
     handle = (scheduler.task(name=str(id(ex)))
               if bool_property(session, "fair_scheduling", True) else None)
+    # device-time profiling: per-dispatch block_until_ready bracketing +
+    # per-operator attribution (obs/profiler.py). On under the `profile`
+    # session property, and always under EXPLAIN ANALYZE — analyze mode
+    # already pays a per-batch sync for live row counts, so device truth
+    # rides along; plain queries pay one contextvar load per dispatch.
+    profile_on = (bool_property(session, "profile", False)
+                  or (stats is not None
+                      and getattr(stats, "count_rows", False)))
     try:
-        run_init_plans(ex, plan)
-        root = plan.root
-        rows: List[tuple] = []
-        out_batches: List[Batch] = []
-        # one fair-scheduler quantum per produced output batch: concurrent
-        # queries interleave at batch granularity by cumulative device
-        # time (the reference's TaskExecutor 1s-quantum role)
-        it = ex.run(root.child)
-        sentinel = object()
-        try:
-            while True:
-                # cancellation interrupts between quanta, like the
-                # reference Driver checking its DriverYieldSignal/state
-                # between page moves (operator/Driver.java:262;
-                # DispatchManager.java:134)
-                ex._check_cancel()
-                b = scheduler.run_quantum(handle,
-                                          lambda: next(it, sentinel))
-                if b is sentinel:
-                    break
-                if collect_rows:
-                    out_batches.append(b)
-        finally:
-            # closing the generator runs suspended finally blocks (the
-            # threaded scan's stop.set()) so cancel/error doesn't leave
-            # prefetch workers spinning
-            it.close()
-        ex.check_errors()
-        if collect_rows:
-            rows = [r for b in out_batches for r in b.to_pylist()]
-        return QueryResult(names=[f.name for f in root.fields],
-                           types=[f.type for f in root.fields], rows=rows)
+        with profiled(profile_on):
+            run_init_plans(ex, plan)
+            root = plan.root
+            rows: List[tuple] = []
+            out_batches: List[Batch] = []
+            # one fair-scheduler quantum per produced output batch:
+            # concurrent queries interleave at batch granularity by
+            # cumulative device time (the reference's TaskExecutor
+            # 1s-quantum role)
+            it = ex.run(root.child)
+            sentinel = object()
+            try:
+                while True:
+                    # cancellation interrupts between quanta, like the
+                    # reference Driver checking its DriverYieldSignal/state
+                    # between page moves (operator/Driver.java:262;
+                    # DispatchManager.java:134)
+                    ex._check_cancel()
+                    b = scheduler.run_quantum(handle,
+                                              lambda: next(it, sentinel))
+                    if b is sentinel:
+                        break
+                    if collect_rows:
+                        out_batches.append(b)
+            finally:
+                # closing the generator runs suspended finally blocks (the
+                # threaded scan's stop.set()) so cancel/error doesn't leave
+                # prefetch workers spinning
+                it.close()
+            ex.check_errors()
+            if collect_rows:
+                rows = [r for b in out_batches for r in b.to_pylist()]
+            return QueryResult(names=[f.name for f in root.fields],
+                               types=[f.type for f in root.fields],
+                               rows=rows)
     finally:
         if handle is not None:
             handle.close()

@@ -24,6 +24,7 @@ Modes:
 """
 from __future__ import annotations
 
+import contextvars
 import queue as _queue
 import threading
 from typing import Callable, Iterator, List, Optional, Sequence
@@ -141,7 +142,14 @@ def exchange_source(batches: Iterator[Batch], mode: str, n_consumers: int,
                 close()
         ex.finish()
 
-    t = threading.Thread(target=produce, daemon=True)
+    # run in a copy of the caller's context: the profile flag
+    # (obs/profiler._ACTIVE) and trace parentage must follow the
+    # pipeline onto its producer thread — a profiled query's join
+    # kernels run HERE, and losing the contextvar would silently drop
+    # their device-time attribution (per-operator scopes still re-set
+    # themselves inside this thread via StatsCollector.wrap)
+    ctx = contextvars.copy_context()
+    t = threading.Thread(target=ctx.run, args=(produce,), daemon=True)
     t.start()
     return ex
 
@@ -174,7 +182,11 @@ def parallel_drivers(batches: Iterator[Batch],
             out.put(("done", None))
 
     for c in range(concurrency):
-        threading.Thread(target=drive, args=(c,), daemon=True).start()
+        # one context copy per driver (a Context can't be entered twice
+        # concurrently) — same propagation contract as exchange_source
+        ctx = contextvars.copy_context()
+        threading.Thread(target=ctx.run, args=(drive, c),
+                         daemon=True).start()
     done = 0
     try:
         while done < concurrency:

@@ -172,17 +172,31 @@ class LocalRunner:
         for node, st in list(stats.by_node.items()):
             kind = type(node).__name__.replace("Node", "")
             agg = by_kind.setdefault(
-                kind, {"rows": 0, "batches": 0, "wall_ms": 0.0})
+                kind, {"rows": 0, "batches": 0, "wall_ms": 0.0,
+                       "device_time_s": 0.0, "flops": 0.0,
+                       "hbm_bytes": 0.0})
             agg["rows"] += st.rows
             agg["batches"] += st.batches
             agg["wall_ms"] += st.wall_s * 1e3
+            # device truth (profile mode): seconds/FLOPs/HBM bytes the
+            # profiler attributed to this operator's jit dispatches —
+            # zeros on the unprofiled path
+            dev = stats.device_for(node) \
+                if hasattr(stats, "device_for") else None
+            if dev is not None:
+                agg["device_time_s"] += dev["device_time_s"]
+                agg["flops"] += dev["flops"]
+                agg["hbm_bytes"] += dev["hbm_bytes"]
         # no "bytes" key: the local stats collector doesn't measure
         # operator output bytes (cluster records carry per-task
         # bytesOut); rows are live only in analyze mode — counting
         # them on the normal path would cost a device sync per batch
         operators = [{"operator": k, "rows": int(v["rows"]),
                       "batches": int(v["batches"]),
-                      "wall_ms": round(v["wall_ms"], 3)}
+                      "wall_ms": round(v["wall_ms"], 3),
+                      "device_time_s": round(v["device_time_s"], 6),
+                      "flops": v["flops"],
+                      "hbm_bytes": int(v["hbm_bytes"])}
                      for k, v in by_kind.items()]
         pool_stats = getattr(self.session, "last_memory_stats", None)
         planning_ms = device_sync_ms = 0.0
@@ -308,6 +322,7 @@ class LocalRunner:
                     text += "\n" + format_trace_summary(trace_spans)
                 if stats is not None:
                     from ..planner.printer import (
+                        format_cost_verdict, format_executables_summary,
                         format_scan_cache_summary, format_skew_summary,
                     )
                     skew = format_skew_summary(stats)
@@ -316,6 +331,12 @@ class LocalRunner:
                     sc = format_scan_cache_summary(stats)
                     if sc:
                         text += "\n" + sc
+                    exes = format_executables_summary(stats)
+                    if exes:
+                        text += "\n" + exes
+                    verdict = format_cost_verdict(stats)
+                    if verdict:
+                        text += "\n" + verdict
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.split("\n")])
         if isinstance(stmt, A.ShowCatalogs):

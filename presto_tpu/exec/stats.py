@@ -25,6 +25,10 @@ class NodeStats:
     batches: int = 0
     rows: int = 0                # live rows (analyze mode only)
     capacity: int = 0            # total batch capacity emitted
+    #: device seconds attributed by the profiler (profile mode only:
+    #: jit dispatches made in this operator's frame, bracketed with
+    #: block_until_ready — obs/profiler.py)
+    device_time_s: float = 0.0
 
 
 class StatsCollector:
@@ -47,6 +51,11 @@ class StatsCollector:
         self.cache_hits = 0
         self.cache_misses = 0
         self.prefetch_stall_s = 0.0
+        #: plan node -> {ExecutableRecord: [invocations, device_s]} —
+        #: which executables each operator dispatched while profiled;
+        #: FLOPs/HBM bytes derive at render time (record.analyze() is
+        #: lazy XLA introspection, never paid per call)
+        self.exe_by_node: Dict[object, Dict[object, list]] = {}
         import threading
         # record_cache fires from concurrent prefetch worker threads;
         # an unsynchronized += would drop increments
@@ -58,6 +67,59 @@ class StatsCollector:
                 self.cache_hits += 1
             else:
                 self.cache_misses += 1
+
+    def record_device(self, node, seconds: float, record) -> None:
+        """Charge one profiled jit dispatch to a plan operator
+        (obs/profiler.profiled_call's attribution sink)."""
+        with self._cache_lock:
+            st = self.by_node.setdefault(node, NodeStats())
+            st.device_time_s += seconds
+            ent = self.exe_by_node.setdefault(node, {}).setdefault(
+                record, [0, 0.0])
+            ent[0] += 1
+            ent[1] += seconds
+
+    def device_for(self, node) -> Optional[Dict]:
+        """Per-operator device truth for the printer/history:
+        ``device_time_s`` plus FLOPs / bytes-accessed estimates
+        (per-invocation cost analysis x invocation count). None when
+        the operator dispatched nothing under a profile context."""
+        st = self.by_node.get(node)
+        counts = self.exe_by_node.get(node)
+        if (st is None or st.device_time_s <= 0.0) and not counts:
+            return None
+        flops = 0.0
+        hbm = 0.0
+        for rec, (n, _secs) in list((counts or {}).items()):
+            a = rec.analyze()
+            flops += (a.get("flops") or 0.0) * n
+            hbm += (a.get("bytes_accessed") or 0.0) * n
+        return {"device_time_s": st.device_time_s if st else 0.0,
+                "flops": flops, "hbm_bytes": hbm}
+
+    def executables_used(self) -> List[Dict]:
+        """This query's executables, aggregated across operators —
+        the EXPLAIN ANALYZE "Executables" section feed (the
+        ``system.runtime.executables`` table is the process-lifetime
+        view of the same records)."""
+        agg: Dict[object, list] = {}
+        for per_node in list(self.exe_by_node.values()):
+            for rec, (n, secs) in list(per_node.items()):
+                ent = agg.setdefault(rec, [0, 0.0])
+                ent[0] += n
+                ent[1] += secs
+        out = []
+        for rec, (n, secs) in agg.items():
+            a = rec.analyze()
+            out.append({
+                "name": rec.name, "static_key": rec.static_key,
+                "invocations": n, "device_time_s": secs,
+                "compile_seconds": rec.compile_seconds,
+                "flops": a.get("flops"),
+                "bytes_accessed": a.get("bytes_accessed"),
+            })
+        out.sort(key=lambda d: -d["device_time_s"])
+        return out
 
     def record_split(self, table: str, split_no: int, started_at: float,
                      wall_s: float, batches: int) -> None:
@@ -88,12 +150,20 @@ class StatsCollector:
 
     def wrap(self, node, it: Iterator) -> Iterator:
         st = self.by_node.setdefault(node, NodeStats())
+        from ..obs.profiler import operator_scope
 
         def timed():
             while True:
                 t0 = time.perf_counter()
                 try:
-                    b = next(it)
+                    # operator attribution: jit dispatches made while
+                    # THIS node's generator frame runs charge to it;
+                    # nested child iterators re-set the scope around
+                    # their own frames (innermost wins), so a join's
+                    # kernels bill the join, its child scan's staging
+                    # bills the scan
+                    with operator_scope(self, node):
+                        b = next(it)
                 except StopIteration:
                     st.wall_s += time.perf_counter() - t0
                     return

@@ -16,10 +16,16 @@ presto-main/.../connector/jmx/) — reshaped for a device runtime:
                   registry — the ``GET /v1/metrics`` scrape surface on
                   workers and the coordinator;
 - ``obs.history`` bounded persistent query history (+ optional JSONL
-                  sink), queryable as ``system.runtime.
-                  {completed_queries,operator_stats}``;
+                  sink with size-capped rotation), queryable as
+                  ``system.runtime.{completed_queries,operator_stats}``;
 - ``obs.log``     structured JSON-lines logging correlated by
-                  query/task/trace ids from the span context.
+                  query/task/trace ids from the span context;
+- ``obs.profiler`` device profiling & cost attribution: per-executable
+                  compile/FLOPs/HBM introspection
+                  (``system.runtime.executables``), per-operator
+                  device-time attribution under the ``profile`` session
+                  property, HBM telemetry sampling, and host+device
+                  Chrome-trace merging for ``--profile-out``.
 
 Everything is always importable and safe when idle: the tracer is OFF
 by default (a disabled ``span()`` returns a shared no-op and records
@@ -33,3 +39,4 @@ from .metrics import (  # noqa: F401
 from .exposition import parse_exposition, render_exposition  # noqa: F401
 from .history import HISTORY, attach_history  # noqa: F401
 from .log import LOG  # noqa: F401
+from .profiler import EXECUTABLES, profiled, sample_hbm  # noqa: F401

@@ -114,6 +114,16 @@ def render_exposition(registry: Optional[MetricsRegistry] = None,
             family("node_mem_pool_peak_bytes", "gauge").samples.append(
                 ("node_mem_pool_peak_bytes", lab,
                  float(n.get("mem_pool_peak_bytes", 0) or 0)))
+            # HBM telemetry federated from worker heartbeats
+            # (device.memory_stats() sums; absent on nodes that never
+            # reported one, so a CPU-only cluster adds no noise)
+            if n.get("hbm_in_use_bytes") is not None:
+                family("node_hbm_in_use_bytes", "gauge").samples.append(
+                    ("node_hbm_in_use_bytes", lab,
+                     float(n.get("hbm_in_use_bytes") or 0)))
+                family("node_hbm_peak_bytes", "gauge").samples.append(
+                    ("node_hbm_peak_bytes", lab,
+                     float(n.get("hbm_peak_bytes") or 0)))
 
     lines: List[str] = []
     for name in sorted(fams):

@@ -12,10 +12,18 @@ The ring is bounded (records die with the process unless a JSONL sink
 is configured with ``HISTORY.configure(sink_path=...)`` / the CLI's
 ``--history-out``); ``slow_threshold_s`` additionally emits the full
 record through the structured logger (``--slow-query-log``).
+
+The sink itself is bounded too: a long-lived coordinator must not grow
+one JSONL file forever, so when the file passes ``max_sink_bytes``
+(default 64 MiB) it rotates to ``<path>.1`` — one generation kept, the
+previous ``.1``'s records dropped and counted in
+``history_records_dropped_total`` so the loss is observable, never
+silent.
 """
 from __future__ import annotations
 
 import json
+import os
 import threading
 from collections import deque
 from typing import Dict, List, Optional
@@ -36,21 +44,56 @@ class QueryHistory:
         self._lock = threading.Lock()
         self.sink_path: Optional[str] = None
         self.slow_threshold_s: Optional[float] = None
+        #: rotate the sink when it passes this size (0/None = unbounded,
+        #: the pre-rotation behaviour, for tests that diff whole files)
+        self.max_sink_bytes: Optional[int] = 64 << 20
+        self._sink_lock = threading.Lock()
+        # records written to the current sink file / living in the .1
+        # generation — the .1 count is what one more rotation drops
+        self._sink_records = 0
+        self._rotated_records = 0
 
     def configure(self, sink_path: Optional[str] = None,
-                  slow_threshold_s: Optional[float] = None) -> None:
+                  slow_threshold_s: Optional[float] = None,
+                  max_sink_bytes: Optional[int] = None) -> None:
         if sink_path is not None:
             self.sink_path = sink_path
+            # resuming onto files a previous process wrote: seed the
+            # record counts from what's on disk, so the FIRST rotation
+            # after a restart still attributes the dropped generation
+            # correctly (one line scan at configure time, never per add)
+            with self._sink_lock:
+                self._sink_records = self._count_lines(sink_path)
+                self._rotated_records = self._count_lines(
+                    sink_path + ".1")
         if slow_threshold_s is not None:
             self.slow_threshold_s = slow_threshold_s
+        if max_sink_bytes is not None:
+            self.max_sink_bytes = int(max_sink_bytes) or None
+
+    @staticmethod
+    def _count_lines(path: str) -> int:
+        try:
+            with open(path, "rb") as f:
+                return sum(chunk.count(b"\n")
+                           for chunk in iter(lambda: f.read(1 << 20),
+                                             b""))
+        except OSError:
+            return 0
 
     def add(self, record: Dict) -> None:
         with self._lock:
             self._ring.append(record)
         if self.sink_path:
             try:
-                with open(self.sink_path, "a") as f:
-                    f.write(json.dumps(record, default=str) + "\n")
+                with self._sink_lock:
+                    with open(self.sink_path, "a") as f:
+                        f.write(json.dumps(record, default=str) + "\n")
+                        size = f.tell()
+                    self._sink_records += 1
+                    if self.max_sink_bytes \
+                            and size >= self.max_sink_bytes:
+                        self._rotate()
             except Exception:   # history must not break queries
                 pass
         thr = self.slow_threshold_s
@@ -58,6 +101,18 @@ class QueryHistory:
                 and float(record.get("elapsed_ms") or 0.0) >= thr * 1e3:
             from .log import LOG
             LOG.log("slow_query", **record)
+
+    def _rotate(self) -> None:
+        """Current sink becomes ``<path>.1`` (replacing — and thereby
+        dropping — the previous generation); appends continue into a
+        fresh file. Called with the sink lock held."""
+        dropped = self._rotated_records
+        os.replace(self.sink_path, self.sink_path + ".1")
+        self._rotated_records = self._sink_records
+        self._sink_records = 0
+        if dropped:
+            from .metrics import REGISTRY
+            REGISTRY.counter("history_records_dropped_total").inc(dropped)
 
     def snapshot(self) -> List[Dict]:
         with self._lock:

@@ -306,10 +306,16 @@ class NodeRegistry:
         self._nodes: Dict[str, Dict] = {}
         self._lock = threading.Lock()
 
-    def update(self, node_id: str, seen: bool = True, **fields) -> None:
+    def update(self, node_id: str, seen: bool = True, drop=(),
+               **fields) -> None:
+        """Merge ``fields`` into the node's doc; ``drop`` removes keys a
+        fresh heartbeat no longer carries (a merge-only update would
+        latch e.g. HBM gauges from a node's previous incarnation)."""
         with self._lock:
             n = self._nodes.setdefault(node_id, {"node_id": node_id})
             n.update(fields)
+            for k in drop:
+                n.pop(k, None)
             if seen:
                 n["last_seen"] = time.monotonic()
 

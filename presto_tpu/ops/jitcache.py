@@ -19,6 +19,7 @@ from typing import Optional, Sequence
 
 import jax
 
+from ..obs import profiler as _prof
 from ..obs.metrics import REGISTRY
 from ..obs.trace import TRACER
 from .aggregation import AggSpec, global_aggregate, grouped_aggregate
@@ -27,23 +28,36 @@ _JIT_HITS = REGISTRY.counter("jit_cache_hits_total")
 _JIT_MISSES = REGISTRY.counter("jit_cache_misses_total")
 _JIT_COMPILES = REGISTRY.counter("jit_compile_total")
 _JIT_COMPILE_S = REGISTRY.counter("jit_compile_seconds_total")
+#: fixed-bucket histogram alongside the counter: compile-time p95
+#: becomes visible on /v1/metrics (jit_compile_seconds_bucket/_sum/
+#: _count) while the long-standing _total sum keeps old scrapes working
+_JIT_COMPILE_HIST = REGISTRY.histogram("jit_compile_seconds")
 
 
 class _TimedEntry:
     """Jitted callable whose FIRST invocation is timed as a compile
     (jax.jit compiles lazily on first call; later shape buckets retrace
     silently — this records the dominant first-trace cost without
-    touching every dispatch)."""
+    touching every dispatch). Every entry owns an ExecutableRecord in
+    ``obs.profiler.EXECUTABLES``; under a profile context each dispatch
+    is additionally bracketed with block_until_ready and attributed to
+    the operator whose frame made the call."""
 
-    __slots__ = ("name", "fn", "first", "_lock")
+    __slots__ = ("name", "fn", "first", "_lock", "record")
 
-    def __init__(self, name: str, fn):
+    def __init__(self, name: str, fn, key=()):
         self.name = name
         self.fn = fn
         self.first = True
         self._lock = threading.Lock()
+        self.record = _prof.EXECUTABLES.register(name, key)
 
     def __call__(self, *args):
+        rec = self.record
+        if rec.evicted:
+            _prof.EXECUTABLES.readmit(rec)
+        rec.note_invocation()
+        _prof.INVOCATIONS.inc()
         if self.first:
             # one-shot flip under a lock: concurrent first calls (a
             # fixed stage starts every task at once) must count ONE
@@ -54,9 +68,14 @@ class _TimedEntry:
                 t0 = time.perf_counter()
                 with TRACER.span(f"jit-compile:{self.name}"):
                     out = self.fn(*args)
+                dt = time.perf_counter() - t0
                 _JIT_COMPILES.inc()
-                _JIT_COMPILE_S.inc(time.perf_counter() - t0)
+                _JIT_COMPILE_S.inc(dt)
+                _JIT_COMPILE_HIST.observe(dt)
+                rec.note_compile(dt, self.fn, args)
                 return out
+        if _prof.should_profile_call(rec):
+            return _prof.profiled_call(rec, self.fn, args)
         return self.fn(*args)
 
 
@@ -74,7 +93,8 @@ def _entry_cache(name: str, factory):
                 fn = cache.get(key)
                 if fn is None:
                     _JIT_MISSES.inc()
-                    fn = cache[key] = _TimedEntry(name, factory(*key))
+                    fn = cache[key] = _TimedEntry(name, factory(*key),
+                                                  key)
                     return fn
         _JIT_HITS.inc()
         return fn
@@ -217,7 +237,8 @@ from .join import max_multiplicity  # noqa: E402
 #: build, replacing the per-probe-batch match_count_max syncs for
 #: non-skewed builds (jit retraces per prepared-pytree structure, so one
 #: wrapper covers both the direct and sorted layouts)
-max_multiplicity_jit = jax.jit(max_multiplicity)
+max_multiplicity_jit = _TimedEntry("max_multiplicity",
+                                   jax.jit(max_multiplicity))
 
 
 _match_mask = _entry_cache(

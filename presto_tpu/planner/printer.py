@@ -233,6 +233,67 @@ def format_retry_summary(info) -> str:
     return "\n".join(lines)
 
 
+def format_executables_summary(stats, max_rows: int = 12) -> str:
+    """Executables section appended to EXPLAIN ANALYZE under profile
+    mode: the query's compiled XLA executables ranked by device time,
+    with compile seconds and per-invocation cost-analysis estimates
+    (obs/profiler.EXECUTABLES holds the process-lifetime view as
+    ``system.runtime.executables``). Empty when nothing was profiled."""
+    used = (stats.executables_used()
+            if hasattr(stats, "executables_used") else [])
+    if not used:
+        return ""
+    lines = ["Executables (this query, by device time):"]
+    for e in used[:max_rows]:
+        flops = e.get("flops")
+        hbm = e.get("bytes_accessed")
+        cost = ""
+        if flops is not None or hbm is not None:
+            cost = (f", {_si(flops or 0.0)}FLOP"
+                    f"/{_si(hbm or 0.0)}B per call")
+        lines.append(
+            f"  {e['name']:<24} x{e['invocations']:<5} device "
+            f"{e['device_time_s'] * 1e3:,.1f}ms, compile "
+            f"{e['compile_seconds']:,.2f}s{cost}")
+    if len(used) > max_rows:
+        lines.append(f"  ... and {len(used) - max_rows} more "
+                     "(system.runtime.executables)")
+    return "\n".join(lines)
+
+
+def format_executables_registry(max_rows: int = 12) -> str:
+    """Process-lifetime executables section (cluster EXPLAIN ANALYZE,
+    where per-query attribution lives on the workers): the registry's
+    records ranked by cumulative device time, compile-heavy entries
+    surfacing even when never profiled. Empty when nothing compiled."""
+    from ..obs.profiler import EXECUTABLES
+    rows = [e for e in EXECUTABLES.snapshot(analyze=False)
+            if e["invocations"]]
+    if not rows:
+        return ""
+    lines = ["Executables (process lifetime, by device time):"]
+    for e in rows[:max_rows]:
+        lines.append(
+            f"  {e['name']:<24} x{e['invocations']:<6} device "
+            f"{e['device_time_s'] * 1e3:,.1f}ms, compile "
+            f"{e['compile_seconds']:,.2f}s")
+    return "\n".join(lines)
+
+
+def format_cost_verdict(stats) -> str:
+    """Closing EXPLAIN ANALYZE line: tf.data's framing — is the query
+    input-bound (scan decode/staging + prefetch stall dominates) or
+    compute-bound (attributed device time dominates)? Empty when
+    nothing was profiled."""
+    from ..obs.profiler import cost_verdict
+    v = cost_verdict(stats)
+    if v is None:
+        return ""
+    return (f"Verdict: {v['verdict']} "
+            f"(device compute {v['compute_s'] * 1e3:,.1f}ms vs input "
+            f"{v['input_s'] * 1e3:,.1f}ms scan+stall)")
+
+
 def _label(n: PlanNode) -> str:
     cols = ", ".join(f"{f.name}:{f.type.display()}" for f in n.fields)
     if isinstance(n, TableScanNode):
@@ -277,6 +338,14 @@ def _label(n: PlanNode) -> str:
     return type(n).__name__
 
 
+def _si(v: float) -> str:
+    """Compact engineering notation for FLOP/byte totals."""
+    for thresh, unit in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(v) >= thresh:
+            return f"{v / thresh:,.2f}{unit}"
+    return f"{v:,.0f}"
+
+
 def _walk(n: PlanNode, depth: int, lines: List[str], stats=None) -> None:
     suffix = ""
     if stats is not None:
@@ -290,6 +359,16 @@ def _walk(n: PlanNode, depth: int, lines: List[str], stats=None) -> None:
             suffix = (f"   [self {self_ms:,.1f}ms, wall "
                       f"{st.wall_s * 1e3:,.1f}ms, {st.rows:,} rows, "
                       f"{st.batches} batches]")
+            # device truth (profile mode / EXPLAIN ANALYZE): seconds the
+            # device actually spent in this operator's executables, plus
+            # cost-analysis FLOP / HBM-traffic estimates — host wall
+            # lies under async dispatch, these don't
+            dev = (stats.device_for(n)
+                   if hasattr(stats, "device_for") else None)
+            if dev is not None:
+                suffix += (f" [device {dev['device_time_s'] * 1e3:,.1f}ms"
+                           f", {_si(dev['flops'])}FLOP"
+                           f", {_si(dev['hbm_bytes'])}B hbm]")
         elif not isinstance(n, OutputNode):
             suffix = "   [not executed]"
     lines.append("  " * depth + "- " + _label(n) + suffix)

@@ -42,6 +42,7 @@ from ..exec import local as local_exec
 from ..exec.failpoints import FAILPOINTS, FailpointError
 from ..obs.log import LOG
 from ..obs.metrics import REGISTRY, TASKS
+from ..obs.profiler import hbm_totals, profiled
 from ..obs.trace import TRACER
 from ..exec.pages import deserialize_page, serialize_page, \
     serialize_partitioned
@@ -484,35 +485,43 @@ class Task:
                 # fair device scheduling across concurrent tasks: one
                 # quantum per produced batch (reference TaskExecutor
                 # time slicing)
+                # `profile` session prop rides the task doc: this
+                # task's jit dispatches get device-time bracketing and
+                # land in the worker's obs.profiler.EXECUTABLES (and
+                # its system.runtime.executables table)
+                from ..exec.local import bool_property
+                profile_ctx = profiled(
+                    bool_property(self.session, "profile", False))
                 it = ex.run(self.root)
                 sentinel = object()
-                while True:
-                    if self._abort.is_set():
-                        from ..errors import QueryCancelledError
-                        raise QueryCancelledError("task aborted")
-                    batch = handle.scheduler.run_quantum(
-                        handle, lambda: next(it, sentinel))
-                    if batch is sentinel:
-                        break
-                    live = batch.host_count()
-                    if live == 0:
-                        continue
-                    self.rows_out += live
-                    if self.output_kind == "partition":
-                        pages = serialize_partitioned(
-                            batch, self.output_keys, self.buffer.n)
-                        for b, page in enumerate(pages):
-                            if page is not None:
-                                self.bytes_out += len(page)
-                                self.buffer.add(b, page)
-                    elif self.output_kind == "broadcast":
-                        page = serialize_page(batch)
-                        self.bytes_out += len(page)
-                        self.buffer.add_broadcast(page)
-                    else:   # single
-                        page = serialize_page(batch)
-                        self.bytes_out += len(page)
-                        self.buffer.add(0, page)
+                with profile_ctx:
+                    while True:
+                        if self._abort.is_set():
+                            from ..errors import QueryCancelledError
+                            raise QueryCancelledError("task aborted")
+                        batch = handle.scheduler.run_quantum(
+                            handle, lambda: next(it, sentinel))
+                        if batch is sentinel:
+                            break
+                        live = batch.host_count()
+                        if live == 0:
+                            continue
+                        self.rows_out += live
+                        if self.output_kind == "partition":
+                            pages = serialize_partitioned(
+                                batch, self.output_keys, self.buffer.n)
+                            for b, page in enumerate(pages):
+                                if page is not None:
+                                    self.bytes_out += len(page)
+                                    self.buffer.add(b, page)
+                        elif self.output_kind == "broadcast":
+                            page = serialize_page(batch)
+                            self.bytes_out += len(page)
+                            self.buffer.add_broadcast(page)
+                        else:   # single
+                            page = serialize_page(batch)
+                            self.bytes_out += len(page)
+                            self.buffer.add(0, page)
                 ex.check_errors()
             self.buffer.finish()
             self._set_state("FINISHED")
@@ -785,6 +794,11 @@ class WorkerServer:
             # (process-wide gauge: in-process test workers share it)
             "memPoolPeakBytes": int(
                 REGISTRY.gauge("memory_pool_peak_bytes").value),
+            # HBM sample riding the heartbeat: device.memory_stats()
+            # summed over local devices AND published as per-device
+            # hbm_in_use_bytes/hbm_peak_bytes gauges on this worker's
+            # /v1/metrics (zeros on stats-less backends like XLA:CPU)
+            "hbm": hbm_totals(),
         }
 
     def abort_query(self, query_id: str) -> int:

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Perf-regression gate: diff a bench run against the latest committed
+``BENCH_r*.json``.
+
+Every round's numbers are committed as ``BENCH_rNN.json`` (the driver's
+wrapper: ``{"parsed": {"metric", "value", "vs_baseline",
+"sub_metrics": [...]}}``). This tool turns "did the join PR regress
+q1?" from an eyeball diff into a machine verdict: it flattens the
+headline + sub_metrics of both sides, compares each query's
+``vs_baseline`` speedup (the machine-calibrated ratio against the
+pinned NumPy proxy — BASELINE_PROXY.json pins the proxy seconds, so
+the ratio is stable across rounds on one machine class) with a
+per-query tolerance, and emits one JSON verdict plus a matching exit
+code.
+
+Usage:
+    python tools/check_bench_regression.py --run bench_out.json
+    python tools/check_bench_regression.py --run bench_out.json \
+        --tolerance 10 --tolerance-for q55=25 --tolerance-for q3=15
+    python tools/check_bench_regression.py --smoke       # self-test
+
+``--run`` accepts either bench.py's summary line (written via
+``BENCH_OUT=path python bench.py``), a file whose LAST JSON line is
+that summary (a captured stdout log), or a committed ``BENCH_r*.json``
+wrapper. ``--smoke`` runs the gate's self-consistency check against
+the latest committed round: the baseline must pass against itself, and
+a synthetically halved copy must fail — the mode tier-1 runs so the
+gate itself cannot rot.
+
+Verdict JSON (stdout):
+    {"verdict": "pass"|"fail", "baseline_file": ..., "checks": [
+        {"metric", "baseline", "run", "ratio", "tolerance_pct", "ok"}],
+     "missing": [...], "new": [...]}
+
+Exit code 0 on pass, 1 on fail, 2 on usage/IO errors.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: default allowed relative drop in vs_baseline, percent. Generous
+#: enough for machine noise on multi-second configs, tight enough that
+#: a real regression (the 2x kind perf PRs cause) cannot hide.
+DEFAULT_TOLERANCE_PCT = 10.0
+
+
+def latest_bench_file(root: str = _REPO) -> Optional[str]:
+    """Highest-numbered BENCH_r*.json — the pinned trajectory."""
+    best, best_n = None, -1
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        if m and int(m.group(1)) > best_n:
+            best, best_n = p, int(m.group(1))
+    return best
+
+
+def _flatten(summary: Dict) -> Dict[str, Dict]:
+    """Headline + sub_metrics -> {metric: record}."""
+    out: Dict[str, Dict] = {}
+    if not isinstance(summary, dict) or "metric" not in summary:
+        return out
+    head = {k: v for k, v in summary.items() if k != "sub_metrics"}
+    out[head["metric"]] = head
+    for sub in summary.get("sub_metrics") or ():
+        if isinstance(sub, dict) and "metric" in sub:
+            out[sub["metric"]] = sub
+    return out
+
+
+def load_summary(path: str) -> Dict[str, Dict]:
+    """Metrics from a bench summary file: a BENCH_r wrapper (use its
+    ``parsed``), a bare summary object, or a log whose last JSON line
+    is the summary (bench.py re-emits the full summary after every
+    config, so the last line always wins)."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "parsed" in doc:
+            doc = doc["parsed"]
+        flat = _flatten(doc)
+        if flat:
+            return flat
+    except ValueError:
+        pass
+    # log mode: last parseable JSON line
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            flat = _flatten(json.loads(line))
+        except ValueError:
+            continue
+        if flat:
+            return flat
+    raise ValueError(f"{path}: no bench summary found")
+
+
+def _score(rec: Dict) -> Optional[float]:
+    """The comparable number: vs_baseline (machine-calibrated) when
+    present, raw value otherwise."""
+    v = rec.get("vs_baseline")
+    if v is None:
+        v = rec.get("value")
+    return None if v is None else float(v)
+
+
+def _tolerance_for(metric: str, default_pct: float,
+                   overrides: Dict[str, float]) -> float:
+    """Per-metric tolerance: exact metric name wins, then a short-name
+    override (``q55=25`` matches ``tpcds_sf10_q55_rows_per_sec``)."""
+    if metric in overrides:
+        return overrides[metric]
+    for short, pct in overrides.items():
+        if f"_{short}_" in metric:
+            return pct
+    return default_pct
+
+
+def compare(baseline: Dict[str, Dict], run: Dict[str, Dict],
+            default_pct: float = DEFAULT_TOLERANCE_PCT,
+            overrides: Optional[Dict[str, float]] = None,
+            allow_missing: bool = False) -> Dict:
+    """The gate: every baseline metric must be present in the run and
+    within its tolerance. New run-only metrics are reported, never
+    failed — adding a config must not break the gate."""
+    overrides = overrides or {}
+    checks: List[Dict] = []
+    missing: List[str] = []
+    for metric in sorted(baseline):
+        b = _score(baseline[metric])
+        if metric not in run:
+            missing.append(metric)
+            continue
+        r = _score(run[metric])
+        pct = _tolerance_for(metric, default_pct, overrides)
+        if b is None or r is None or b <= 0:
+            checks.append({"metric": metric, "baseline": b, "run": r,
+                           "ratio": None, "tolerance_pct": pct,
+                           "ok": True, "note": "not comparable"})
+            continue
+        ratio = r / b
+        ok = ratio >= 1.0 - pct / 100.0
+        checks.append({"metric": metric, "baseline": b, "run": r,
+                       "ratio": round(ratio, 4), "tolerance_pct": pct,
+                       "ok": ok})
+    new = sorted(set(run) - set(baseline))
+    failed = [c["metric"] for c in checks if not c["ok"]]
+    verdict = "pass"
+    if failed or (missing and not allow_missing):
+        verdict = "fail"
+    return {"verdict": verdict, "checks": checks, "missing": missing,
+            "new": new, "failed": failed}
+
+
+def smoke(baseline_path: str) -> Dict:
+    """Self-consistency: the pinned round must pass against itself,
+    and a halved copy must fail. Proves discovery, parsing, tolerance
+    math, and verdict emission without running the engine."""
+    baseline = load_summary(baseline_path)
+    same = compare(baseline, baseline)
+    degraded = {
+        m: {**rec,
+            **({"vs_baseline": rec["vs_baseline"] * 0.5}
+               if rec.get("vs_baseline") is not None else {}),
+            "value": (rec.get("value") or 0) * 0.5}
+        for m, rec in baseline.items()}
+    worse = compare(baseline, degraded)
+    ok = same["verdict"] == "pass" and worse["verdict"] == "fail"
+    return {"verdict": "pass" if ok else "fail", "mode": "smoke",
+            "baseline_file": baseline_path,
+            "self_comparison": same["verdict"],
+            "degraded_comparison": worse["verdict"],
+            "metrics": sorted(baseline)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff a bench run against the latest BENCH_r*.json")
+    ap.add_argument("--run", default=None, metavar="FILE",
+                    help="bench summary to check (BENCH_OUT file, "
+                         "captured stdout log, or BENCH_r wrapper)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file (default: latest BENCH_r*.json "
+                         "in the repo root)")
+    ap.add_argument("--tolerance", type=float,
+                    default=DEFAULT_TOLERANCE_PCT, metavar="PCT",
+                    help="default allowed vs_baseline drop, percent "
+                         f"(default {DEFAULT_TOLERANCE_PCT:g})")
+    ap.add_argument("--tolerance-for", action="append", default=[],
+                    metavar="NAME=PCT",
+                    help="per-query override; NAME is a full metric or "
+                         "a short config name (q55=25). Repeatable")
+    ap.add_argument("--allow-missing", action="store_true",
+                    help="metrics the run skipped (bench budget) warn "
+                         "instead of failing")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="also write the verdict JSON to this file")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-consistency mode (no engine run): "
+                         "baseline-vs-itself must pass, a degraded "
+                         "copy must fail")
+    args = ap.parse_args(argv)
+
+    baseline_path = args.baseline or latest_bench_file()
+    if baseline_path is None or not os.path.exists(baseline_path):
+        print(json.dumps({"verdict": "error",
+                          "error": "no BENCH_r*.json baseline found"}))
+        return 2
+
+    try:
+        if args.smoke:
+            verdict = smoke(baseline_path)
+        else:
+            if not args.run:
+                print(json.dumps({"verdict": "error",
+                                  "error": "--run FILE required "
+                                           "(or --smoke)"}))
+                return 2
+            overrides: Dict[str, float] = {}
+            for spec in args.tolerance_for:
+                name, _, pct = spec.partition("=")
+                overrides[name.strip()] = float(pct)
+            verdict = compare(load_summary(baseline_path),
+                              load_summary(args.run),
+                              default_pct=args.tolerance,
+                              overrides=overrides,
+                              allow_missing=args.allow_missing)
+            verdict["baseline_file"] = baseline_path
+            verdict["run_file"] = args.run
+    except (OSError, ValueError) as e:
+        print(json.dumps({"verdict": "error", "error": str(e)}))
+        return 2
+
+    text = json.dumps(verdict, indent=2)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text + "\n")
+    return 0 if verdict["verdict"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

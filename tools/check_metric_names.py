@@ -15,19 +15,26 @@ tools/check_tier1_time.py's time budget):
 - the family must end in a unit suffix: ``_total``, ``_seconds`` or
   ``_bytes``;
 - one family, one type: the same name registered as both a counter and
-  a gauge (anywhere in the tree) is an error.
+  a gauge (anywhere in the tree) is an error;
+- **doc drift** (``docs/observability.md``): every metric family the
+  doc names in backticks must exist in code (a registered family or an
+  exposition-only series from ``obs/exposition.py``), and every family
+  registered in code must be documented — renames and additions that
+  forget the doc fail CI, not a reader.
 
 Usage:
     python tools/check_metric_names.py [src_dir ...]   # default: presto_tpu/
+    python tools/check_metric_names.py --docs PATH | --no-docs
 """
 from __future__ import annotations
 
 import argparse
 import ast
+import fnmatch
 import os
 import re
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 _KINDS = ("counter", "gauge", "histogram")
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*(\*[a-z0-9_]*)*$")
@@ -81,15 +88,89 @@ def scan_file(path: str) -> Tuple[List[Tuple[str, str, int]], List[str]]:
     return out, []
 
 
+#: doc tokens that look like a metric family (after stripping any
+#: label/dotted suffix)
+_DOC_FAMILY = re.compile(r"^[a-z][a-z0-9_]*_(?:total|seconds|bytes)$")
+
+#: backticked doc tokens that share the unit-suffix shape but are SQL
+#: column names, not metric families
+_DOC_IGNORE = {"hbm_bytes", "peak_memory_bytes", "output_bytes",
+               "arg_bytes", "temp_bytes", "generated_code_bytes",
+               "mem_pool_peak_bytes"}
+
+
+def exposition_families(path: str) -> Set[str]:
+    """Literal sample families the Prometheus exposition constructs
+    directly (``family("node_up", ...)`` in obs/exposition.py) — real
+    scrape series that never pass through the registry, so the doc may
+    name them without a counter()/gauge() call site existing."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "family":
+            pattern = _name_pattern(node.args[0])
+            if pattern:
+                out.add(pattern)
+    return out
+
+
+def doc_families(doc_path: str) -> Set[str]:
+    """Backticked metric-family names in the doc: each `token` is
+    stripped of label/series suffixes (``.``, ``{``, ``_bucket`` etc.
+    stay — only families matching the unit-suffix shape count)."""
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    out: Set[str] = set()
+    for token in re.findall(r"`([^`\n]+)`", text):
+        fam = re.split(r"[.{\s(]", token.strip(), maxsplit=1)[0]
+        if fam not in _DOC_IGNORE \
+                and _DOC_FAMILY.match(fam.replace("*", "x")):
+            out.add(fam)
+    return out
+
+
+def check_doc_drift(doc_path: str, code_families: Set[str],
+                    expo_families: Set[str]) -> List[str]:
+    """Two-way diff: doc names must exist in code (registered family or
+    exposition series; f-string families compare by fnmatch), and every
+    registered family must appear in the doc."""
+    errors: List[str] = []
+    known = code_families | expo_families
+    documented = doc_families(doc_path)
+    for fam in sorted(documented):
+        if not any(fnmatch.fnmatch(fam, pat) or fam == pat
+                   for pat in known):
+            errors.append(f"{doc_path}: documents {fam!r} but no such "
+                          "metric family is registered in code")
+    for pat in sorted(code_families):
+        if pat in documented:
+            continue
+        if any(fnmatch.fnmatch(fam, pat) for fam in documented):
+            continue
+        errors.append(f"metric family {pat!r} is registered in code "
+                      f"but not documented in {doc_path}")
+    return errors
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("src", nargs="*", default=None,
                     help="source directories (default: presto_tpu/ "
                          "next to this script's repo root)")
+    ap.add_argument("--docs", default=None, metavar="PATH",
+                    help="observability doc to drift-check (default: "
+                         "docs/observability.md next to the repo root)")
+    ap.add_argument("--no-docs", action="store_true",
+                    help="skip the doc-drift check")
     args = ap.parse_args(argv)
-    roots = args.src or [os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "presto_tpu")]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = args.src or [os.path.join(repo, "presto_tpu")]
 
     errors: List[str] = []
     families: Dict[str, Tuple[str, str]] = {}   # family -> (kind, where)
@@ -119,6 +200,14 @@ def main(argv=None) -> int:
                             f"but as {prev[0]} at {prev[1]}")
                     elif prev is None:
                         families[family] = (kind, where)
+
+    doc_path = args.docs or os.path.join(repo, "docs",
+                                         "observability.md")
+    if not args.no_docs and os.path.exists(doc_path):
+        errors.extend(check_doc_drift(
+            doc_path, set(families),
+            exposition_families(os.path.join(
+                repo, "presto_tpu", "obs", "exposition.py"))))
 
     if errors:
         for e in errors:
