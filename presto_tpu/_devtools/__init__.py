@@ -1,0 +1,2 @@
+"""Developer-facing runtime checkers (never active in production paths
+unless explicitly enabled; see lockcheck.ENABLED)."""

@@ -1,0 +1,215 @@
+"""Runtime lock-order validator (the dynamic half of tools/analyze/locks.py).
+
+The engine runs five cooperating thread pools (scan prefetcher,
+local-exchange producers, taskexec fair scheduler, cluster retry loop,
+metrics/history sinks) whose lock discipline the static checker can only
+approximate — aliasing and cross-module call chains hide orders from the
+AST. This module records the ACTUAL acquisition edges taken at runtime:
+every instrumented lock pushes itself onto a per-thread held-stack, and
+acquiring lock B while holding lock A records the edge A->B. ``check()``
+then fails on
+
+- **cycles** in the observed edge graph (a real AB/BA inversion was
+  executed, even if the two orders ran on different threads and never
+  deadlocked in this run), and
+- **locks held across a jit dispatch** (``ops/jitcache._TimedEntry``
+  calls :func:`note_dispatch` before every cached-executable call; a
+  lock held there serializes every other query behind one query's
+  device work — the exact stall the fair scheduler exists to prevent).
+
+Gating: instrumentation is decided once at import via the
+``PRESTO_TPU_LOCKCHECK`` env var (``1``/``0``); when unset it is ON
+under pytest ("pytest" already imported) and OFF otherwise, so
+production lock sites (``checked_lock``/``checked_rlock``) cost exactly
+a plain ``threading.Lock``. The chaos/taskexec suites assert
+``GRAPH.check() == []`` after exercising the thread pools.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ENABLED", "GRAPH", "LockGraph", "checked_lock",
+           "checked_rlock", "note_dispatch"]
+
+_env = os.environ.get("PRESTO_TPU_LOCKCHECK")
+if _env is None:
+    #: on by default under pytest, off everywhere else
+    ENABLED = "pytest" in sys.modules
+else:
+    ENABLED = _env.strip().lower() not in ("0", "false", "off", "")
+
+
+class LockGraph:
+    """Observed lock-acquisition edges + violations, per graph instance
+    (the process uses :data:`GRAPH`; tests build private ones so seeded
+    inversions don't fail the suite-wide clean check)."""
+
+    def __init__(self):
+        self._local = threading.local()
+        # raw primitive lock: the graph guards itself and must never
+        # recurse into its own instrumentation
+        self._mu = threading.Lock()
+        #: (held_name, acquired_name) -> first-seen description
+        self.edges: Dict[Tuple[str, str], str] = {}
+        #: dispatch-under-lock records, appended as they happen
+        self.violations: List[str] = []
+
+    # -- held-stack plumbing (called from _CheckedLock) ----------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _acquired(self, name: str) -> None:
+        st = self._stack()
+        for held in st:
+            if held != name and (held, name) not in self.edges:
+                with self._mu:
+                    self.edges.setdefault(
+                        (held, name), f"{held} -> {name}")
+        st.append(name)
+
+    def _released(self, name: str) -> None:
+        st = self._stack()
+        # remove the innermost occurrence (re-entrant RLocks push twice)
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                return
+
+    # -- public API ----------------------------------------------------------
+    def lock(self, name: str) -> "_CheckedLock":
+        return _CheckedLock(name, threading.Lock(), self)
+
+    def rlock(self, name: str) -> "_CheckedLock":
+        return _CheckedLock(name, threading.RLock(), self)
+
+    def held(self) -> List[str]:
+        return list(self._stack())
+
+    def note_dispatch(self, what: str) -> None:
+        held = self._stack()
+        if held:
+            with self._mu:
+                self.violations.append(
+                    f"jit dispatch {what!r} while holding "
+                    f"lock(s) {sorted(set(held))} — device work must "
+                    f"never run under an engine lock")
+
+    def check(self) -> List[str]:
+        """Violation strings: recorded dispatch-under-lock events plus
+        every cycle in the observed acquisition-order graph."""
+        with self._mu:
+            out = list(self.violations)
+            adj: Dict[str, List[str]] = {}
+            for a, b in self.edges:
+                adj.setdefault(a, []).append(b)
+        state: Dict[str, int] = {}   # 0=visiting, 1=done
+        path: List[str] = []
+
+        def visit(n: str) -> Optional[List[str]]:
+            state[n] = 0
+            path.append(n)
+            for m in adj.get(n, ()):
+                if state.get(m) == 0:
+                    return path[path.index(m):] + [m]
+                if m not in state:
+                    cyc = visit(m)
+                    if cyc:
+                        return cyc
+            path.pop()
+            state[n] = 1
+            return None
+
+        seen_cycles = set()
+        for n in sorted(adj):
+            if n not in state:
+                cyc = visit(n)
+                if cyc:
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append("lock-order cycle: "
+                                   + " -> ".join(cyc))
+                    # keep scanning other components: everything still
+                    # on the aborted DFS path counts as finished so a
+                    # later visit can't index a cleared path
+                    state.update({k: 1 for k in path})
+                    state.update({k: 1 for k in cyc})
+                    path.clear()
+        return out
+
+    def reset(self) -> None:
+        with self._mu:
+            self.edges.clear()
+            self.violations.clear()
+
+
+class _CheckedLock:
+    """Lock/RLock wrapper feeding a :class:`LockGraph`. Supports the
+    subset of the lock protocol the engine (and ``threading.Condition``
+    over it) uses: acquire/release/context manager."""
+
+    __slots__ = ("name", "_inner", "_graph")
+
+    def __init__(self, name: str, inner, graph: LockGraph):
+        self.name = name
+        self._inner = inner
+        self._graph = graph
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._graph._acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._graph._released(self.name)
+
+    def __enter__(self) -> "_CheckedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        fn = getattr(self._inner, "locked", None)
+        if fn is not None:
+            return fn()
+        # RLock before Python 3.12 has no locked(): probe with a
+        # non-blocking acquire on the raw primitive (no graph edges —
+        # this is introspection, not an acquisition)
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+#: the process-wide graph instrumented engine locks feed
+GRAPH = LockGraph()
+
+
+def checked_lock(name: str):
+    """A ``threading.Lock`` — instrumented into :data:`GRAPH` when the
+    validator is enabled, a plain primitive lock otherwise."""
+    if not ENABLED:
+        return threading.Lock()
+    return GRAPH.lock(name)
+
+
+def checked_rlock(name: str):
+    if not ENABLED:
+        return threading.RLock()
+    return GRAPH.rlock(name)
+
+
+def note_dispatch(what: str) -> None:
+    """Called by ops/jitcache._TimedEntry before each cached-executable
+    dispatch; records a violation when any instrumented lock is held."""
+    GRAPH.note_dispatch(what)

@@ -24,10 +24,228 @@ classloader isolation, which Python does not need).
 """
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import Callable, Dict, Optional
 
 from .connectors.spi import CatalogManager
+
+
+# -- session-property registry -----------------------------------------------
+# The single declaration point for every session property the engine
+# reads (the reference's SystemSessionProperties.java role): name ->
+# type/default/doc plus an optional extra validator. SET SESSION on an
+# unknown or type-mismatched name raises a user-facing error instead of
+# silently latching a string no read site will ever consult, and the
+# static registry lint (tools/analyze/registries.py) cross-checks every
+# ``session.properties.get("...")``/``bool_property(...)`` literal in
+# the tree against this table — a typo'd property name fails CI, not a
+# user's dashboard.
+
+@dataclasses.dataclass(frozen=True)
+class SessionProperty:
+    name: str
+    type: str           # boolean | integer | double | varchar | duration
+    default: object     # documentation only; read sites supply defaults
+    doc: str
+    validator: Optional[Callable[[object], object]] = None
+
+
+class SessionPropertyError(ValueError):
+    """User-facing SET SESSION rejection (unknown name / bad type)."""
+
+    name = "INVALID_SESSION_PROPERTY"
+
+
+SESSION_PROPERTIES: Dict[str, SessionProperty] = {}
+
+
+def _sp(name: str, type_: str, default, doc: str,
+        validator: Optional[Callable] = None) -> None:
+    SESSION_PROPERTIES[name] = SessionProperty(name, type_, default, doc,
+                                               validator)
+
+
+def _valid_retry_policy(v):
+    p = str(v).upper()
+    if p not in ("TASK", "QUERY", "NONE"):
+        raise SessionPropertyError(
+            f"retry_policy must be TASK, QUERY or NONE, got {v!r}")
+    return p
+
+
+def _valid_duration(v):
+    from .exec.cluster import parse_duration_s
+    try:
+        parse_duration_s(v)
+    except ValueError as e:
+        raise SessionPropertyError(str(e)) from None
+    return v
+
+
+_sp("broadcast_join_row_limit", "integer", 4_000_000,
+    "build sides at or under this many estimated rows broadcast; "
+    "larger ones hash-partition")
+_sp("cluster_memory_limit", "integer", None,
+    "cluster-wide reservation cap in bytes; the coordinator memory "
+    "manager kills the largest query above it")
+_sp("dense_grouping", "boolean", True,
+    "allow the stats-bounded dense (scatter-path) GROUP BY plan")
+_sp("enable_dynamic_filtering", "boolean", True,
+    "build-side key bounds prune probe-side scans at runtime")
+_sp("exchange_failure_timeout_s", "double", 45.0,
+    "seconds an exchange client retries transport loss before failing "
+    "the upstream task")
+_sp("fair_scheduling", "boolean", True,
+    "time-slice concurrent queries through the device scheduler")
+_sp("fused_compact_floor", "integer", 1 << 17,
+    "skip fused-chain compaction below this batch capacity")
+_sp("fused_compact_window", "integer", 4,
+    "fused-chain liveness readbacks amortize over this many batches")
+_sp("fused_pipeline", "boolean", True,
+    "fuse filter->project->join chains into one jitted pipeline")
+_sp("grouped_execution", "boolean", True,
+    "run bucketed scans one lifespan at a time")
+_sp("probe_prefetch", "boolean", True,
+    "overlap probe-side host staging with device dispatch")
+_sp("profile", "boolean", False,
+    "bracket every jit dispatch and attribute device time per operator")
+_sp("push_partial_aggregation_through_join", "boolean", True,
+    "eager aggregation below joins when the grouping key covers the "
+    "probe join key")
+_sp("query_max_memory", "integer", None,
+    "per-query memory pool limit in bytes (spill beyond it)")
+_sp("query_max_run_time", "duration", None,
+    "wall-clock deadline (e.g. 30s, 500ms); the query aborts past it",
+    _valid_duration)
+_sp("query_retry_attempts", "integer", 1,
+    "whole-query re-runs under retry_policy=QUERY")
+_sp("retry_policy", "varchar", "TASK",
+    "fault-tolerance mode: TASK, QUERY or NONE", _valid_retry_policy)
+_sp("role", "varchar", None,
+    "active role for access-control checks (SET ROLE)")
+_sp("scan_cache", "boolean", True,
+    "serve repeated scans from the device-resident scan cache")
+_sp("scan_pad_batches", "boolean", True,
+    "pad ragged final split chunks to the stream's capacity bucket")
+_sp("scan_prefetch", "boolean", True,
+    "decode+stage splits on background threads ahead of the consumer")
+_sp("scan_prefetch_depth", "integer", 4,
+    "buffered batches per split in the prefetch pipeline")
+_sp("scan_threads", "integer", 2,
+    "background decode threads per scan")
+_sp("speculative_execution", "boolean", True,
+    "duplicate straggler tasks on another node, first finished wins")
+_sp("spill_partitions", "integer", 16,
+    "hash partitions for spill-to-host aggregation")
+_sp("spill_path", "varchar", None,
+    "directory for second-tier disk spill pages")
+_sp("spill_to_disk_bytes", "integer", 4 << 30,
+    "staged host bytes beyond this flush to compressed disk pages")
+_sp("stats_bounded_grouping", "boolean", True,
+    "attach hard per-key bounds from connector stats to aggregations")
+_sp("task_concurrency", "integer", 1,
+    "parallel driver threads per local pipeline")
+_sp("task_retry_attempts", "integer", 2,
+    "per-task retry budget under retry_policy=TASK")
+_sp("task_retry_backoff_s", "double", 0.05,
+    "base backoff between task retry attempts (exponential)")
+
+_TRUE = ("true", "1", "on", "yes")
+_FALSE = ("false", "0", "off", "no")
+
+
+def validate_session_property(name: str, value):
+    """Coerced canonical value for ``SET SESSION name = value``; raises
+    :class:`SessionPropertyError` on an unknown name or a value that
+    does not parse as the declared type."""
+    sp = SESSION_PROPERTIES.get(name)
+    if sp is None:
+        raise SessionPropertyError(
+            f"unknown session property {name!r} "
+            f"(known: {', '.join(sorted(SESSION_PROPERTIES))})")
+
+    def bad(detail: str = ""):
+        return SessionPropertyError(
+            f"session property {name!r} expects a {sp.type}, "
+            f"got {value!r}" + (f" ({detail})" if detail else ""))
+
+    out = value
+    if sp.type == "boolean":
+        if isinstance(value, bool):
+            out = value
+        elif isinstance(value, str) \
+                and value.strip().lower() in _TRUE + _FALSE:
+            out = value.strip().lower() in _TRUE
+        else:
+            raise bad()
+    elif sp.type == "integer":
+        if isinstance(value, bool):
+            raise bad()
+        elif isinstance(value, int):
+            out = value
+        elif isinstance(value, str):
+            try:
+                out = int(value.strip())
+            except ValueError:
+                raise bad() from None
+        else:
+            raise bad()
+    elif sp.type == "double":
+        if isinstance(value, bool):
+            raise bad()
+        elif isinstance(value, (int, float)):
+            out = float(value)
+        elif isinstance(value, str):
+            try:
+                out = float(value.strip())
+            except ValueError:
+                raise bad() from None
+        else:
+            raise bad()
+    elif sp.type == "varchar":
+        if not isinstance(value, str):
+            raise bad()
+    elif sp.type == "duration":
+        if not isinstance(value, (str, int, float)) \
+                or isinstance(value, bool):
+            raise bad()
+    if sp.validator is not None:
+        out = sp.validator(out)
+    return out
+
+
+# -- config-file key registry ------------------------------------------------
+# Every literal read off a parsed *.properties dict (NodeConfig,
+# catalog/connector factories, plugin loader) must appear here — the
+# static registry lint (tools/analyze/registries.py) cross-checks the
+# ``props.get("...")`` call sites, so a typo'd key in code fails CI
+# instead of silently reading the default forever. Globs cover
+# namespaced families (``session.*`` defaults).
+
+CONFIG_KEYS: Dict[str, str] = {
+    "node.id": "stable node identity (defaults to worker-<port>)",
+    "coordinator": "true/false — run the coordinator role",
+    "http-server.http.port": "statement/worker HTTP port (0 = ephemeral)",
+    "discovery.uri": "coordinator discovery endpoint workers announce to",
+    "session.catalog": "default catalog for new sessions",
+    "session.schema": "default schema for new sessions",
+    "session.*": "session-property defaults (validated against "
+                 "SESSION_PROPERTIES at boot)",
+    "scan-cache.max-bytes": "process-wide device scan-cache resident "
+                            "limit (deliberately not a session prop)",
+    "failpoints": "deterministic fault-injection spec "
+                  "(exec/failpoints.py grammar)",
+    "connector.name": "catalog properties: which connector factory",
+    "tpch.scale-factor": "tpch catalog scale factor",
+    "tpcds.scale-factor": "tpcds catalog scale factor",
+    "orc.root": "orc catalog data directory",
+    "parquet.root": "parquet catalog data directory",
+    "sqlite.path": "sqlite catalog database file",
+    "path": "sqlite catalog database file (legacy alias)",
+    "plugin.modules": "comma-separated plugin modules to import",
+    "plugin.dir": "directory of plugin modules to load",
+}
 
 
 def parse_properties(path: str) -> Dict[str, str]:
@@ -193,7 +411,11 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
         FAILPOINTS.configure_from_spec(cfg.failpoints)
     runner = LocalRunner(catalogs=catalogs, catalog=cfg.catalog,
                          schema=cfg.schema)
-    runner.session.properties.update(cfg.session_defaults)
+    # session.<name> defaults go through the same registry gate as SET
+    # SESSION: a typo'd default fails the boot, not a dashboard
+    runner.session.properties.update(
+        {k: validate_session_property(k, v)
+         for k, v in cfg.session_defaults.items()})
     srv = PrestoTpuServer(
         runner=runner, host=host,
         port=cfg.http_port if port is None else port,

@@ -131,6 +131,12 @@ class HeartbeatFailureDetector:
 
     def stop(self) -> None:
         self._stop.set()
+        # bounded join (the loop notices _stop within one interval;
+        # the in-flight ping holds it at most its 5s timeout): a
+        # heartbeat that outlives its runner keeps writing the node
+        # registry through teardown (locks/unjoined-thread)
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
 
     def ping(self, url: str) -> Optional[dict]:
         """The worker's ``/v1/info`` doc on success (always truthy),
@@ -182,6 +188,10 @@ class ClusterMemoryManager:
 
     def stop(self) -> None:
         self._stop.set()
+        # join like the failure detector: the kill loop must not issue
+        # DELETEs against a runner that already tore down its workers
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
 
     def poll_once(self) -> Dict[str, int]:
         totals: Dict[str, int] = {}

@@ -85,6 +85,9 @@ class DistributedExecutor(_Executor):
         self.n = mesh.shape[self.axis]
         self._row_sharding = NamedSharding(mesh, P(self.axis))
         self._replicated = NamedSharding(mesh, P())
+        #: memoized all-gather identity (see _replicate_device): one
+        #: trace per executor, not one per broadcast build side
+        self._replicate_jit = None
 
     # -- sharding helpers ----------------------------------------------------
     def _shard_rows(self, batch: Batch) -> Batch:
@@ -101,9 +104,28 @@ class DistributedExecutor(_Executor):
             for i in range(n_in))
         out_specs = (P(self.axis) if n_out == 1
                      else tuple(P(self.axis) for _ in range(n_out)))
-        return jax.jit(shard_map(
-            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-            **{_SHARD_MAP_CHECK_KW: False}))
+        # registered entry, not a raw jax.jit: every shard_map program
+        # is an executable like any jitcache kernel — compiles and
+        # (profiled) device time land in obs.profiler.EXECUTABLES
+        # instead of being invisible to the PR 6 cost plane. The static
+        # key is the defining CALL SITE (code object) + specs:
+        # anonymous lambdas from different sites must not collapse into
+        # one 'smap:<lambda>' record (that would sum unrelated
+        # operators' compiles/FLOPs into one executables row), while
+        # re-builds of the same program share one record instead of
+        # churning the registry query after query
+        from ..ops.jitcache import _TimedEntry
+        label = getattr(fn, "__qualname__", None) \
+            or getattr(fn, "__name__", "fn")
+        code = getattr(fn, "__code__", None)
+        site = ((code.co_filename, code.co_firstlineno)
+                if code is not None else id(fn))
+        return _TimedEntry(
+            f"smap:{label.split('.<locals>.')[-1]}",
+            jax.jit(shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs, **{_SHARD_MAP_CHECK_KW: False})),
+            (site, in_specs, out_specs))
 
     def _shard_live_max(self, batch: Batch) -> int:
         """Max live rows on any shard (host sync) — sizes compactions."""
@@ -119,8 +141,13 @@ class DistributedExecutor(_Executor):
         insert the all-gather over ICI (the FIXED_BROADCAST exchange,
         reference operator/ExchangeClient.java pulling a broadcast buffer —
         here device-to-device only)."""
-        return jax.jit(lambda b: b,
-                       out_shardings=self._replicated)(batch)
+        fn = self._replicate_jit
+        if fn is None:
+            from ..ops.jitcache import _TimedEntry
+            fn = self._replicate_jit = _TimedEntry(
+                "replicate_device",
+                jax.jit(lambda b: b, out_shardings=self._replicated))
+        return fn(batch)
 
     def _repartitioner(self, key_cols: Sequence[int]):
         """Quota-compacted hash exchange driver: one cheap collective
@@ -187,45 +214,8 @@ class DistributedExecutor(_Executor):
         valids: List[List[np.ndarray]] = [[] for _ in range(ncols)]
         masks: List[np.ndarray] = []
         vocabs: List[Optional[Tuple[str, ...]]] = [None] * ncols
-        for p in parts:
-            if p is None:
-                for ci in range(ncols):
-                    dt = schema.types[ci].storage_dtype
-                    datas[ci].append(np.zeros(cap, dtype=np.dtype(dt)))
-                    valids[ci].append(np.zeros(cap, dtype=bool))
-                masks.append(np.zeros(cap, dtype=bool))
-                continue
-            from ..batch import unify_dictionaries
-            for ci, c in enumerate(p.columns):
-                # explicit device_get: scan staging deliberately rounds
-                # through the host to stack per-shard chunks; implicit-
-                # transfer guards must not see it as a leak
-                d = np.asarray(jax.device_get(c.data))
-                v = np.asarray(jax.device_get(c.validity))
-                if c.dictionary is not None:
-                    if vocabs[ci] is None:
-                        vocabs[ci] = c.dictionary
-                    elif vocabs[ci] != c.dictionary:
-                        # remap codes into the accumulated vocabulary
-                        merged, remaps = unify_dictionaries([
-                            _host_col(c.type, vocabs[ci]),
-                            c])
-                        vocabs[ci] = merged
-                        # remap previously collected shards
-                        prev_map = remaps[0]
-                        datas[ci] = [
-                            _apply_remap(a, prev_map) for a in datas[ci]]
-                        d = _apply_remap(d, remaps[1])
-                pad = cap - d.shape[0]
-                if pad:
-                    d = np.pad(d, (0, pad))
-                    v = np.pad(v, (0, pad))
-                datas[ci].append(d)
-                valids[ci].append(v)
-            m = np.asarray(jax.device_get(p.row_mask))
-            if cap - m.shape[0]:
-                m = np.pad(m, (0, cap - m.shape[0]))
-            masks.append(m)
+        self._stage_parts(parts, schema, cap, datas, valids,
+                          masks, vocabs)
         cols = []
         for ci in range(ncols):
             data = np.concatenate(datas[ci])
@@ -237,6 +227,50 @@ class DistributedExecutor(_Executor):
                 vocabs[ci]))
         mask = jax.device_put(np.concatenate(masks), self._row_sharding)
         return Batch(schema, cols, mask)
+
+    def _stage_parts(self, parts, schema: Schema, cap: int,
+                     datas, valids, masks, vocabs) -> None:
+        """Fetch every shard's columns to the host (explicit
+        device_get: staging deliberately rounds through the host to
+        stack per-shard chunks — one device-sync span brackets the whole round so the stall is observable)."""
+        ncols = len(schema)
+        with TRACER.span("device-sync", what="scan-stage"):
+            for p in parts:
+                if p is None:
+                    for ci in range(ncols):
+                        dt = schema.types[ci].storage_dtype
+                        datas[ci].append(np.zeros(cap, dtype=np.dtype(dt)))
+                        valids[ci].append(np.zeros(cap, dtype=bool))
+                    masks.append(np.zeros(cap, dtype=bool))
+                    continue
+                from ..batch import unify_dictionaries
+                for ci, c in enumerate(p.columns):
+                    d = np.asarray(jax.device_get(c.data))
+                    v = np.asarray(jax.device_get(c.validity))
+                    if c.dictionary is not None:
+                        if vocabs[ci] is None:
+                            vocabs[ci] = c.dictionary
+                        elif vocabs[ci] != c.dictionary:
+                            # remap codes into the accumulated vocabulary
+                            merged, remaps = unify_dictionaries([
+                                _host_col(c.type, vocabs[ci]),
+                                c])
+                            vocabs[ci] = merged
+                            # remap previously collected shards
+                            prev_map = remaps[0]
+                            datas[ci] = [
+                                _apply_remap(a, prev_map) for a in datas[ci]]
+                            d = _apply_remap(d, remaps[1])
+                    pad = cap - d.shape[0]
+                    if pad:
+                        d = np.pad(d, (0, pad))
+                        v = np.pad(v, (0, pad))
+                    datas[ci].append(d)
+                    valids[ci].append(v)
+                m = np.asarray(jax.device_get(p.row_mask))
+                if cap - m.shape[0]:
+                    m = np.pad(m, (0, cap - m.shape[0]))
+                masks.append(m)
 
     def _ValuesNode(self, node: ValuesNode) -> Iterator[Batch]:
         for b in super()._ValuesNode(node):
@@ -513,8 +547,9 @@ class DistributedExecutor(_Executor):
                 lambda b: max_multiplicity(
                     build_sorted(b, rkeys))[None].astype(jnp.int64), 1,
                 replicated_in=(0,) if replicated else ())
-            bound = int(np.asarray(
-                jax.device_get(mult_fn(build_side))).max())
+            with TRACER.span("device-sync", what="join-multiplicity"):
+                bound = int(np.asarray(
+                    jax.device_get(mult_fn(build_side))).max())
             if bound <= self.SKEW_MATCH_LIMIT:
                 maxk_static = bucket_capacity(max(bound, 1), minimum=1)
             else:
@@ -538,10 +573,11 @@ class DistributedExecutor(_Executor):
             if maxk_static is not None:
                 maxk = maxk_static
             elif count_fn is not None:
-                maxk = bucket_capacity(
-                    max(int(np.asarray(jax.device_get(
-                        count_fn(probe, build_side))).max()), 1),
-                    minimum=1)
+                with TRACER.span("device-sync", what="join-match-count"):
+                    maxk = bucket_capacity(
+                        max(int(np.asarray(jax.device_get(
+                            count_fn(probe, build_side))).max()), 1),
+                        minimum=1)
             fn = join_fns.get(maxk)
             if fn is None:
                 if residual_outer:
@@ -613,7 +649,9 @@ class DistributedExecutor(_Executor):
             lambda f: max_multiplicity(
                 build_sorted(f, fkeys))[None].astype(jnp.int64), 1,
             replicated_in=(0,))
-        bound = int(np.asarray(jax.device_get(mult_fn(build_rep))).max())
+        with TRACER.span("device-sync", what="semi-multiplicity"):
+            bound = int(np.asarray(
+                jax.device_get(mult_fn(build_rep))).max())
         res_maxk = (bucket_capacity(max(bound, 1), minimum=1)
                     if bound <= self.SKEW_MATCH_LIMIT else None)
         count_fn = (None if res_maxk is not None else self._smap(
@@ -621,9 +659,14 @@ class DistributedExecutor(_Executor):
             replicated_in=(1,)))
         fns: Dict[int, object] = {}
         for b in self.run(node.source):
-            maxk = res_maxk if res_maxk is not None else bucket_capacity(
-                max(int(np.asarray(jax.device_get(
-                    count_fn(b, build_rep))).max()), 1), minimum=1)
+            if res_maxk is not None:
+                maxk = res_maxk
+            else:
+                with TRACER.span("device-sync", what="semi-match-count"):
+                    maxk = bucket_capacity(
+                        max(int(np.asarray(jax.device_get(
+                            count_fn(b, build_rep))).max()), 1),
+                        minimum=1)
             fn = fns.get(maxk)
             if fn is None:
                 def local_mark(p: Batch, f: Batch, _k=maxk) -> Batch:

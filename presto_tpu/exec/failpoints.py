@@ -45,7 +45,25 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["FailpointError", "FailpointRegistry", "FAILPOINTS"]
+__all__ = ["FailpointError", "FailpointRegistry", "FAILPOINTS", "SITES"]
+
+#: declared failpoint sites: every production ``FAILPOINTS.hit(...)``
+#: call names one of these, and arming a site outside this table via
+#: the spec grammar raises at parse time instead of silently never
+#: firing (a typo'd chaos config that injects nothing "passes" every
+#: recovery test it was meant to exercise). The static registry lint
+#: (tools/analyze/registries.py) cross-checks this table against the
+#: hit() call sites and the docs/robustness.md catalog two-way.
+SITES: Dict[str, str] = {
+    "worker.task_run": "worker begins executing a task attempt "
+                       "(server/worker.py)",
+    "exchange.pull": "exchange client pulls a page from an upstream "
+                     "task (server/worker.py)",
+    "heartbeat.ping": "coordinator failure-detector pings a worker "
+                      "/v1/info (exec/cluster.py)",
+    "scan.decode": "scan pipeline decodes one split batch, before "
+                   "staging (exec/scancache.py)",
+}
 
 
 class FailpointError(RuntimeError):
@@ -99,9 +117,13 @@ class FailpointRegistry:
     """Process-wide named-failpoint table. ``hit`` is the production
     call site; everything else is the test/config API."""
 
-    def __init__(self):
+    def __init__(self, sites: Optional[Dict[str, str]] = None):
         self._lock = threading.Lock()
         self._rules: Dict[str, List[_Rule]] = {}
+        #: when set, configure() rejects sites outside this table (the
+        #: process-wide registry passes SITES; unit-test registries that
+        #: exercise the rule machinery on synthetic names pass None)
+        self._sites = sites
 
     # -- configuration (test API) --------------------------------------------
     def configure(self, site: str, action: str = "error",
@@ -112,6 +134,10 @@ class FailpointRegistry:
                   callback: Optional[Callable] = None) -> None:
         """Arm one rule on ``site`` (appends — multiple rules per site
         evaluate in configuration order)."""
+        if self._sites is not None and site not in self._sites:
+            raise ValueError(
+                f"unknown failpoint site {site!r} — it would never "
+                f"fire (registered: {sorted(self._sites)})")
         rule = _Rule(site, action, message, sleep_s, times, skip,
                      probability, match, seed, callback)
         with self._lock:
@@ -200,8 +226,8 @@ class FailpointRegistry:
                 raise FailpointError(f"failpoint {site}: {r.message}")
 
 
-#: the process-wide registry
-FAILPOINTS = FailpointRegistry()
+#: the process-wide registry (site names validated against SITES)
+FAILPOINTS = FailpointRegistry(sites=SITES)
 
 _env_spec = os.environ.get("PRESTO_TPU_FAILPOINTS")
 if _env_spec:

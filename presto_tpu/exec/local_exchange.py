@@ -27,6 +27,7 @@ from __future__ import annotations
 import contextvars
 import queue as _queue
 import threading
+import time
 from typing import Callable, Iterator, List, Optional, Sequence
 
 from ..batch import Batch
@@ -51,6 +52,9 @@ class LocalExchange:
         self._rr = 0
         self._failed: Optional[BaseException] = None
         self._closed = threading.Event()
+        #: producer thread (exchange_source) — joined by close() so the
+        #: subplan driver can't outlive the consumer that aborted it
+        self._producer: Optional[threading.Thread] = None
 
     # -- producer side -------------------------------------------------------
     def push(self, batch: Batch, producer: int = 0) -> None:
@@ -79,7 +83,11 @@ class LocalExchange:
             self._put(c, _DONE, force=True)
 
     def close(self) -> None:
-        """Consumer-side abort: unblock producers (e.g. LIMIT satisfied)."""
+        """Consumer-side abort: unblock producers (e.g. LIMIT satisfied)
+        AND any consumer still blocked in ``get`` (each queue gets a
+        terminal DONE after the drain), then join the producer thread —
+        an orphaned producer keeps driving the upstream subplan and
+        touching shared state through teardown."""
         self._closed.set()
         for q in self._queues:
             try:
@@ -87,6 +95,27 @@ class LocalExchange:
                     q.get_nowait()
             except _queue.Empty:
                 pass
+            try:
+                q.put_nowait(_DONE)
+            except _queue.Full:
+                pass
+        if self._producer is not None and self._producer.is_alive():
+            # bounded: the producer notices _closed within one 0.1s put
+            # timeout; anything longer is upstream compute finishing
+            self._producer.join(timeout=5.0)
+
+    def start_producer(self, produce: Callable[[], None]) -> None:
+        """Run ``produce`` on an owned daemon thread; close() joins it.
+        Runs in a copy of the caller's context: the profile flag
+        (obs/profiler._ACTIVE) and trace parentage must follow the
+        pipeline onto its producer thread — a profiled query's join
+        kernels run HERE, and losing the contextvar would silently drop
+        their device-time attribution (per-operator scopes still re-set
+        themselves inside this thread via StatsCollector.wrap)."""
+        ctx = contextvars.copy_context()
+        self._producer = threading.Thread(target=ctx.run,
+                                          args=(produce,), daemon=True)
+        self._producer.start()
 
     def _put(self, c: int, item, force: bool = False) -> None:
         while not self._closed.is_set():
@@ -142,15 +171,7 @@ def exchange_source(batches: Iterator[Batch], mode: str, n_consumers: int,
                 close()
         ex.finish()
 
-    # run in a copy of the caller's context: the profile flag
-    # (obs/profiler._ACTIVE) and trace parentage must follow the
-    # pipeline onto its producer thread — a profiled query's join
-    # kernels run HERE, and losing the contextvar would silently drop
-    # their device-time attribution (per-operator scopes still re-set
-    # themselves inside this thread via StatsCollector.wrap)
-    ctx = contextvars.copy_context()
-    t = threading.Thread(target=ctx.run, args=(produce,), daemon=True)
-    t.start()
+    ex.start_producer(produce)
     return ex
 
 
@@ -181,12 +202,15 @@ def parallel_drivers(batches: Iterator[Batch],
         finally:
             out.put(("done", None))
 
+    drivers: List[threading.Thread] = []
     for c in range(concurrency):
         # one context copy per driver (a Context can't be entered twice
         # concurrently) — same propagation contract as exchange_source
         ctx = contextvars.copy_context()
-        threading.Thread(target=ctx.run, args=(drive, c),
-                         daemon=True).start()
+        t = threading.Thread(target=ctx.run, args=(drive, c),
+                             daemon=True)
+        drivers.append(t)
+        t.start()
     done = 0
     try:
         while done < concurrency:
@@ -197,5 +221,17 @@ def parallel_drivers(batches: Iterator[Batch],
             yield item
     finally:
         ex.close()
+        # early exit (LIMIT / generator closed): drivers may be blocked
+        # on a full ``out`` — drain it until they notice the closed
+        # exchange, then join (bounded; normal path joins immediately)
+        deadline = time.monotonic() + 5.0
+        while any(t.is_alive() for t in drivers) \
+                and time.monotonic() < deadline:
+            try:
+                out.get_nowait()
+            except _queue.Empty:
+                time.sleep(0.01)
+        for t in drivers:
+            t.join(timeout=1.0)
     if errors:
         raise errors[0]

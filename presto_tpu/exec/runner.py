@@ -63,8 +63,11 @@ class LocalRunner:
         self._query_seq = 0
         #: query id -> live StatsCollector (the /v1/query/{id} surface)
         self.live_stats: Dict[str, object] = {}
-        import threading
-        self._state_lock = threading.Lock()
+        # checked_lock: the cluster plane acquires this lock too (query
+        # registration/log), so its edges belong in the runtime
+        # lock-order graph (_devtools/lockcheck.py)
+        from .._devtools.lockcheck import checked_lock
+        self._state_lock = checked_lock("runner.state")
 
     # -- public API -----------------------------------------------------------
     def execute(self, sql: str,
@@ -373,7 +376,13 @@ class LocalRunner:
                 [(k, str(v)) for k, v in
                  sorted(self.session.properties.items())])
         if isinstance(stmt, A.SetSession):
-            value = _literal_value(stmt.value)
+            # validate against the declared registry (config.py): an
+            # unknown or type-mismatched property fails the statement
+            # instead of silently latching a string no read site will
+            # ever consult
+            from ..config import validate_session_property
+            value = validate_session_property(
+                stmt.name, _literal_value(stmt.value))
             self.session.properties[stmt.name] = value
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
         if isinstance(stmt, A.ResetSession):

@@ -59,6 +59,7 @@ from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 
+from .._devtools.lockcheck import checked_lock, checked_rlock
 from ..batch import Batch, bucket_capacity
 from ..connectors import spi
 from ..memory import QueryMemoryPool, batch_device_bytes
@@ -108,7 +109,7 @@ class ScanCache:
     def __init__(self, limit_bytes: int = DEFAULT_CACHE_BYTES):
         self.pool = QueryMemoryPool(limit_bytes)
         self._entries: "OrderedDict[Tuple, _Entry]" = OrderedDict()
-        self._lock = threading.RLock()
+        self._lock = checked_rlock("scancache.entries")
 
     # -- keying ---------------------------------------------------------------
     @staticmethod
@@ -287,7 +288,7 @@ class _PadTracker:
     __slots__ = ("_lock", "_max", "ceiling")
 
     def __init__(self, ceiling: int):
-        self._lock = threading.Lock()
+        self._lock = checked_lock("scancache.pad")
         self._max = 0
         self.ceiling = ceiling
 

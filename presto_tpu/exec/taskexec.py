@@ -72,7 +72,11 @@ class DeviceScheduler:
     """One per process (one device); tasks round through it."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # checked_lock: acquisition edges feed the runtime lock-order
+        # validator under pytest (_devtools/lockcheck.py); plain Lock
+        # in production
+        from .._devtools.lockcheck import checked_lock
+        self._lock = checked_lock("taskexec.scheduler")
         self._cv = threading.Condition(self._lock)
         self._tasks: List[TaskHandle] = []
         self._waiting: List[TaskHandle] = []

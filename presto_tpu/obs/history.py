@@ -40,14 +40,15 @@ class QueryHistory:
     """Bounded store of final per-query records (dicts)."""
 
     def __init__(self, max_records: int = 1000):
+        from .._devtools.lockcheck import checked_lock
         self._ring: deque = deque(maxlen=max_records)
-        self._lock = threading.Lock()
+        self._lock = checked_lock("history.ring")
         self.sink_path: Optional[str] = None
         self.slow_threshold_s: Optional[float] = None
         #: rotate the sink when it passes this size (0/None = unbounded,
         #: the pre-rotation behaviour, for tests that diff whole files)
         self.max_sink_bytes: Optional[int] = 64 << 20
-        self._sink_lock = threading.Lock()
+        self._sink_lock = checked_lock("history.sink")
         # records written to the current sink file / living in the .1
         # generation — the .1 count is what one more rotation drops
         self._sink_records = 0
@@ -56,20 +57,24 @@ class QueryHistory:
     def configure(self, sink_path: Optional[str] = None,
                   slow_threshold_s: Optional[float] = None,
                   max_sink_bytes: Optional[int] = None) -> None:
-        if sink_path is not None:
-            self.sink_path = sink_path
-            # resuming onto files a previous process wrote: seed the
-            # record counts from what's on disk, so the FIRST rotation
-            # after a restart still attributes the dropped generation
-            # correctly (one line scan at configure time, never per add)
-            with self._sink_lock:
+        # the whole reconfiguration happens under the sink lock: a
+        # concurrent add() must never observe a half-switched sink
+        # (new path with the old generation's record counts)
+        with self._sink_lock:
+            if sink_path is not None:
+                self.sink_path = sink_path
+                # resuming onto files a previous process wrote: seed the
+                # record counts from what's on disk, so the FIRST
+                # rotation after a restart still attributes the dropped
+                # generation correctly (one line scan at configure time,
+                # never per add)
                 self._sink_records = self._count_lines(sink_path)
                 self._rotated_records = self._count_lines(
                     sink_path + ".1")
-        if slow_threshold_s is not None:
-            self.slow_threshold_s = slow_threshold_s
-        if max_sink_bytes is not None:
-            self.max_sink_bytes = int(max_sink_bytes) or None
+            if slow_threshold_s is not None:
+                self.slow_threshold_s = slow_threshold_s
+            if max_sink_bytes is not None:
+                self.max_sink_bytes = int(max_sink_bytes) or None
 
     @staticmethod
     def _count_lines(path: str) -> int:

@@ -19,6 +19,8 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+from .._devtools.lockcheck import checked_lock
+
 _INF = float("inf")
 
 #: default histogram bucket upper bounds (seconds-flavoured exponential
@@ -152,7 +154,10 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        # registry-level lock is order-validated under pytest; the hot
+        # per-metric leaf locks (Counter/Gauge/Histogram) stay plain —
+        # they never acquire anything else
+        self._lock = checked_lock("metrics.registry")
 
     def _get(self, name: str, cls):
         m = self._metrics.get(name)
@@ -253,7 +258,7 @@ class TaskRegistry:
     def __init__(self, max_tasks: int = 1000):
         self._tasks: "OrderedDict[str, Dict]" = OrderedDict()
         self._max = max_tasks
-        self._lock = threading.Lock()
+        self._lock = checked_lock("metrics.tasks")
 
     def update(self, task_id: str, **fields) -> None:
         evicted = 0
@@ -304,7 +309,7 @@ class NodeRegistry:
 
     def __init__(self):
         self._nodes: Dict[str, Dict] = {}
-        self._lock = threading.Lock()
+        self._lock = checked_lock("metrics.nodes")
 
     def update(self, node_id: str, seen: bool = True, drop=(),
                **fields) -> None:

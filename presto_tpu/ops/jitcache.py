@@ -19,6 +19,7 @@ from typing import Optional, Sequence
 
 import jax
 
+from .._devtools import lockcheck as _lockcheck
 from ..obs import profiler as _prof
 from ..obs.metrics import REGISTRY
 from ..obs.trace import TRACER
@@ -53,6 +54,11 @@ class _TimedEntry:
         self.record = _prof.EXECUTABLES.register(name, key)
 
     def __call__(self, *args):
+        if _lockcheck.ENABLED:
+            # an engine lock held across a device dispatch serializes
+            # every other query behind this one's kernels — the runtime
+            # lock validator fails the suite on it
+            _lockcheck.note_dispatch(self.name)
         rec = self.record
         if rec.evicted:
             _prof.EXECUTABLES.readmit(rec)

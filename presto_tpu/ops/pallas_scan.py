@@ -82,8 +82,12 @@ def _imap(i):
     return (jnp.asarray(i, jnp.int32), jnp.int32(0))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def _cumsum_tiled(x2d: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    # deliberately NOT jitted here: every engine call site reaches this
+    # inside an already-jitted kernel (ops/aggregation.py group-by),
+    # where an inner jax.jit is inlined anyway — a raw jit wrapper
+    # would only create an executable invisible to ops/jitcache
+    # (tracing/raw-jit) for the eager test-only path
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     n = x2d.shape[0] // R

@@ -1,0 +1,62 @@
+"""Red fixture: lock-discipline violations for tools/analyze/locks.py."""
+import threading
+
+SHARED = {}
+
+
+class A:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def ab(self):
+        with self._la:
+            with self._lb:        # edge la -> lb
+                pass
+
+    def ba(self):
+        with self._lb:
+            with self._la:        # edge lb -> la: cycle
+                pass
+
+    def unlocked_write(self):
+        SHARED["k"] = 1           # unlocked-global-write
+
+    def locked_write_is_fine(self):
+        with self._la:
+            SHARED["k"] = 2
+
+
+class Looper:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()          # unjoined-thread: no join anywhere
+
+
+def fire_and_forget():
+    threading.Thread(target=print, daemon=True).start()   # unjoined
+
+
+def string_join_does_not_count(names):
+    t = threading.Thread(target=print, daemon=True)       # unjoined:
+    t.start()                                             # str.join on
+    return ", ".join(names)                               # the next line
+                                                          # must not mask it
+
+
+def looped_join_counts(n):
+    ts = [threading.Thread(target=print, daemon=True) for _ in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=1.0)                               # ok

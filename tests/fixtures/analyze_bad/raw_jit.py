@@ -1,0 +1,24 @@
+"""Red fixture: jax.jit entry points that bypass ops/jitcache."""
+import functools
+
+import jax
+
+
+def make_kernel(scale):
+    return jax.jit(lambda b: b * scale)       # raw-jit: bare call
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scaled(x, k):                             # raw-jit: partial decorator
+    return x * k
+
+
+def sync_without_span(x):
+    y = jax.device_get(x)                     # unbracketed-sync
+    x.block_until_ready()                     # unbracketed-sync
+    return y
+
+
+def sync_with_span(x, TRACER):
+    with TRACER.span("device-sync", what="fixture"):
+        return jax.device_get(x)              # properly bracketed — ok

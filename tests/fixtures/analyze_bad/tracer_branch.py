@@ -1,0 +1,48 @@
+"""Red fixture: every trace-safety sin in one file. NEVER imported —
+tests/test_analyze.py asserts tools/analyze/tracing.py flags each."""
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def branch_on_tracer(x):
+    if x > 0:                      # tracer-branch: host `if` on arg
+        return x + 1
+    return x - 1
+
+
+def loop_on_tracer(x):
+    total = x * 2                  # taint propagates through assignment
+    while total < 10:              # tracer-branch: host `while`
+        total = total + 1
+    return total
+
+
+loop_jit = jax.jit(loop_on_tracer)
+
+
+@jax.jit
+def concretize(x):
+    return float(x) + x.item() + bool(x)   # tracer-branch x3
+
+
+@jax.jit
+def frozen_random(x):
+    # nondeterminism: evaluated once at trace time, constant thereafter
+    return x + time.time() + random.random() + np.random.rand()
+
+
+@jax.jit
+def static_uses_are_fine(x, flag):
+    # none of these may be flagged: structure reads are static
+    if x is None:
+        return jnp.zeros(())
+    if len(x.shape) > 1:
+        return x.sum()
+    if x.dtype == jnp.int32:
+        return x * 2
+    return x

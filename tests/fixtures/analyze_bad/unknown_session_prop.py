@@ -1,0 +1,9 @@
+"""Red fixture: registry-consistency violations (session props +
+failpoint sites) for tools/analyze/registries.py."""
+
+
+def read_props(session, bool_property, FAILPOINTS):
+    a = session.properties.get("definitely_not_a_declared_prop", 1)
+    b = bool_property(session, "another_undeclared_prop", True)
+    FAILPOINTS.hit("not.a.registered.site")
+    return a, b

@@ -1,0 +1,337 @@
+"""Tier-1 gate for the static-analysis plane (tools/analyze/) and its
+paired runtime pieces.
+
+Three layers:
+
+- the live tree is GREEN: ``python -m tools.analyze`` semantics (all
+  three checker families + baseline) produce zero unsuppressed
+  findings and zero stale suppressions;
+- each checker family CATCHES its seeded red fixtures under
+  tests/fixtures/analyze_bad/ — these tests fail if a checker is
+  disabled or its detection rots;
+- the registry contracts hold at runtime too: SET SESSION rejects
+  unknown/mistyped properties, failpoint specs reject unregistered
+  sites, and the lock-order validator (_devtools/lockcheck.py) records
+  real edges and flags real inversions.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analyze_bad")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analyze import CHECKERS, locks, registries, run, tracing  # noqa: E402
+from tools.analyze.base import Finding, apply_baseline, load_baseline  # noqa: E402
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+# -- the live tree is green --------------------------------------------------
+
+def test_live_tree_has_no_unsuppressed_findings():
+    findings, _suppressed, stale = run(root=REPO)
+    assert not findings, "\n" + "\n".join(f.render() for f in findings)
+    assert not stale, f"stale baseline suppressions: {stale}"
+
+
+def test_cli_main_exits_zero():
+    from tools.analyze.__main__ import main
+    assert main([]) == 0
+
+
+def test_every_checker_family_registered():
+    assert set(CHECKERS) == {"tracing", "locks", "registries"}
+
+
+# -- red fixtures: tracing ---------------------------------------------------
+
+def test_tracing_catches_tracer_branches():
+    fs = tracing.check_paths([_fixture("tracer_branch.py")], REPO)
+    by_sym = {(f.rule, f.line) for f in fs}
+    assert ("tracer-branch", 13) in by_sym          # if x > 0
+    assert ("tracer-branch", 20) in by_sym          # while (via taint)
+    concretize = [f for f in fs if f.rule == "tracer-branch"
+                  and f.line == 30]
+    kinds = {f.message.split("(")[0].split()[0] for f in concretize}
+    assert {"float", "bool", ".item"} <= kinds
+    assert sum(f.rule == "nondeterminism" for f in fs) == 3
+
+
+def test_tracing_static_structure_reads_not_flagged():
+    fs = tracing.check_paths([_fixture("tracer_branch.py")], REPO)
+    assert not [f for f in fs
+                if f.symbol.startswith("static_uses_are_fine")
+                and f.rule != "raw-jit"]
+
+
+def test_tracing_catches_raw_jit_and_unbracketed_sync():
+    fs = tracing.check_paths([_fixture("raw_jit.py")], REPO)
+    raw = [f for f in fs if f.rule == "raw-jit"]
+    assert {f.line for f in raw} == {8, 11}
+    sync = [f for f in fs if f.rule == "unbracketed-sync"]
+    assert {f.line for f in sync} == {17, 18}       # 24 is spanned
+
+
+def test_tracing_jitcache_itself_is_exempt():
+    path = os.path.join(REPO, "presto_tpu", "ops", "jitcache.py")
+    fs = tracing.check_paths([path], REPO)
+    assert not [f for f in fs if f.rule == "raw-jit"]
+
+
+# -- red fixtures: locks -----------------------------------------------------
+
+def test_locks_catches_inversion_cycle():
+    fs = locks.check_paths([_fixture("lock_inversion.py")], REPO)
+    cycles = [f for f in fs if f.rule == "lock-cycle"]
+    assert len(cycles) == 1
+    assert "_la" in cycles[0].message and "_lb" in cycles[0].message
+
+
+def test_locks_catches_unjoined_threads():
+    fs = locks.check_paths([_fixture("lock_inversion.py")], REPO)
+    unjoined = [f for f in fs if f.rule == "unjoined-thread"]
+    # the Looper attr thread, the anonymous fire-and-forget, and the
+    # local masked by a str.join; the looped t.join() case is clean
+    assert {f.line for f in unjoined} == {33, 47, 51}
+
+
+def test_locks_catches_unlocked_global_write():
+    fs = locks.check_paths([_fixture("lock_inversion.py")], REPO)
+    writes = [f for f in fs if f.rule == "unlocked-global-write"]
+    assert [f.line for f in writes] == [23]         # line 27 is locked
+
+
+# -- red fixtures: registries ------------------------------------------------
+
+def test_registries_catches_unknown_session_props():
+    fs = registries.session_prop_findings(
+        REPO, scan_paths=[_fixture("unknown_session_prop.py")],
+        doc_path="/nonexistent")
+    unknown = {f.symbol for f in fs if f.rule == "unknown-session-prop"}
+    assert unknown == {"definitely_not_a_declared_prop",
+                       "another_undeclared_prop"}
+
+
+def test_registries_catches_unknown_failpoint_site():
+    fs = registries.failpoint_findings(
+        REPO, scan_paths=[_fixture("unknown_session_prop.py")],
+        doc_path="/nonexistent")
+    assert {f.symbol for f in fs
+            if f.rule == "unknown-failpoint-site"} \
+        == {"not.a.registered.site"}
+
+
+def test_registries_metric_rules_still_fire():
+    # the folded-in check_metric_names rules (shim covers the CLI; this
+    # pins the library path)
+    import tempfile
+    import textwrap
+    with tempfile.TemporaryDirectory() as td:
+        with open(os.path.join(td, "bad.py"), "w") as f:
+            f.write(textwrap.dedent("""\
+                REGISTRY.counter('CamelCase_total').inc()
+                REGISTRY.counter('no_unit_suffix').inc()
+                REGISTRY.gauge('dup_total').set(1)
+                REGISTRY.counter('dup_total').inc()
+            """))
+        fs = registries.metric_findings([td], REPO, doc_path=None)
+    assert _rules(fs) == {"bad-metric-name", "metric-type-conflict"}
+
+
+# -- baseline machinery ------------------------------------------------------
+
+def test_baseline_suppresses_and_goes_stale(tmp_path):
+    f1 = Finding("tracing", "raw-jit", "a.py", 3, "f", "m")
+    f2 = Finding("locks", "lock-cycle", "b.py", 9, "g", "m")
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(json.dumps({"suppressions": [
+        {"id": f1.ident, "reason": "accepted"},
+        {"id": "tracing:raw-jit:gone.py:old", "reason": "fixed long ago"},
+    ]}))
+    baseline = load_baseline(str(bl_path))
+    keep, dropped, stale = apply_baseline([f1, f2], baseline)
+    assert keep == [f2]
+    assert dropped == [f1]
+    assert stale == ["tracing:raw-jit:gone.py:old"]
+
+
+# -- runtime: SET SESSION validation -----------------------------------------
+
+@pytest.fixture(scope="module")
+def runner():
+    from presto_tpu.exec.runner import LocalRunner
+    return LocalRunner(tpch_sf=0.01)
+
+
+def test_set_session_unknown_property_raises(runner):
+    from presto_tpu.config import SessionPropertyError
+    with pytest.raises(SessionPropertyError, match="unknown session"):
+        runner.execute("set session not_a_real_property = 1")
+    assert "not_a_real_property" not in runner.session.properties
+
+
+def test_set_session_type_mismatch_raises(runner):
+    from presto_tpu.config import SessionPropertyError
+    with pytest.raises(SessionPropertyError, match="expects a integer"):
+        runner.execute("set session scan_threads = 'many'")
+    with pytest.raises(SessionPropertyError, match="expects a boolean"):
+        runner.execute("set session dense_grouping = 7")
+
+
+def test_set_session_coerces_and_latches(runner):
+    try:
+        runner.execute("set session dense_grouping = 'false'")
+        assert runner.session.properties["dense_grouping"] is False
+        runner.execute("set session scan_threads = '3'")
+        assert runner.session.properties["scan_threads"] == 3
+        runner.execute("set session retry_policy = 'query'")
+        assert runner.session.properties["retry_policy"] == "QUERY"
+    finally:
+        for k in ("dense_grouping", "scan_threads", "retry_policy"):
+            runner.session.properties.pop(k, None)
+
+
+def test_session_defaults_from_config_validated(tmp_path):
+    from presto_tpu.config import NodeConfig, SessionPropertyError, \
+        validate_session_property
+    cfg = NodeConfig({"session.scan_threads": "4"})
+    assert validate_session_property(
+        "scan_threads", cfg.session_defaults["scan_threads"]) == 4
+    with pytest.raises(SessionPropertyError):
+        validate_session_property("scan_threads", "lots")
+    with pytest.raises(SessionPropertyError):
+        validate_session_property("no_such_default", "1")
+
+
+def test_every_declared_property_documents_itself():
+    from presto_tpu.config import SESSION_PROPERTIES
+    for sp in SESSION_PROPERTIES.values():
+        assert sp.doc.strip(), f"{sp.name} has no doc line"
+        assert sp.type in ("boolean", "integer", "double", "varchar",
+                           "duration"), sp.name
+
+
+# -- runtime: failpoint site validation --------------------------------------
+
+def test_failpoint_unknown_site_rejected_at_parse_time():
+    from presto_tpu.exec.failpoints import FAILPOINTS
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        FAILPOINTS.configure("scan.decoed")      # typo'd site
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        FAILPOINTS.configure_from_spec("no.such.site=error")
+    # a real site still arms (and disarms) fine
+    FAILPOINTS.configure("scan.decode", action="sleep", sleep_s=0.0,
+                         times=0)
+    FAILPOINTS.clear("scan.decode")
+
+
+def test_failpoint_unit_registries_can_use_synthetic_sites():
+    # rule-machinery unit tests build private registries with no site
+    # table — those must keep accepting arbitrary names
+    from presto_tpu.exec.failpoints import FailpointRegistry
+    reg = FailpointRegistry()
+    reg.configure("synthetic.site", times=1)
+    with pytest.raises(Exception):
+        reg.hit("synthetic.site")
+
+
+# -- runtime: lock-order validator -------------------------------------------
+
+def test_lockcheck_enabled_under_pytest():
+    from presto_tpu._devtools import lockcheck
+    assert lockcheck.ENABLED
+    lk = lockcheck.checked_lock("test.analyze.probe")
+    assert type(lk).__name__ == "_CheckedLock"
+
+
+def test_lockcheck_records_cycle():
+    from presto_tpu._devtools.lockcheck import LockGraph
+    g = LockGraph()
+    a, b = g.lock("A"), g.lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    out = g.check()
+    assert any("cycle" in v for v in out)
+
+
+def test_lockcheck_consistent_order_is_clean():
+    from presto_tpu._devtools.lockcheck import LockGraph
+    g = LockGraph()
+    a, b, c = g.lock("A"), g.lock("B"), g.lock("C")
+    for _ in range(3):
+        with a:
+            with b:
+                with c:
+                    pass
+    assert g.check() == []
+
+
+def test_lockcheck_flags_dispatch_under_lock():
+    from presto_tpu._devtools.lockcheck import LockGraph
+    g = LockGraph()
+    a = g.lock("A")
+    with a:
+        g.note_dispatch("kernel")
+    out = g.check()
+    assert any("jit dispatch" in v and "kernel" in v for v in out)
+    g.reset()
+    assert g.check() == []
+
+
+def test_lockcheck_rlock_reentry_balances():
+    from presto_tpu._devtools.lockcheck import LockGraph
+    g = LockGraph()
+    r = g.rlock("R")
+    with r:
+        with r:
+            pass
+    assert g.held() == []
+    assert g.check() == []
+
+
+def test_lockcheck_condition_wait_releases_stack():
+    import threading
+    from presto_tpu._devtools.lockcheck import LockGraph
+    g = LockGraph()
+    lk = g.lock("CV")
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hits.append(tuple(g.held()))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.1)
+    with cv:
+        cv.notify()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert hits and hits[0] == ("CV",)
+    assert g.check() == []
+
+
+# -- the engine's own locks feed the process graph ---------------------------
+
+def test_engine_locks_recorded_and_clean(runner):
+    from presto_tpu._devtools import lockcheck
+    runner.execute("select count(*) from nation")
+    assert lockcheck.GRAPH.check() == [], lockcheck.GRAPH.check()

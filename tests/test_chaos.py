@@ -25,3 +25,23 @@ def test_chaos_smoke():
     assert scenarios["straggler"]["speculative_won"] >= 1
     assert scenarios["worker_death"]["task_retries"] >= 1
     assert "retry_none" in scenarios
+
+
+def test_lock_discipline_clean_after_chaos():
+    """After the full chaos run (retries, speculation, drain, worker
+    death) the runtime lock-order validator saw every engine lock edge
+    the cluster plane takes under stress: the acquisition graph must be
+    acyclic and no dispatch may have run under a lock."""
+    from presto_tpu._devtools import lockcheck
+    assert lockcheck.ENABLED
+    assert lockcheck.GRAPH.check() == [], lockcheck.GRAPH.check()
+
+
+def test_chaos_spec_with_unknown_site_fails_fast():
+    """A typo'd chaos spec must raise at parse time — a config that
+    injects nothing would 'pass' every recovery scenario it was meant
+    to exercise."""
+    import pytest
+    from presto_tpu.exec.failpoints import FAILPOINTS
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        FAILPOINTS.configure_from_spec("worker.task_ruin=error")

@@ -171,13 +171,21 @@ def test_retry_policy_validation():
 
 
 def test_bad_session_value_leaves_no_phantom_query(cluster):
-    """A bad retry_policy/query_max_run_time raises before the RUNNING
-    log entry is appended — no forever-RUNNING phantom row in
-    system.runtime.queries."""
+    """A bad retry_policy/query_max_run_time now fails at SET SESSION
+    time (config.SESSION_PROPERTIES validation) — and even a bad value
+    injected directly into the session dict still raises before the
+    RUNNING log entry is appended, so there is never a forever-RUNNING
+    phantom row in system.runtime.queries."""
     runner, _ = cluster
     for prop, bad in (("retry_policy", "ALWAYS"),
                       ("query_max_run_time", "soon")):
-        runner.execute(f"set session {prop} = '{bad}'")
+        # the SQL path rejects the value up front...
+        with pytest.raises(ValueError):
+            runner.execute(f"set session {prop} = '{bad}'")
+        assert prop not in runner.session.properties
+        # ...and the belt-and-braces execution-time check still guards
+        # values that bypass SET SESSION (direct dict writes)
+        runner.session.properties[prop] = bad
         try:
             with pytest.raises(ValueError):
                 runner.execute("select count(*) from nation")

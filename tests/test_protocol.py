@@ -54,14 +54,22 @@ def test_error_surfaces_as_query_error(server, client):
 
 
 def test_session_roundtrip(server, client):
-    client.execute("set session join_distribution_type = 'broadcast'")
-    assert client.session_properties.get("join_distribution_type") \
-        == "broadcast"
+    # a DECLARED property (config.SESSION_PROPERTIES): SET SESSION now
+    # validates against the registry, so the old undeclared
+    # join_distribution_type would be rejected server-side
+    client.execute("set session retry_policy = 'QUERY'")
+    assert client.session_properties.get("retry_policy") == "QUERY"
     # the override rides X-Presto-Session on later requests and is
     # restored server-side after each statement
     res = client.execute("show session")
-    client.execute("reset session join_distribution_type")
-    assert "join_distribution_type" not in client.session_properties
+    client.execute("reset session retry_policy")
+    assert "retry_policy" not in client.session_properties
+
+
+def test_set_session_unknown_property_is_query_error(server, client):
+    with pytest.raises(QueryFailed) as ei:
+        client.execute("set session join_distribution_type = 'b'")
+    assert "unknown session property" in str(ei.value)
 
 
 def test_raw_protocol_shape(server):

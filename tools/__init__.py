@@ -1,0 +1,3 @@
+"""Repo tooling (lint/CI helpers). A package so the static-analysis
+plane runs as ``python -m tools.analyze``; the standalone scripts
+(chaos_smoke, check_*) keep working as plain files."""

@@ -1,0 +1,54 @@
+"""Engine-aware static-analysis plane (ISSUE 7 tentpole).
+
+Three checker families over the repo, wired into tier-1 via
+tests/test_analyze.py and runnable standalone:
+
+    python -m tools.analyze            # exit 0 iff no unsuppressed findings
+    python -m tools.analyze --list     # show suppressed findings too
+
+- :mod:`tools.analyze.tracing` — trace-safety (host branches on traced
+  values, raw ``jax.jit`` bypassing ops/jitcache, trace-time
+  nondeterminism, unbracketed device syncs)
+- :mod:`tools.analyze.locks` — lock discipline (static acquisition-
+  order cycles, unlocked shared-state writes, unjoined threads); the
+  runtime half lives in presto_tpu/_devtools/lockcheck.py
+- :mod:`tools.analyze.registries` — string-keyed registry consistency
+  (metric families incl. doc drift, session properties, failpoint
+  sites, config keys)
+
+Accepted pre-existing findings are suppressed by the committed
+``baseline.json`` (see base.py for the ident contract); stale baseline
+entries are errors, so fixed findings must drop their suppression in
+the same change.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from . import locks, registries, tracing
+from .base import REPO, Finding, apply_baseline, load_baseline
+
+CHECKERS = {
+    "tracing": tracing.check,
+    "locks": locks.check,
+    "registries": registries.check,
+}
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+def run(root: Optional[str] = None,
+        checkers: Optional[List[str]] = None,
+        baseline_path: Optional[str] = None
+        ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """-> (unsuppressed findings, suppressed findings, stale baseline
+    idents)."""
+    root = root or REPO
+    findings: List[Finding] = []
+    for name in (checkers or sorted(CHECKERS)):
+        findings.extend(CHECKERS[name](root))
+    baseline: Dict[str, str] = load_baseline(
+        BASELINE_PATH if baseline_path is None else baseline_path)
+    return apply_baseline(findings, baseline)

@@ -1,0 +1,46 @@
+"""CLI for the static-analysis plane: ``python -m tools.analyze``."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import CHECKERS, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="repo static analysis: trace safety, lock "
+                    "discipline, registry consistency")
+    ap.add_argument("--checker", action="append", choices=sorted(CHECKERS),
+                    help="run only this checker (repeatable)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to scan (default: this repo)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore baseline.json suppressions")
+    ap.add_argument("--list", action="store_true",
+                    help="also print baseline-suppressed findings")
+    args = ap.parse_args(argv)
+
+    findings, suppressed, stale = run(
+        root=args.root, checkers=args.checker,
+        baseline_path="/dev/null" if args.no_baseline else None)
+
+    for f in findings:
+        print(f.render(), file=sys.stderr)
+    if args.list:
+        for f in suppressed:
+            print(f"[baseline] {f.render()}")
+    for ident in stale:
+        print(f"stale baseline suppression (fixed? delete it): "
+              f"{ident}", file=sys.stderr)
+
+    ok = not findings and not stale
+    print(f"{'ok' if ok else 'FAIL'}: {len(findings)} finding(s), "
+          f"{len(suppressed)} baseline-suppressed, "
+          f"{len(stale)} stale suppression(s)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -190,6 +190,19 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
             "where mode = 'cluster' order by create_time")
         assert res.rows and any(int(r[0]) >= 1 for r in res.rows), \
             "no completed_queries record carries a retry count"
+
+        # -- (f) typo'd spec rejected at parse time -----------------------
+        # a chaos config naming an unregistered site would inject
+        # nothing and "pass" every scenario above — the registry must
+        # refuse to arm it (exec/failpoints.py SITES validation)
+        finish = scenario("failpoint_validation")
+        rejected = False
+        try:
+            FAILPOINTS.configure_from_spec("worker.task_ruin=error")
+        except ValueError as e:
+            rejected = "unknown failpoint site" in str(e)
+        assert rejected, "typo'd failpoint spec was silently accepted"
+        finish(rejected=True)
         summary["ok"] = True
         return summary
     finally:

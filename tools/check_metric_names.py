@@ -1,26 +1,17 @@
 #!/usr/bin/env python
-"""Metric-name lint: walk the source for ``counter(``/``gauge(``/
-``histogram(`` call sites and fail on bad or conflicting names.
+"""Metric-name lint — thin CLI shim over ``tools/analyze/registries.py``
+(the one lint framework; this entry point survives for muscle memory
+and the tier-1 wiring in tests/test_obs_ops.py).
 
-The metrics registry creates metrics on first use, so a typo'd or
-re-typed name never errors at runtime — it silently forks a second
-series. This tool makes the naming contract enforceable in CI (it runs
-inside the tier-1 suite, tests/test_obs_ops.py, next to
-tools/check_tier1_time.py's time budget):
+Rules (enforced by the analyze package):
 
-- names must be ``snake_case`` (f-string call sites are checked on
-  their literal parts; dotted suffixes like
-  ``operator_batches_total.<kind>`` are label encodings and validated
-  on the family before the first dot);
-- the family must end in a unit suffix: ``_total``, ``_seconds`` or
-  ``_bytes``;
-- one family, one type: the same name registered as both a counter and
-  a gauge (anywhere in the tree) is an error;
-- **doc drift** (``docs/observability.md``): every metric family the
-  doc names in backticks must exist in code (a registered family or an
-  exposition-only series from ``obs/exposition.py``), and every family
-  registered in code must be documented — renames and additions that
-  forget the doc fail CI, not a reader.
+- metric families are ``snake_case`` with a unit suffix
+  (``_total``/``_seconds``/``_bytes``); dotted tails are label
+  encodings validated on the family;
+- one family, one type (a name can't be both counter and gauge);
+- **doc drift**: every family in docs/observability.md exists in code
+  (registry call site or exposition-only series), and every registered
+  family is documented.
 
 Usage:
     python tools/check_metric_names.py [src_dir ...]   # default: presto_tpu/
@@ -29,133 +20,14 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import ast
-import fnmatch
 import os
-import re
 import sys
-from typing import Dict, List, Optional, Set, Tuple
 
-_KINDS = ("counter", "gauge", "histogram")
-_SNAKE = re.compile(r"^[a-z][a-z0-9_]*(\*[a-z0-9_]*)*$")
-_UNIT_SUFFIXES = ("_total", "_seconds", "_bytes")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-
-def _name_pattern(arg: ast.expr) -> Optional[str]:
-    """The metric-name argument as a string pattern: literal strings
-    verbatim, f-strings with each interpolation collapsed to ``*``;
-    None when the name is fully dynamic (a variable)."""
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-        return arg.value
-    if isinstance(arg, ast.JoinedStr):
-        parts = []
-        for v in arg.values:
-            if isinstance(v, ast.Constant) and isinstance(v.value, str):
-                parts.append(v.value)
-            else:
-                parts.append("*")
-        return "".join(parts)
-    return None
-
-
-def _check_name(pattern: str) -> Optional[str]:
-    family = pattern.split(".", 1)[0]
-    if not _SNAKE.match(family.replace("*", "x")):
-        return f"{pattern!r}: family {family!r} is not snake_case"
-    if not family.endswith(_UNIT_SUFFIXES):
-        return (f"{pattern!r}: family {family!r} lacks a unit suffix "
-                f"({'/'.join(_UNIT_SUFFIXES)})")
-    return None
-
-
-def scan_file(path: str) -> Tuple[List[Tuple[str, str, int]], List[str]]:
-    """-> ([(pattern, kind, lineno)], [parse errors])."""
-    with open(path, encoding="utf-8", errors="replace") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [], [f"{path}: {e}"]
-    out: List[Tuple[str, str, int]] = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _KINDS and node.args):
-            continue
-        pattern = _name_pattern(node.args[0])
-        if pattern is not None:
-            out.append((pattern, node.func.attr, node.lineno))
-    return out, []
-
-
-#: doc tokens that look like a metric family (after stripping any
-#: label/dotted suffix)
-_DOC_FAMILY = re.compile(r"^[a-z][a-z0-9_]*_(?:total|seconds|bytes)$")
-
-#: backticked doc tokens that share the unit-suffix shape but are SQL
-#: column names, not metric families
-_DOC_IGNORE = {"hbm_bytes", "peak_memory_bytes", "output_bytes",
-               "arg_bytes", "temp_bytes", "generated_code_bytes",
-               "mem_pool_peak_bytes"}
-
-
-def exposition_families(path: str) -> Set[str]:
-    """Literal sample families the Prometheus exposition constructs
-    directly (``family("node_up", ...)`` in obs/exposition.py) — real
-    scrape series that never pass through the registry, so the doc may
-    name them without a counter()/gauge() call site existing."""
-    try:
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read(), filename=path)
-    except (OSError, SyntaxError):
-        return set()
-    out: Set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and node.args \
-                and isinstance(node.func, ast.Name) \
-                and node.func.id == "family":
-            pattern = _name_pattern(node.args[0])
-            if pattern:
-                out.add(pattern)
-    return out
-
-
-def doc_families(doc_path: str) -> Set[str]:
-    """Backticked metric-family names in the doc: each `token` is
-    stripped of label/series suffixes (``.``, ``{``, ``_bucket`` etc.
-    stay — only families matching the unit-suffix shape count)."""
-    with open(doc_path, encoding="utf-8") as f:
-        text = f.read()
-    out: Set[str] = set()
-    for token in re.findall(r"`([^`\n]+)`", text):
-        fam = re.split(r"[.{\s(]", token.strip(), maxsplit=1)[0]
-        if fam not in _DOC_IGNORE \
-                and _DOC_FAMILY.match(fam.replace("*", "x")):
-            out.add(fam)
-    return out
-
-
-def check_doc_drift(doc_path: str, code_families: Set[str],
-                    expo_families: Set[str]) -> List[str]:
-    """Two-way diff: doc names must exist in code (registered family or
-    exposition series; f-string families compare by fnmatch), and every
-    registered family must appear in the doc."""
-    errors: List[str] = []
-    known = code_families | expo_families
-    documented = doc_families(doc_path)
-    for fam in sorted(documented):
-        if not any(fnmatch.fnmatch(fam, pat) or fam == pat
-                   for pat in known):
-            errors.append(f"{doc_path}: documents {fam!r} but no such "
-                          "metric family is registered in code")
-    for pat in sorted(code_families):
-        if pat in documented:
-            continue
-        if any(fnmatch.fnmatch(fam, pat) for fam in documented):
-            continue
-        errors.append(f"metric family {pat!r} is registered in code "
-                      f"but not documented in {doc_path}")
-    return errors
+from tools.analyze import registries  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -169,54 +41,21 @@ def main(argv=None) -> int:
     ap.add_argument("--no-docs", action="store_true",
                     help="skip the doc-drift check")
     args = ap.parse_args(argv)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    roots = args.src or [os.path.join(repo, "presto_tpu")]
+    # resolve user-given dirs against the CWD (walk_py would otherwise
+    # anchor relative paths at the repo root and silently scan nothing)
+    roots = [os.path.abspath(p) for p in args.src] if args.src \
+        else [os.path.join(_REPO, "presto_tpu")]
+    doc = None if args.no_docs else (
+        args.docs or os.path.join(_REPO, "docs", "observability.md"))
 
-    errors: List[str] = []
-    families: Dict[str, Tuple[str, str]] = {}   # family -> (kind, where)
-    n_sites = 0
-    for root in roots:
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = [d for d in dirnames
-                           if d not in ("__pycache__",)]
-            for fn in sorted(filenames):
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                sites, errs = scan_file(path)
-                errors.extend(errs)
-                for pattern, kind, lineno in sites:
-                    n_sites += 1
-                    where = f"{path}:{lineno}"
-                    bad = _check_name(pattern)
-                    if bad:
-                        errors.append(f"{where}: {bad}")
-                        continue
-                    family = pattern.split(".", 1)[0]
-                    prev = families.get(family)
-                    if prev is not None and prev[0] != kind:
-                        errors.append(
-                            f"{where}: {family!r} registered as {kind} "
-                            f"but as {prev[0]} at {prev[1]}")
-                    elif prev is None:
-                        families[family] = (kind, where)
-
-    doc_path = args.docs or os.path.join(repo, "docs",
-                                         "observability.md")
-    if not args.no_docs and os.path.exists(doc_path):
-        errors.extend(check_doc_drift(
-            doc_path, set(families),
-            exposition_families(os.path.join(
-                repo, "presto_tpu", "obs", "exposition.py"))))
-
-    if errors:
-        for e in errors:
-            print(e, file=sys.stderr)
-        print(f"{len(errors)} metric-name error(s) across {n_sites} "
-              f"call sites", file=sys.stderr)
+    findings = registries.metric_findings(roots, _REPO, doc_path=doc)
+    if findings:
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        print(f"{len(findings)} metric-name error(s)", file=sys.stderr)
         return 1
-    print(f"ok: {n_sites} metric call sites, "
-          f"{len(families)} families")
+    print("ok: metric naming, types and docs consistent "
+          "(tools/analyze/registries.py)")
     return 0
 
 
