@@ -867,6 +867,189 @@ def bench_q27(sf: float):
     return total, dev_s, np_s
 
 
+# ---------------------------------------------------------------------------
+# Serving: concurrent-throughput axis (ROADMAP item 3). N concurrent
+# protocol clients drive a mix of repeated parameterized statements
+# through a real PrestoTpuServer (resource groups, plan cache, shared
+# scans) — the axis every other config ignores: queries/sec under
+# multi-tenant load, not one query's wall-clock. Run via
+# `python bench.py serving` (or BENCH_SERVING=1); writes the summary to
+# SERVING_OUT (default stdout only). tools/check_bench_regression.py
+# gates it against the committed SERVING_r*.json.
+# ---------------------------------------------------------------------------
+
+#: the repeated-statement mix: dashboard-shaped parameterized queries —
+#: a handful of distinct shapes, each fired many times (the plan cache's
+#: steady-state case), plus EXECUTE-driven prepared statements
+_SERVING_STATEMENTS = [
+    "select count(*), sum(l_extendedprice) from lineitem "
+    "where l_quantity > {q}",
+    "select l_returnflag, count(*) from lineitem "
+    "where l_discount between 0.0{d} and 0.08 group by l_returnflag "
+    "order by l_returnflag",
+    "select o_orderpriority, count(*) from orders "
+    "where o_totalprice > {p} group by o_orderpriority "
+    "order by o_orderpriority",
+    "select n_name, count(*) from nation group by n_name "
+    "order by n_name limit {n}",
+]
+
+
+def _serving_mix(n: int):
+    """Deterministic mixed workload: ~4 distinct statement shapes over a
+    small parameter domain, so most executions repeat an already-seen
+    fingerprint (the dashboard traffic the plan cache exists for)."""
+    out = []
+    for i in range(n):
+        tmpl = _SERVING_STATEMENTS[i % len(_SERVING_STATEMENTS)]
+        out.append(tmpl.format(q=10 + (i // 4) % 3, d=1 + (i // 4) % 2,
+                               p=1000 * (1 + (i // 4) % 3),
+                               n=5 + (i // 4) % 2))
+    return out
+
+
+def bench_serving(sf: float = 0.01, clients: int = 16,
+                  per_client: int = 8):
+    """Queries/sec + latency percentiles at ``clients`` concurrent
+    protocol clients of mixed repeated statements, plus the cold/warm
+    repeated-statement split (cold pays parse+plan+optimize+compile;
+    warm rides the plan cache onto already-compiled executables)."""
+    import threading
+
+    from presto_tpu.client import StatementClient
+    from presto_tpu.connectors.spi import CatalogManager
+    from presto_tpu.exec.runner import LocalRunner
+    from presto_tpu.obs.metrics import REGISTRY
+    from presto_tpu.server.protocol import PrestoTpuServer
+
+    catalogs = CatalogManager()
+    catalogs.register("tpch", _shared_tpch(sf))
+    runner = LocalRunner(catalogs=catalogs, rows_per_batch=1 << 17)
+    srv = PrestoTpuServer(runner, resource_groups={
+        "rootGroups": [
+            {"name": "serving", "hardConcurrencyLimit": 8,
+             "maxQueued": 10_000,
+             "subGroups": [
+                 {"name": "dash", "hardConcurrencyLimit": 8,
+                  "schedulingWeight": 2},
+                 {"name": "adhoc", "hardConcurrencyLimit": 8,
+                  "schedulingWeight": 1}]}],
+        "selectors": [{"user": "dash-.*", "group": "serving.dash"},
+                      {"group": "serving.adhoc"}]})
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        probe = _SERVING_STATEMENTS[0].format(q=10)
+
+        # cold: first-ever execution pays parse+plan+optimize+jit
+        # compile; warm (after the traffic phase): fingerprint hit in
+        # the plan cache + warm executables
+        c = StatementClient(base, user="bench")
+        t0 = time.perf_counter()
+        cold_rows = c.execute(probe).rows
+        cold_s = time.perf_counter() - t0
+
+        statements = _serving_mix(clients * per_client)
+        # warmup: one pass over the distinct shapes so the timed phase
+        # measures steady-state serving, not first-compile
+        warm_shapes = sorted(set(statements))
+        for s in warm_shapes:
+            c.execute(s)
+
+        def snap():
+            return {m["name"]: m["value"] for m in REGISTRY.snapshot()
+                    if m["name"].startswith("plan_cache_")}
+
+        before = snap()
+        latencies = []
+        lat_lock = threading.Lock()
+        errors = []
+
+        def client_loop(ci: int) -> None:
+            user = f"dash-{ci}" if ci % 2 == 0 else f"adhoc-{ci}"
+            cl = StatementClient(base, user=user)
+            try:
+                for qi in range(per_client):
+                    sql = statements[(ci * per_client + qi)
+                                     % len(statements)]
+                    t = time.perf_counter()
+                    cl.execute(sql)
+                    dt = time.perf_counter() - t
+                    with lat_lock:
+                        latencies.append(dt)
+            except Exception as e:   # surfaced in the summary, not lost
+                errors.append(f"client {ci}: {e}")
+
+        threads = [threading.Thread(target=client_loop, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.perf_counter() - t0
+        after = snap()
+        assert not errors, errors
+
+        t0 = time.perf_counter()
+        warm_rows = c.execute(probe).rows
+        warm_s = time.perf_counter() - t0
+        assert warm_rows == cold_rows, "warm re-run changed results"
+
+        latencies.sort()
+
+        def pct(p):
+            return latencies[min(int(p * len(latencies)),
+                                 len(latencies) - 1)]
+        hits = after.get("plan_cache_hit_total", 0.0) \
+            - before.get("plan_cache_hit_total", 0.0)
+        misses = after.get("plan_cache_miss_total", 0.0) \
+            - before.get("plan_cache_miss_total", 0.0)
+        hit_rate = hits / max(hits + misses, 1.0)
+        return {
+            "metric": f"serving_tpch_sf{sf:g}_qps",
+            "value": round(len(latencies) / wall_s, 2),
+            "unit": "queries/s",
+            "clients": clients,
+            "queries": len(latencies),
+            "p50_ms": round(pct(0.50) * 1e3, 2),
+            "p95_ms": round(pct(0.95) * 1e3, 2),
+            "plan_cache_hit_rate": round(hit_rate, 4),
+            "cold_ms": round(cold_s * 1e3, 2),
+            "warm_ms": round(warm_s * 1e3, 2),
+            "warm_speedup": round(cold_s / warm_s, 2),
+            "sub_metrics": [
+                {"metric": f"serving_tpch_sf{sf:g}_p95_latency_ms",
+                 "value": round(pct(0.95) * 1e3, 2), "unit": "ms"},
+                {"metric": f"serving_tpch_sf{sf:g}_warm_speedup",
+                 "value": round(cold_s / warm_s, 2), "unit": "x"},
+            ],
+        }
+    finally:
+        srv.stop()
+
+
+def main_serving() -> None:
+    import sys
+    _enable_compile_cache()
+    sf = float(os.environ.get("BENCH_SERVING_SF", "0.01"))
+    clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "16"))
+    per_client = int(os.environ.get("BENCH_SERVING_QUERIES", "8"))
+    summary = bench_serving(sf, clients, per_client)
+    line = json.dumps(summary)
+    print(line, flush=True)
+    out_path = os.environ.get("SERVING_OUT")
+    if out_path:
+        try:
+            tmp = out_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(line + "\n")
+            os.replace(tmp, out_path)
+        except OSError as e:
+            print(f"[bench] SERVING_OUT write failed: {e}",
+                  file=sys.stderr)
+
+
 def main() -> None:
     import sys
 
@@ -986,4 +1169,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+    if "serving" in _sys.argv[1:] or os.environ.get("BENCH_SERVING"):
+        main_serving()
+    else:
+        main()

@@ -106,6 +106,9 @@ _sp("fused_pipeline", "boolean", True,
     "fuse filter->project->join chains into one jitted pipeline")
 _sp("grouped_execution", "boolean", True,
     "run bucketed scans one lifespan at a time")
+_sp("plan_cache", "boolean", True,
+    "serve repeated statements from the compiled-plan cache "
+    "(fingerprinted bound AST; skips parse/plan/optimize)")
 _sp("probe_prefetch", "boolean", True,
     "overlap probe-side host staging with device dispatch")
 _sp("profile", "boolean", False,
@@ -117,6 +120,10 @@ _sp("query_max_memory", "integer", None,
     "per-query memory pool limit in bytes (spill beyond it)")
 _sp("query_max_run_time", "duration", None,
     "wall-clock deadline (e.g. 30s, 500ms); the query aborts past it",
+    _valid_duration)
+_sp("query_queued_timeout", "duration", None,
+    "admission deadline (e.g. 5s): a query still queued in its "
+    "resource group past it fails with QUERY_QUEUED_TIMEOUT",
     _valid_duration)
 _sp("query_retry_attempts", "integer", 1,
     "whole-query re-runs under retry_policy=QUERY")
@@ -134,6 +141,9 @@ _sp("scan_prefetch_depth", "integer", 4,
     "buffered batches per split in the prefetch pipeline")
 _sp("scan_threads", "integer", 2,
     "background decode threads per scan")
+_sp("shared_scan", "boolean", True,
+    "attach concurrent identical-split scan misses to one in-flight "
+    "decode instead of racing duplicates")
 _sp("speculative_execution", "boolean", True,
     "duplicate straggler tasks on another node, first finished wins")
 _sp("spill_partitions", "integer", 16,
@@ -236,6 +246,15 @@ CONFIG_KEYS: Dict[str, str] = {
                             "limit (deliberately not a session prop)",
     "failpoints": "deterministic fault-injection spec "
                   "(exec/failpoints.py grammar)",
+    # resource-groups.json group keys (server/resource_groups.py; not
+    # *.properties keys, registered here so tools/analyze round-trips
+    # the serving-plane configuration surface)
+    "softMemoryLimit": "resource-groups.json: group device-memory bytes "
+                       "beyond which new queries queue",
+    "hardMemoryLimit": "resource-groups.json: group device-memory bytes "
+                       "beyond which a growing query is killed",
+    "queryQueuedTimeout": "resource-groups.json: admission deadline for "
+                          "queries queued in the group (duration)",
     "connector.name": "catalog properties: which connector factory",
     "tpch.scale-factor": "tpch catalog scale factor",
     "tpcds.scale-factor": "tpcds catalog scale factor",

@@ -90,6 +90,17 @@ _SCHEMAS: Dict[str, List] = {
         ("batches", T.BIGINT), ("wall_ms", T.DOUBLE),
         ("bytes", T.BIGINT), ("device_time_s", T.DOUBLE),
         ("flops", T.DOUBLE), ("hbm_bytes", T.BIGINT)],
+    # serving plane: every resource group of every live manager in the
+    # process — admission state, memory ledger, and the device
+    # scheduler's per-group quanta share (serving/groups.group_snapshot;
+    # reference system.runtime resource-group MBeans made queryable)
+    "resource_groups": [
+        ("group", V), ("state", V), ("running", T.BIGINT),
+        ("queued", T.BIGINT), ("memory_reserved_bytes", T.BIGINT),
+        ("soft_memory_limit_bytes", T.BIGINT),
+        ("scheduling_weight", T.BIGINT),
+        ("device_seconds", T.DOUBLE), ("device_share", T.DOUBLE),
+        ("quanta", T.BIGINT)],
     # per compiled jit entry (ops/jitcache + fused chains): compile
     # cost, invocation/device-time ledger, and lazy XLA introspection
     # (cost_analysis FLOPs/bytes, memory_analysis sizes) — the feed is
@@ -287,6 +298,17 @@ class SystemConnector(Connector):
                                 float(op.get("flops") or 0.0),
                                 int(op.get("hbm_bytes") or 0)))
             return out
+        if table == "resource_groups":
+            from ..serving.groups import group_snapshot
+            return [(g["group"], g["state"], int(g["running"]),
+                     int(g["queued"]),
+                     int(g["memory_reserved_bytes"] or 0),
+                     None if g["soft_memory_limit_bytes"] is None
+                     else int(g["soft_memory_limit_bytes"]),
+                     int(g["scheduling_weight"]),
+                     float(g["device_seconds"]),
+                     float(g["device_share"]), int(g["quanta"]))
+                    for g in group_snapshot()]
         if table == "executables":
             from ..obs.profiler import EXECUTABLES
             return [(e["name"], e["static_key"], int(e["compiles"]),

@@ -111,8 +111,16 @@ def execute_plan(plan: LogicalPlan, session: Session,
     from .taskexec import GLOBAL as scheduler
     ex = _Executor(session, rows_per_batch, stats=stats)
     ex.cancel_event = cancel_event
-    handle = (scheduler.task(name=str(id(ex)))
-              if bool_property(session, "fair_scheduling", True) else None)
+    # admitted queries register under their resource group's scheduler
+    # share (serving/groups.py): quanta are allotted per group by
+    # schedulingWeight, then per task within the group
+    serving = getattr(session, "serving", None)
+    handle = (scheduler.task(
+        name=str(id(ex)),
+        group=serving.scheduler_group if serving is not None else "",
+        weight=serving.weight if serving is not None else 1,
+        label=serving.group_path if serving is not None else None)
+        if bool_property(session, "fair_scheduling", True) else None)
     # device-time profiling: per-dispatch block_until_ready bracketing +
     # per-operator attribution (obs/profiler.py). On under the `profile`
     # session property, and always under EXPLAIN ANALYZE — analyze mode
@@ -313,7 +321,10 @@ class _Executor:
             # second spill tier: staged host bytes beyond this flush to
             # compressed pages on disk (reference NodeSpillConfig)
             disk_threshold=_int_prop("spill_to_disk_bytes", 4 << 30),
-            spill_dir=session.properties.get("spill_path"))
+            spill_dir=session.properties.get("spill_path"),
+            # admitted queries mirror reservations to their resource
+            # group's ledger (kill-or-queue on group memory limits)
+            group=getattr(session, "serving", None))
         self.spill_partitions = int(
             session.properties.get("spill_partitions", 16))
         session.last_memory_stats = self.pool.stats

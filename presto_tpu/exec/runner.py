@@ -72,19 +72,25 @@ class LocalRunner:
     # -- public API -----------------------------------------------------------
     def execute(self, sql: str,
                 properties: Optional[Dict[str, object]] = None,
-                user: str = "", cancel_event=None) -> QueryResult:
+                user: str = "", cancel_event=None,
+                serving=None) -> QueryResult:
         """Run one statement. ``properties`` overlays per-query session
         properties without mutating the shared session (needed for
         concurrent queries under resource groups; the reference builds a
         per-query Session the same way, Session.java +
         QuerySessionSupplier). ``user`` scopes access-control checks and
-        query events."""
+        query events. ``serving`` is the admitted query's resource-group
+        context (serving/groups.QueryServingContext): group memory
+        accounting + weighted device-scheduler share."""
         import time as _time
         from ..connectors.system import QueryLogEntry
         from ..events import completed_event
         from ..exec.stats import StatsCollector
         from ..events import SplitCompletedEvent
-        stmt = parse_statement(sql)
+        from ..serving.plancache import parse_cached
+        # repeated-statement fast path, step 1: identical SQL text
+        # reuses the parsed AST (frozen dataclasses)
+        stmt = parse_cached(sql)
         with self._state_lock:
             self._query_seq += 1
             qid = f"q_{self._query_seq:06d}"
@@ -114,7 +120,7 @@ class LocalRunner:
                 trace_id = getattr(qspan, "trace_id", None)
                 out = self._execute_stmt(stmt, properties, user,
                                          cancel_event=cancel_event,
-                                         stats=stats)
+                                         stats=stats, serving=serving)
             rows_out = len(out.rows)
             entry.state = "FINISHED"
             return out
@@ -237,22 +243,34 @@ class LocalRunner:
     def _execute_stmt(self, stmt: A.Node,
                       properties: Optional[Dict[str, object]] = None,
                       user: str = "", cancel_event=None,
-                      stats=None) -> QueryResult:
+                      stats=None, serving=None) -> QueryResult:
         import dataclasses as _dc
         session = self.session
         secured = bool(self.access_control.catalog_rules)
-        if properties or secured:
+        if properties or secured or serving is not None:
             catalogs = session.catalogs
             if secured:
                 from ..server.security import SecuredCatalogs
                 catalogs = SecuredCatalogs(catalogs, user,
                                            self.access_control)
             session = _dc.replace(
-                session, catalogs=catalogs,
+                session, catalogs=catalogs, serving=serving,
                 properties={**session.properties, **(properties or {})})
         if isinstance(stmt, A.Query):
+            # repeated-statement fast path, step 2: a fingerprint hit in
+            # the compiled-plan cache (serving/plancache.py) skips
+            # plan_query + optimize entirely — the plan's jitted
+            # executables are already warm in ops/jitcache
+            from ..serving.plancache import cached_plan
             with TRACER.span("plan"):
-                plan = optimize(plan_query(stmt, session), session)
+                plan = cached_plan(
+                    stmt, session, user=user,
+                    secured=secured or self.roles.enforce)
+            if secured:
+                # a cache hit skips planning — where SecuredCatalogs
+                # enforces — so re-check catalog access on the plan's
+                # scans (a revoked grant must bite on warm plans too)
+                self._check_catalog_access(plan, user)
             if self.roles.enforce:
                 self._check_select_privileges(plan, user)
             try:
@@ -496,7 +514,8 @@ class LocalRunner:
                     f"but found {len(stmt.args)}")
             bound = substitute_parameters(prepared, list(stmt.args))
             return self._execute_stmt(bound, properties, user,
-                                      cancel_event=cancel_event)
+                                      cancel_event=cancel_event,
+                                      stats=stats, serving=serving)
         if isinstance(stmt, A.DescribeOutput):
             prepared = self.session.prepared.get(stmt.name)
             if prepared is None:
@@ -529,6 +548,22 @@ class LocalRunner:
         catalog = self.session.catalog if len(name) < 3 else name[-3]
         schema = self.session.schema if len(name) < 2 else name[-2]
         return (catalog, schema, name[-1])
+
+    def _check_catalog_access(self, plan: LogicalPlan,
+                              user: str) -> None:
+        """Catalog-level access control over a plan's scans — the check
+        SecuredCatalogs performs at plan time, repeated here so plans
+        served from the cache (planning skipped) stay enforced."""
+        from ..planner.plan import TableScanNode
+
+        def walk(n):
+            if isinstance(n, TableScanNode):
+                self.access_control.check_can_access_catalog(
+                    user, n.catalog)
+            for c in n.children:
+                walk(c)
+        for p in [plan.root] + list(plan.init_plans):
+            walk(p)
 
     def _check_select_privileges(self, plan: LogicalPlan,
                                  user: str) -> None:

@@ -74,6 +74,12 @@ _EVICTED_BYTES = REGISTRY.counter("scan_cache_evicted_bytes_total")
 _RESIDENT = REGISTRY.gauge("scan_cache_resident_bytes")
 _STALL = REGISTRY.histogram("scan_prefetch_stall_seconds")
 _PREFETCH_BATCHES = REGISTRY.counter("scan_prefetch_batches_total")
+_SHARED_ATTACH = REGISTRY.counter("scan_shared_attach_total")
+
+#: longest a query waits on another query's in-flight decode before
+#: giving up and decoding solo (robustness: a wedged producer must not
+#: wedge its attached consumers)
+SHARED_WAIT_S = 30.0
 
 #: default resident-set bound for the process-wide cache; overridable
 #: via config.properties ``scan-cache.max-bytes`` or CACHE.set_limit
@@ -100,15 +106,36 @@ class _Entry:
         self.conn_ref = conn_ref
 
 
+class _InFlight:
+    """One split decode in progress: attached queries wait on ``event``
+    and read ``batches`` (None = the producer failed or abandoned —
+    waiters retry, possibly becoming the producer themselves)."""
+
+    __slots__ = ("event", "batches")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.batches: Optional[List[Batch]] = None
+
+
 class ScanCache:
     """Cross-query LRU of decoded device split data, accounted against
     its own memory pool (the reference has no analogue — Presto re-reads
     the source per query; the closest cousins are Alluxio-style local
-    caches and tf.data's ``cache()``, which this is, device-resident)."""
+    caches and tf.data's ``cache()``, which this is, device-resident).
+
+    Serving plane: the cache additionally brokers **shared-scan
+    batching** — N concurrent queries missing on the same (table,
+    split, columns, pushdown, version) key attach to ONE in-flight
+    decode (``join_inflight``/``finish_inflight``) instead of racing N
+    duplicate decodes, the "shared work across concurrent consumers of
+    the same table" idea from 'Efficient Tabular Data Preprocessing of
+    ML Pipelines' (PAPERS.md)."""
 
     def __init__(self, limit_bytes: int = DEFAULT_CACHE_BYTES):
         self.pool = QueryMemoryPool(limit_bytes)
         self._entries: "OrderedDict[Tuple, _Entry]" = OrderedDict()
+        self._inflight: dict = {}
         self._lock = checked_rlock("scancache.entries")
 
     # -- keying ---------------------------------------------------------------
@@ -184,6 +211,27 @@ class ScanCache:
             _INSERTS.inc()
             _RESIDENT.set(self.pool.reserved)
             return True
+
+    # -- shared-scan batching -------------------------------------------------
+    def join_inflight(self, key) -> Tuple[_InFlight, bool]:
+        """(record, is_owner): the first caller per key becomes the
+        owner (it decodes and MUST call :meth:`finish_inflight` on every
+        exit path); later callers attach and wait on ``record.event``."""
+        with self._lock:
+            fl = self._inflight.get(key)
+            if fl is not None:
+                return fl, False
+            fl = self._inflight[key] = _InFlight()
+            return fl, True
+
+    def finish_inflight(self, key, batches: Optional[List[Batch]]) -> None:
+        """Publish the owner's outcome: the complete staged batch list,
+        or None when the decode failed/was abandoned (waiters retry)."""
+        with self._lock:
+            fl = self._inflight.pop(key, None)
+        if fl is not None:
+            fl.batches = batches
+            fl.event.set()
 
     # -- eviction / invalidation ---------------------------------------------
     def _drop(self, key, e: _Entry) -> None:
@@ -262,6 +310,8 @@ class ScanOptions:
     pad: bool = True
     threads: int = 2
     depth: int = 4
+    #: attach concurrent identical-split misses to one in-flight decode
+    shared: bool = True
 
 
 def options_from_session(session) -> ScanOptions:
@@ -276,7 +326,8 @@ def options_from_session(session) -> ScanOptions:
         prefetch=bool_property(session, "scan_prefetch", True),
         pad=bool_property(session, "scan_pad_batches", True),
         threads=int(props.get("scan_threads", 2)),
-        depth=int(props.get("scan_prefetch_depth", 4)))
+        depth=int(props.get("scan_prefetch_depth", 4)),
+        shared=bool_property(session, "shared_scan", True))
 
 
 class _PadTracker:
@@ -329,6 +380,14 @@ def scan_splits(conn, catalog: str, columns: Sequence[str],
         cacheable = version is not None
     pad = _PadTracker(bucket_capacity(max(int(rows_per_batch), 1))) \
         if opts.pad else None
+    # inline (no prefetch threads): split_batches runs inside the
+    # consumer's device-scheduler quantum — attach-waiting there would
+    # hold the device while the owner may need quanta to finish its own
+    # inline decode (whole-device stall). Inline scans therefore never
+    # ATTACH; they still register ownership and publish, so threaded
+    # peers (which wait on background threads, outside any quantum) can
+    # ride their decode.
+    inline_scan = not opts.prefetch or opts.threads <= 1
 
     def split_keys(split, pushdown):
         """[effective key, static-pushdown fallback key] (deduped);
@@ -371,40 +430,108 @@ def scan_splits(conn, catalog: str, columns: Sequence[str],
         if record_split is not None:
             record_split(i, t0, len(cached))
 
+    def attach_wait(fl: "_InFlight") -> bool:
+        """Wait on another query's in-flight decode of this split
+        (shared-scan batching). True when its batches are usable. The
+        wait is an input stall: observed and credited back to the fair
+        scheduler like a prefetch stall."""
+        from . import taskexec
+        _SHARED_ATTACH.inc()
+        t_stall = time.perf_counter()
+        deadline = t_stall + SHARED_WAIT_S
+        done = True
+        while not fl.event.wait(0.1):
+            if check_cancel is not None:
+                check_cancel()
+            if time.perf_counter() > deadline:
+                done = False      # wedged producer: decode solo
+                break
+        dt = time.perf_counter() - t_stall
+        _STALL.observe(dt)
+        taskexec.GLOBAL.note_stall(dt)
+        if stats is not None:
+            stats.prefetch_stall_s += dt
+        return done and fl.batches is not None
+
     def split_batches(i: int, split) -> Iterator[Batch]:
         t0 = time.perf_counter()
         pushdown = pushdown_fn()
         keys = split_keys(split, pushdown)
-        if keys:
+        owner_key = None
+        solo = False
+        while keys:
             cached = CACHE.get_any(keys, conn)
             if cached is not None:
                 yield from replay(i, split, cached, t0)
                 return
-            if stats is not None:
-                stats.record_cache(False)
-        src = conn.page_source(split, list(columns), pushdown=pushdown,
-                               rows_per_batch=rows_per_batch)
-        acc = [] if keys else None
-        nb = 0
-        for b in src.batches():
-            # failpoint: abort mid-decode (chaos tests prove a failed/
-            # aborted scan never reaches the put() below — a partial
-            # column set must not become a resident cache entry)
-            FAILPOINTS.hit("scan.decode",
-                           key=f"{catalog}.{split.table.table}.{i}",
-                           split=i, batch=nb)
-            b = stage(b)
-            nb += 1
+            if not opts.shared or solo:
+                break
+            fl, owner = CACHE.join_inflight(keys[0])
+            if not owner and inline_scan:
+                # another query owns the decode but THIS scan runs
+                # inside its quantum: waiting would hold the device —
+                # decode solo instead (duplicate work beats a stall)
+                break
+            if owner:
+                # close the probe->register gap: a decode that started
+                # and FINISHED between this query's miss and its
+                # registration already inserted the entry — serve it
+                # instead of decoding again
+                cached = CACHE.get_any(keys, conn, count_miss=False)
+                if cached is not None:
+                    CACHE.finish_inflight(keys[0], cached)
+                    yield from replay(i, split, cached, t0)
+                    return
+                owner_key = keys[0]
+                break
+            if attach_wait(fl):
+                # ride the other query's decode: its staged batches
+                # serve this consumer directly (put() may have been
+                # refused by the memory limit — the list is live
+                # either way)
+                yield from replay(i, split, fl.batches, t0)
+                return
+            # producer failed/abandoned (event set, no batches): retry
+            # the probe — this query may now become the owner. Producer
+            # wedged past the wait budget (event unset): decode solo,
+            # unregistered, so one stuck query cannot wedge its peers.
+            solo = not fl.event.is_set()
+        if keys and stats is not None:
+            stats.record_cache(False)
+        complete = None
+        try:
+            src = conn.page_source(split, list(columns),
+                                   pushdown=pushdown,
+                                   rows_per_batch=rows_per_batch)
+            acc = [] if keys else None
+            nb = 0
+            for b in src.batches():
+                # failpoint: abort mid-decode (chaos tests prove a
+                # failed/aborted scan never reaches the put() below — a
+                # partial column set must not become a resident cache
+                # entry)
+                FAILPOINTS.hit("scan.decode",
+                               key=f"{catalog}.{split.table.table}.{i}",
+                               split=i, batch=nb)
+                b = stage(b)
+                nb += 1
+                if acc is not None:
+                    acc.append(b)
+                yield b
+            if record_split is not None:
+                record_split(i, t0, nb)
             if acc is not None:
-                acc.append(b)
-            yield b
-        if record_split is not None:
-            record_split(i, t0, nb)
-        if acc is not None:
-            # only complete split streams insert: every early exit above
-            # (decode error, failpoint, abort/GeneratorExit from the
-            # consumer) skips this line by construction
-            CACHE.put(keys[0], conn, acc)
+                # only complete split streams insert: every early exit
+                # above (decode error, failpoint, abort/GeneratorExit
+                # from the consumer) skips this line by construction
+                complete = acc
+                CACHE.put(keys[0], conn, acc)
+        finally:
+            if owner_key is not None:
+                # publish to attached queries on EVERY exit path: a
+                # complete batch list serves them directly; None sends
+                # them back to decode for themselves
+                CACHE.finish_inflight(owner_key, complete)
 
     # serial warm fast path: splits already resident replay in order
     # with no thread/queue machinery at all; the pipeline spins up only

@@ -15,6 +15,21 @@ LOWEST level (levels by cumulative device seconds, same thresholds as
 the reference: 0/1/10/60/300s), breaking ties by least in-level usage.
 A long-running query climbs levels and yields to fresh short queries —
 the multilevel feedback queue, without threads owning the device.
+
+Serving plane (PR 8): quanta are first allotted **per resource group**
+— stride scheduling over the admitting group's ``schedulingWeight``
+(the role of the reference's resource-group CPU-quota split, reshaped
+for device time): each billed quantum advances the group's virtual
+time by ``billed / weight``, and the waiting task whose group has the
+LOWEST virtual time runs next, so under saturation a weight-2 group
+receives ~2x the device seconds of a weight-1 group. Starvation-proof
+by construction: only running advances virtual time, so a waiting
+group's priority can only improve; a group returning from idle is
+clamped UP to the floor of the currently-active groups' virtual times
+(it competes from now on — it cannot replay its idle period as debt
+and monopolize the device). Within one group, tasks keep the
+multilevel-feedback order above. Tasks registered without a group
+share the default ``""`` group at weight 1.
 """
 from __future__ import annotations
 
@@ -33,13 +48,39 @@ _WAIT_SECONDS = REGISTRY.histogram("scheduler_wait_seconds")
 #: MultilevelSplitQueue.LEVEL_THRESHOLD_SECONDS = {0, 1, 10, 60, 300})
 LEVEL_THRESHOLDS = (0.0, 1.0, 10.0, 60.0, 300.0)
 
+#: idle GroupShare retention bound (see DeviceScheduler._shares)
+_MAX_SHARES = 256
+
 R = TypeVar("R")
 
 
+class GroupShare:
+    """One resource group's device-time account (stride scheduling):
+    ``vtime`` advances by billed-seconds/weight, so heavier groups
+    accrue slower and win eligibility more often. ``name`` is the
+    account key (manager-scoped by the serving plane, so two servers'
+    same-named groups never share one account); ``label`` is the
+    human-facing group path used for the metric series."""
+
+    __slots__ = ("name", "label", "weight", "vtime", "device_seconds",
+                 "quanta")
+
+    def __init__(self, name: str, weight: int = 1,
+                 label: Optional[str] = None):
+        self.name = name
+        self.label = label if label is not None else name
+        self.weight = max(int(weight), 1)
+        self.vtime = 0.0
+        self.device_seconds = 0.0
+        self.quanta = 0
+
+
 class TaskHandle:
-    def __init__(self, scheduler: "DeviceScheduler", name: str):
+    def __init__(self, scheduler: "DeviceScheduler", name: str,
+                 share: Optional[GroupShare] = None):
         self.scheduler = scheduler
         self.name = name
+        self.share = share
         self.device_seconds = 0.0
         self.quanta = 0
         self.closed = False
@@ -61,9 +102,6 @@ class TaskHandle:
                 lv = i
         return lv
 
-    def priority(self):
-        return (self.level, self.device_seconds)
-
     def close(self) -> None:
         self.scheduler.remove(self)
 
@@ -82,17 +120,56 @@ class DeviceScheduler:
         self._waiting: List[TaskHandle] = []
         self._running: Optional[TaskHandle] = None
         self._running_depth = 0
+        #: group key -> GroupShare (the "" default group is created on
+        #: first ungrouped task; serving-plane keys are manager-scoped).
+        #: Bounded: idle shares beyond _MAX_SHARES evict oldest-first,
+        #: so restart-per-tenant / embedded-server churn cannot grow
+        #: this dict (or the group_snapshot denominator) forever.
+        self._shares: dict = {}
         #: ident of the thread executing the current quantum's fn():
         #: stall credits only attach when the STALLED thread is the one
         #: being billed (a query running outside the scheduler must not
         #: discount another query's quantum)
         self._running_thread: Optional[int] = None
 
-    def task(self, name: str = "") -> TaskHandle:
-        h = TaskHandle(self, name)
+    def task(self, name: str = "", group: str = "",
+             weight: int = 1,
+             label: Optional[str] = None) -> TaskHandle:
         with self._lock:
+            share = self._shares.get(group)
+            if share is None:
+                share = self._shares[group] = GroupShare(group, weight,
+                                                         label)
+            else:
+                share.weight = max(int(weight), 1)
+            # idle-return clamp: a group with no active task competes
+            # from the current floor — its idle period is not device
+            # debt it may burn down at everyone else's expense
+            active = {t.share for t in self._tasks
+                      if t.share is not None and t.share is not share}
+            if active and not any(t.share is share for t in self._tasks):
+                floor = min(s.vtime for s in active)
+                if share.vtime < floor:
+                    share.vtime = floor
+            h = TaskHandle(self, name, share)
             self._tasks.append(h)
+            if len(self._shares) > _MAX_SHARES:
+                live = {t.share for t in self._tasks
+                        if t.share is not None}
+                for key in list(self._shares):
+                    if len(self._shares) <= _MAX_SHARES:
+                        break
+                    if self._shares[key] not in live:
+                        del self._shares[key]
         return h
+
+    def group_shares(self) -> dict:
+        """Per-group ledger snapshot (system.runtime.resource_groups)."""
+        with self._lock:
+            return {name: {"weight": s.weight, "vtime": s.vtime,
+                           "device_seconds": s.device_seconds,
+                           "quanta": s.quanta}
+                    for name, s in self._shares.items()}
 
     def remove(self, handle: TaskHandle) -> None:
         with self._cv:
@@ -101,6 +178,13 @@ class DeviceScheduler:
                 self._tasks.remove(handle)
             self._cv.notify_all()
 
+    @staticmethod
+    def _wait_key(t: TaskHandle):
+        """Group virtual time first (stride fairness across groups),
+        then the multilevel-feedback order within the group."""
+        vtime = t.share.vtime if t.share is not None else 0.0
+        return (vtime, t.level, t.device_seconds)
+
     def _eligible(self, handle: TaskHandle) -> bool:
         if self._running is handle:
             return True       # re-entrant: tasks of one query (pipeline
@@ -108,7 +192,7 @@ class DeviceScheduler:
             # themselves — only against OTHER queries
         if self._running is not None:
             return False
-        best = min(self._waiting, key=TaskHandle.priority)
+        best = min(self._waiting, key=self._wait_key)
         return best is handle
 
     def run_quantum(self, handle: Optional[TaskHandle],
@@ -157,6 +241,17 @@ class DeviceScheduler:
                 _DEVICE_SECONDS.inc(billed)
                 handle.device_seconds += billed
                 handle.quanta += 1
+                if handle.share is not None:
+                    # stride accounting: billed seconds advance the
+                    # group's virtual time inversely to its weight
+                    share = handle.share
+                    share.vtime += billed / share.weight
+                    share.device_seconds += billed
+                    share.quanta += 1
+                    if share.label:
+                        REGISTRY.counter(
+                            "resource_group_device_seconds_total."
+                            f"{share.label}").inc(billed)
                 self._running_depth -= 1
                 if self._running_depth == 0:
                     self._running = None

@@ -60,6 +60,12 @@ class Session:
     prepared: Dict[str, object] = dataclasses.field(default_factory=dict)
     # filled by the executor: memory.MemoryStats of the last query
     last_memory_stats: object = None
+    # serving-plane context (serving/groups.QueryServingContext) set on
+    # the per-query overlay by LocalRunner.execute when the query was
+    # admitted through a resource group: carries the group path /
+    # scheduling weight for the device scheduler and the group memory
+    # account for the query pool
+    serving: object = None
 
 
 def _schema_exists(session: "Session", schema: str) -> bool:

@@ -1,3 +1,7 @@
-from .protocol import PrestoTpuServer
+from .protocol import PrestoTpuServer, StatementServer
+from .resource_groups import (
+    QueryQueuedTimeoutError, QueryQueueFullError, ResourceGroupManager,
+)
 
-__all__ = ["PrestoTpuServer"]
+__all__ = ["PrestoTpuServer", "StatementServer", "ResourceGroupManager",
+           "QueryQueueFullError", "QueryQueuedTimeoutError"]

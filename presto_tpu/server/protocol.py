@@ -52,17 +52,34 @@ def _json_value(v):
     return str(v)
 
 
+def _runner_accepts_serving(runner) -> bool:
+    import inspect
+    try:
+        return "serving" in inspect.signature(
+            runner.execute).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 class _Query:
     """One running statement: executes in a thread, pages buffered."""
 
     def __init__(self, qid: str, slug: str, sql: str, runner,
                  session_overrides: Dict[str, str],
-                 admission=None, user: str = ""):
+                 admission=None, user: str = "",
+                 accepts_serving: Optional[bool] = None):
         self.user = user
         self.id = qid
         self.slug = slug
         self.sql = sql
         self._admission = admission
+        # serving-plane handoff (group memory account + scheduler
+        # share) rides runner.execute(serving=...) when the runner
+        # supports it; protocol doubles in tests may not. The server
+        # probes its runner ONCE (an invariant — not per statement).
+        self._accepts_serving = (_runner_accepts_serving(runner)
+                                 if accepts_serving is None
+                                 else accepts_serving)
         self.state = "QUEUED"
         self.error: Optional[Dict] = None
         self.columns: Optional[List[Dict]] = None
@@ -82,25 +99,60 @@ class _Query:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def _queued_timeout_override(self):
+        """Per-query ``query_queued_timeout``: the client's session
+        override wins, else the server session's default (both validated
+        through config.SESSION_PROPERTIES)."""
+        override = self._overrides.get("query_queued_timeout")
+        if override is None:
+            session = getattr(self._runner, "session", None)
+            if session is not None:
+                override = session.properties.get("query_queued_timeout")
+        return override
+
     # -- producer ------------------------------------------------------------
     def _run(self) -> None:
+        from .resource_groups import QueryQueuedTimeoutError
+        serving = None
         try:
             # admission: block in QUEUED until the resource group grants
             # a run slot (reference dispatcher/DispatchManager.java:134 +
-            # resourcegroups/InternalResourceGroup run/queue decision)
+            # resourcegroups/InternalResourceGroup run/queue decision);
+            # a deadline (queryQueuedTimeout group config /
+            # query_queued_timeout session prop) fails the query with a
+            # distinct verdict instead of waiting forever
             if self._admission is not None:
+                timeout = self._admission.queued_timeout_s(
+                    self._queued_timeout_override())
+                deadline = (self._admission.submit_time + timeout
+                            if timeout is not None else None)
                 while not self._admission.wait(0.1):
                     if self._cancelled.is_set():
-                        self._admission.release()
                         return
+                    if deadline is not None \
+                            and time.monotonic() > deadline:
+                        self._admission.time_out()
+                        raise QueryQueuedTimeoutError(
+                            f"query exceeded its queued timeout of "
+                            f"{timeout:g}s in resource group "
+                            f"{self._admission.group.path!r}")
+                from ..serving.groups import serving_context
+                serving = serving_context(self._admission)
             self.state = "RUNNING"
-            try:
-                res = self._runner.execute(
-                    self.sql, properties=dict(self._overrides),
-                    user=self.user, cancel_event=self._cancelled)
-            finally:
-                if self._admission is not None:
-                    self._admission.release()
+            kwargs = ({"serving": serving}
+                      if serving is not None and self._accepts_serving
+                      else {})
+            res = self._runner.execute(
+                self.sql, properties=dict(self._overrides),
+                user=self.user, cancel_event=self._cancelled, **kwargs)
+            # the slot frees as soon as execution completes: paging
+            # buffered rows out to a (possibly slow) client must not
+            # hold the group's concurrency slot (the finally below is
+            # the idempotent safety net for every other exit path)
+            if serving is not None:
+                serving.close()
+            if self._admission is not None:
+                self._admission.release()
             self.columns = [
                 {"name": n, "type": t.display()}
                 for n, t in zip(res.names, res.types)
@@ -127,6 +179,16 @@ class _Query:
             with self._state_lock:
                 if not self._cancelled.is_set():
                     self.state = "FINISHED"
+        except QueryQueuedTimeoutError as e:
+            with self._state_lock:
+                if not self._cancelled.is_set():
+                    self.state = "FAILED"
+                    self.error = {
+                        "message": str(e),
+                        "errorCode": 1,
+                        "errorName": "QUERY_QUEUED_TIMEOUT",
+                        "errorType": "INSUFFICIENT_RESOURCES",
+                    }
         except Exception as e:  # surfaced as QueryError, not a 500
             with self._state_lock:
                 if not self._cancelled.is_set():
@@ -134,11 +196,22 @@ class _Query:
                     self.error = {
                         "message": str(e),
                         "errorCode": 1,
-                        "errorName": type(e).__name__,
+                        "errorName": getattr(e, "name",
+                                             type(e).__name__),
                         "errorType": "USER_ERROR",
                     }
-            self._put_page(None)
-        self._put_page(None)          # end-of-stream sentinel
+        finally:
+            # admission leak fix: EVERY exit path — planning/execution
+            # failure, queued timeout, cancel while queued, even an
+            # unexpected paging error — releases the resource-group
+            # slot exactly once (release() is idempotent) and refunds
+            # residual group memory, so the group's running count
+            # always returns to zero
+            if serving is not None:
+                serving.close()
+            if self._admission is not None:
+                self._admission.release()
+            self._put_page(None)      # end-of-stream sentinel
 
     def _put_page(self, page) -> None:
         """Bounded put that gives up if the query is cancelled (a cancel
@@ -520,6 +593,7 @@ class PrestoTpuServer:
             from ..exec.runner import LocalRunner
             runner = LocalRunner()
         self.runner = runner
+        self._accepts_serving = _runner_accepts_serving(runner)
         self.queries: Dict[str, _Query] = {}
         self.shutting_down = False
         self._seq = 0
@@ -543,8 +617,14 @@ class PrestoTpuServer:
             qid = (f"{datetime.date.today().strftime('%Y%m%d')}"
                    f"_{self._seq:06d}")
         admission = self.resource_groups.submit(user=user, source=source)
-        q = _Query(qid, secrets.token_hex(8), sql, self.runner, overrides,
-                   admission, user=user)
+        try:
+            q = _Query(qid, secrets.token_hex(8), sql, self.runner,
+                       overrides, admission, user=user,
+                       accepts_serving=self._accepts_serving)
+        except BaseException:
+            # a construction failure must not strand the queue slot
+            admission.release()
+            raise
         with self._lock:
             self.queries[qid] = q
             if len(self.queries) > 200:   # evict oldest drained queries
@@ -588,7 +668,11 @@ class PrestoTpuServer:
         threading.Thread(target=drain, daemon=True).start()
 
     def stop(self) -> None:
-        self.httpd.shutdown()
+        # shutdown() handshakes with serve_forever — calling it on a
+        # server whose loop never started (embedded create_query use)
+        # would block forever
+        if self._thread.is_alive():
+            self.httpd.shutdown()
         self.httpd.server_close()
 
 

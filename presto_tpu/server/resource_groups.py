@@ -25,16 +25,62 @@ Configuration mirrors the file manager's JSON shape::
 
 Dequeue is deterministic weighted-fair: among sibling subgroups with
 queued queries, the one with the lowest running/weight ratio goes first.
+
+Serving-plane extensions (presto_tpu/serving/):
+
+- ``softMemoryLimit`` / ``hardMemoryLimit`` (bytes): running queries
+  charge their device-memory reservations to the admitting group chain
+  (serving/groups.QueryServingContext); a group over its soft limit
+  queues new queries instead of starting them, a reservation past the
+  hard limit kills the requesting query (reference
+  InternalResourceGroup.softMemoryLimit semantics).
+- ``queryQueuedTimeout`` (duration): a query still queued past the
+  deadline fails with QUERY_QUEUED_TIMEOUT instead of waiting forever
+  (overridable per query via the ``query_queued_timeout`` session
+  property).
+- ``schedulingWeight`` additionally drives the device scheduler's
+  per-group stride shares (exec/taskexec.py), so the weight governs
+  device quanta, not just dequeue order.
 """
 from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Dict, List, Optional
+
+from ..obs.metrics import REGISTRY
+
+_ADMITTED = REGISTRY.counter("resource_group_admitted_total")
+_QUEUED = REGISTRY.counter("resource_group_queued_total")
+_REJECTED = REGISTRY.counter("resource_group_rejected_total")
+_QUEUE_TIMEOUTS = REGISTRY.counter("resource_group_queued_timeout_total")
 
 
 class QueryQueueFullError(RuntimeError):
     pass
+
+
+class QueryQueuedTimeoutError(RuntimeError):
+    """Admission deadline exceeded (``queryQueuedTimeout`` group config
+    or ``query_queued_timeout`` session property)."""
+
+    name = "QUERY_QUEUED_TIMEOUT"
+
+
+def _parse_limit_bytes(v) -> Optional[int]:
+    if v is None:
+        return None
+    return int(v)
+
+
+def _parse_timeout_s(v) -> Optional[float]:
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    from ..exec.cluster import parse_duration_s
+    return parse_duration_s(v)
 
 
 class Admission:
@@ -43,8 +89,23 @@ class Admission:
 
     def __init__(self, group: "ResourceGroup"):
         self.group = group
+        self.submit_time = time.monotonic()
         self._granted = threading.Event()
         self._released = False
+
+    def queued_timeout_s(self, override=None) -> Optional[float]:
+        """Effective admission deadline in seconds: the per-query
+        session-property override wins, else the leaf group's
+        ``queryQueuedTimeout``; None = wait forever."""
+        if override is not None:
+            return _parse_timeout_s(override)
+        return self.group.query_queued_timeout
+
+    def time_out(self) -> None:
+        """Mark this admission as dead-on-queue: releases the queue slot
+        and counts the timeout (callers raise QueryQueuedTimeoutError)."""
+        _QUEUE_TIMEOUTS.inc()
+        self.release()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._granted.wait(timeout)
@@ -77,7 +138,10 @@ class ResourceGroup:
     def __init__(self, manager: "ResourceGroupManager", name: str,
                  parent: Optional["ResourceGroup"],
                  hard_concurrency_limit: int = 1,
-                 max_queued: int = 100, scheduling_weight: int = 1):
+                 max_queued: int = 100, scheduling_weight: int = 1,
+                 soft_memory_limit: Optional[int] = None,
+                 hard_memory_limit: Optional[int] = None,
+                 query_queued_timeout: Optional[float] = None):
         self.manager = manager
         self.name = name
         self.parent = parent
@@ -85,6 +149,13 @@ class ResourceGroup:
         self.hard_concurrency_limit = hard_concurrency_limit
         self.max_queued = max_queued
         self.scheduling_weight = max(scheduling_weight, 1)
+        #: device-memory bytes charged by this group's running queries
+        #: (and its descendants'), maintained under manager.memory_lock
+        #: by serving.groups.QueryServingContext
+        self.soft_memory_limit = soft_memory_limit
+        self.hard_memory_limit = hard_memory_limit
+        self.memory_reserved = 0
+        self.query_queued_timeout = query_queued_timeout
         self.children: Dict[str, ResourceGroup] = {}
         self.queue: List[Admission] = []
         self.running = 0
@@ -94,10 +165,19 @@ class ResourceGroup:
         return len(self.queue) + sum(c.queued_total()
                                      for c in self.children.values())
 
+    def over_soft_memory(self) -> bool:
+        return (self.soft_memory_limit is not None
+                and self.memory_reserved > self.soft_memory_limit)
+
     def can_run_more(self) -> bool:
         g: Optional[ResourceGroup] = self
         while g is not None:
             if g.running >= g.hard_concurrency_limit:
+                return False
+            if g.over_soft_memory():
+                # kill-or-queue: over the soft limit the group keeps its
+                # running queries but admits nothing new until memory
+                # returns (reference InternalResourceGroup.canRunMore)
                 return False
             g = g.parent
         return True
@@ -105,7 +185,8 @@ class ResourceGroup:
     def _pick_queued(self) -> Optional["ResourceGroup"]:
         """Deepest-first weighted-fair choice of a descendant leaf-queue
         with work, honoring every level's concurrency limit."""
-        if self.running >= self.hard_concurrency_limit:
+        if self.running >= self.hard_concurrency_limit \
+                or self.over_soft_memory():
             return None
         candidates = [c._pick_queued() for c in self.children.values()]
         candidates = [c for c in candidates if c is not None]
@@ -118,20 +199,43 @@ class ResourceGroup:
                                   g.path))
 
     def info(self) -> dict:
+        if self.over_soft_memory():
+            state = "OVER_SOFT_MEMORY_LIMIT"
+        elif self.running >= self.hard_concurrency_limit:
+            state = "FULL"
+        else:
+            state = "CAN_RUN"
         return {
             "id": self.path,
+            "state": state,
             "hardConcurrencyLimit": self.hard_concurrency_limit,
             "maxQueued": self.max_queued,
             "schedulingWeight": self.scheduling_weight,
+            "softMemoryLimitBytes": self.soft_memory_limit,
+            "hardMemoryLimitBytes": self.hard_memory_limit,
+            "memoryReservedBytes": self.memory_reserved,
+            "queryQueuedTimeoutS": self.query_queued_timeout,
             "numRunning": self.running,
             "numQueued": len(self.queue),
             "subGroups": [c.info() for c in self.children.values()],
         }
 
 
+_SCOPE_SEQ = iter(range(1, 1 << 62))
+
+
 class ResourceGroupManager:
     def __init__(self, config: Optional[dict] = None):
-        self.lock = threading.Lock()
+        from .._devtools.lockcheck import checked_lock
+        #: process-unique scope for this manager's groups: same-named
+        #: groups of DIFFERENT managers (two embedded servers in one
+        #: process) must not share one device-scheduler stride account
+        self.scope = f"rg{next(_SCOPE_SEQ)}"
+        self.lock = checked_lock("resourcegroups.manager")
+        #: guards the per-group memory ledgers — separate from ``lock``
+        #: because memory charges arrive from inside QueryMemoryPool
+        #: reservations (hot path) while ``lock`` serializes dispatch
+        self.memory_lock = checked_lock("resourcegroups.memory")
         self.roots: Dict[str, ResourceGroup] = {}
         self.selectors: List[dict] = []
         config = config or {
@@ -142,6 +246,10 @@ class ResourceGroupManager:
         for spec in config.get("rootGroups", []):
             self._build(spec, None)
         self.selectors = list(config.get("selectors", []))
+        # the system.runtime.resource_groups table reflects every live
+        # manager in the process (weak registration)
+        from ..serving.groups import register_manager
+        register_manager(self)
 
     def _build(self, spec: dict, parent: Optional[ResourceGroup]) -> None:
         g = ResourceGroup(
@@ -149,7 +257,13 @@ class ResourceGroupManager:
             hard_concurrency_limit=int(
                 spec.get("hardConcurrencyLimit", 1)),
             max_queued=int(spec.get("maxQueued", 100)),
-            scheduling_weight=int(spec.get("schedulingWeight", 1)))
+            scheduling_weight=int(spec.get("schedulingWeight", 1)),
+            soft_memory_limit=_parse_limit_bytes(
+                spec.get("softMemoryLimit")),
+            hard_memory_limit=_parse_limit_bytes(
+                spec.get("hardMemoryLimit")),
+            query_queued_timeout=_parse_timeout_s(
+                spec.get("queryQueuedTimeout")))
         if parent is None:
             self.roots[g.name] = g
         else:
@@ -181,10 +295,12 @@ class ResourceGroupManager:
         with self.lock:
             group = self._group_for(user, source)
             if group.queued_total() >= group.max_queued:
+                _REJECTED.inc()
                 raise QueryQueueFullError(
                     f"Too many queued queries for {group.path!r}")
             adm = Admission(group)
             group.queue.append(adm)
+            _QUEUED.inc()
         self._dispatch()
         return adm
 
@@ -204,6 +320,7 @@ class ResourceGroupManager:
                         walk.running += 1
                         walk = walk.parent
                     adm._granted.set()
+                    _ADMITTED.inc()
                     started = True
                 if not started:
                     return

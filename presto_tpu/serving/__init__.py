@@ -1,0 +1,22 @@
+"""Multi-tenant serving plane.
+
+The layer-3 analogue of the reference's dispatcher + resource-group
+subsystem (reference presto-main/.../dispatcher/DispatchManager.java +
+execution/resourcegroups/InternalResourceGroup.java), reshaped for one
+shared device and steady repeated traffic:
+
+- :mod:`presto_tpu.serving.plancache` — a compiled-plan cache keyed by
+  a parameterized statement fingerprint, so a repeated or EXECUTE'd
+  statement skips parse/plan/optimize entirely and lands on the
+  already-compiled executables in ``ops/jitcache``;
+- :mod:`presto_tpu.serving.groups` — the per-query serving context
+  that bridges an admitted resource group into execution: memory
+  reservations charged to the group (kill-or-queue on limits) and a
+  weighted device-scheduler share (``exec/taskexec``).
+
+``server/resource_groups.py`` stays the admission-control front;
+``exec/scancache.py`` contributes shared-scan batching (concurrent
+admitted queries over the same split attach to one in-flight decode).
+"""
+from .groups import QueryServingContext, group_snapshot  # noqa: F401
+from .plancache import PLANS, PlanCache, cached_plan  # noqa: F401

@@ -113,6 +113,48 @@ def test_release_of_queued_admission_frees_no_slot():
     assert info["numRunning"] == 0 and info["numQueued"] == 0
 
 
+def test_group_config_parses_serving_keys():
+    """softMemoryLimit / hardMemoryLimit / queryQueuedTimeout parse from
+    the JSON config and surface in info() (docs/serving.md schema)."""
+    m = ResourceGroupManager({
+        "rootGroups": [{"name": "g", "hardConcurrencyLimit": 4,
+                        "softMemoryLimit": 1 << 30,
+                        "hardMemoryLimit": 2 << 30,
+                        "queryQueuedTimeout": "1.5s"}],
+        "selectors": [{"group": "g"}]})
+    g = m.roots["g"]
+    assert g.soft_memory_limit == 1 << 30
+    assert g.hard_memory_limit == 2 << 30
+    assert g.query_queued_timeout == 1.5
+    info = m.info()[0]
+    assert info["softMemoryLimitBytes"] == 1 << 30
+    assert info["memoryReservedBytes"] == 0
+    assert info["state"] == "CAN_RUN"
+
+
+def test_over_soft_memory_queues_until_release():
+    """A group past softMemoryLimit stops admitting (kill-or-queue);
+    the queued query starts the moment memory returns."""
+    m = ResourceGroupManager({
+        "rootGroups": [{"name": "g", "hardConcurrencyLimit": 8,
+                        "softMemoryLimit": 100}],
+        "selectors": [{"group": "g"}]})
+    g = m.roots["g"]
+    a = m.submit()
+    assert a.granted
+    with m.memory_lock:
+        g.memory_reserved = 150          # over the soft limit
+    assert m.info()[0]["state"] == "OVER_SOFT_MEMORY_LIMIT"
+    b = m.submit()
+    assert not b.granted                 # queued, not started
+    with m.memory_lock:
+        g.memory_reserved = 0
+    m._dispatch()
+    assert b.granted
+    b.release()
+    a.release()
+
+
 def test_server_queues_second_query():
     """Server-level: with the default serial group, a second statement
     stays QUEUED until the first finishes."""

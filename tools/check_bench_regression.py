@@ -13,11 +13,19 @@ the ratio is stable across rounds on one machine class) with a
 per-query tolerance, and emits one JSON verdict plus a matching exit
 code.
 
+The same gate covers the SERVING summary (``SERVING_r*.json``, written
+by ``SERVING_OUT=path python bench.py serving``): pass ``--kind
+serving`` to diff QPS / p95 latency / warm-speedup against the latest
+committed serving round. Latency metrics (``*_ms`` / ``*_latency_ms``)
+are lower-is-better — the gate inverts their ratio automatically.
+
 Usage:
     python tools/check_bench_regression.py --run bench_out.json
     python tools/check_bench_regression.py --run bench_out.json \
         --tolerance 10 --tolerance-for q55=25 --tolerance-for q3=15
+    python tools/check_bench_regression.py --kind serving --run s.json
     python tools/check_bench_regression.py --smoke       # self-test
+    python tools/check_bench_regression.py --kind serving --smoke
 
 ``--run`` accepts either bench.py's summary line (written via
 ``BENCH_OUT=path python bench.py``), a file whose LAST JSON line is
@@ -52,14 +60,22 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_TOLERANCE_PCT = 10.0
 
 
-def latest_bench_file(root: str = _REPO) -> Optional[str]:
-    """Highest-numbered BENCH_r*.json — the pinned trajectory."""
+def latest_bench_file(root: str = _REPO,
+                      prefix: str = "BENCH") -> Optional[str]:
+    """Highest-numbered <prefix>_r*.json — the pinned trajectory
+    (``BENCH`` for per-query rounds, ``SERVING`` for the concurrent-
+    throughput axis)."""
     best, best_n = None, -1
-    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
-        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+    for p in glob.glob(os.path.join(root, f"{prefix}_r*.json")):
+        m = re.search(rf"{prefix}_r(\d+)\.json$", os.path.basename(p))
         if m and int(m.group(1)) > best_n:
             best, best_n = p, int(m.group(1))
     return best
+
+
+def _lower_is_better(metric: str) -> bool:
+    """Latency-flavoured metrics regress by going UP."""
+    return metric.endswith("_ms") or metric.endswith("_latency_ms")
 
 
 def _flatten(summary: Dict) -> Dict[str, Dict]:
@@ -148,7 +164,21 @@ def compare(baseline: Dict[str, Dict], run: Dict[str, Dict],
                            "ratio": None, "tolerance_pct": pct,
                            "ok": True, "note": "not comparable"})
             continue
-        ratio = r / b
+        # lower-is-better metrics (latency) invert: ratio stays
+        # "1.0 = unchanged, < 1-tol = regressed" either way. A
+        # nonpositive latency is malformed, not infinitely fast —
+        # route it through the not-comparable path like other
+        # malformed values rather than reporting ratio 0 "regressed".
+        if _lower_is_better(metric):
+            if r <= 0:
+                checks.append({"metric": metric, "baseline": b,
+                               "run": r, "ratio": None,
+                               "tolerance_pct": pct, "ok": True,
+                               "note": "not comparable"})
+                continue
+            ratio = b / r
+        else:
+            ratio = r / b
         ok = ratio >= 1.0 - pct / 100.0
         checks.append({"metric": metric, "baseline": b, "run": r,
                        "ratio": round(ratio, 4), "tolerance_pct": pct,
@@ -168,12 +198,16 @@ def smoke(baseline_path: str) -> Dict:
     math, and verdict emission without running the engine."""
     baseline = load_summary(baseline_path)
     same = compare(baseline, baseline)
-    degraded = {
-        m: {**rec,
-            **({"vs_baseline": rec["vs_baseline"] * 0.5}
-               if rec.get("vs_baseline") is not None else {}),
-            "value": (rec.get("value") or 0) * 0.5}
-        for m, rec in baseline.items()}
+
+    def degrade(metric, rec):
+        # latency metrics regress UP, everything else DOWN
+        factor = 2.0 if _lower_is_better(metric) else 0.5
+        out = {**rec, "value": (rec.get("value") or 0) * factor}
+        if rec.get("vs_baseline") is not None:
+            out["vs_baseline"] = rec["vs_baseline"] * factor
+        return out
+
+    degraded = {m: degrade(m, rec) for m, rec in baseline.items()}
     worse = compare(baseline, degraded)
     ok = same["verdict"] == "pass" and worse["verdict"] == "fail"
     return {"verdict": "pass" if ok else "fail", "mode": "smoke",
@@ -209,12 +243,19 @@ def main(argv=None) -> int:
                     help="self-consistency mode (no engine run): "
                          "baseline-vs-itself must pass, a degraded "
                          "copy must fail")
+    ap.add_argument("--kind", choices=("bench", "serving"),
+                    default="bench",
+                    help="which pinned trajectory to gate: per-query "
+                         "BENCH_r*.json (default) or the concurrent-"
+                         "throughput SERVING_r*.json")
     args = ap.parse_args(argv)
 
-    baseline_path = args.baseline or latest_bench_file()
+    prefix = "SERVING" if args.kind == "serving" else "BENCH"
+    baseline_path = args.baseline or latest_bench_file(prefix=prefix)
     if baseline_path is None or not os.path.exists(baseline_path):
         print(json.dumps({"verdict": "error",
-                          "error": "no BENCH_r*.json baseline found"}))
+                          "error": f"no {prefix}_r*.json baseline "
+                                   "found"}))
         return 2
 
     try:
