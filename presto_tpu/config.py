@@ -148,6 +148,9 @@ _sp("speculative_execution", "boolean", True,
     "duplicate straggler tasks on another node, first finished wins")
 _sp("spill_partitions", "integer", 16,
     "hash partitions for spill-to-host aggregation")
+_sp("spool_exchange", "boolean", True,
+    "write exchange pages through to the durable page-addressed spool "
+    "under retry_policy=TASK (false = PR 5 retained in-memory buffers)")
 _sp("spill_path", "varchar", None,
     "directory for second-tier disk spill pages")
 _sp("spill_to_disk_bytes", "integer", 4 << 30,
@@ -244,6 +247,10 @@ CONFIG_KEYS: Dict[str, str] = {
                  "SESSION_PROPERTIES at boot)",
     "scan-cache.max-bytes": "process-wide device scan-cache resident "
                             "limit (deliberately not a session prop)",
+    "spool.dir": "exchange spool directory (exec/spool.py); point "
+                 "every node at shared storage for cross-node replay",
+    "spool.max-bytes": "spool disk budget; appends past it fail the "
+                       "writing task (default 4GiB)",
     "failpoints": "deterministic fault-injection spec "
                   "(exec/failpoints.py grammar)",
     # resource-groups.json group keys (server/resource_groups.py; not
@@ -378,6 +385,10 @@ class NodeConfig:
         #: (exec/scancache.py); None keeps the built-in default
         raw_sc = props.get("scan-cache.max-bytes")
         self.scan_cache_bytes = int(raw_sc) if raw_sc else None
+        #: exchange-spool backend config (exec/spool.py SPOOL)
+        self.spool_dir = props.get("spool.dir")
+        raw_sp = props.get("spool.max-bytes")
+        self.spool_max_bytes = int(raw_sp) if raw_sp else None
         #: deterministic fault-injection spec (exec/failpoints.py
         #: grammar, ';'-separated) — chaos/soak runs arm failpoints
         #: straight from config.properties, same as the
@@ -425,6 +436,10 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
     if cfg.scan_cache_bytes is not None:
         from .exec.scancache import CACHE
         CACHE.set_limit(cfg.scan_cache_bytes)
+    if cfg.spool_dir or cfg.spool_max_bytes is not None:
+        from .exec.spool import SPOOL
+        SPOOL.configure(directory=cfg.spool_dir,
+                        max_bytes=cfg.spool_max_bytes)
     if cfg.failpoints:
         from .exec.failpoints import FAILPOINTS
         FAILPOINTS.configure_from_spec(cfg.failpoints)

@@ -1,0 +1,16 @@
+"""Retry backoff helpers shared by coordinator and worker planes.
+
+Lives in ``exec/`` so the coordinator's retry path (exec/cluster.py)
+does not have to import the worker HTTP module for a six-line helper.
+"""
+from __future__ import annotations
+
+import random
+
+
+def jittered(seconds: float) -> float:
+    """Retry backoff with +/-50% uniform jitter: deterministic
+    exponential backoff synchronizes N consumers' retries into bursts
+    that hammer a recovering worker; jitter spreads them (reference
+    airlift Backoff adds the same randomization)."""
+    return seconds * random.uniform(0.5, 1.5)

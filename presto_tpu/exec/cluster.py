@@ -236,6 +236,8 @@ _QUERY_RETRIES = REGISTRY.counter("query_retry_total")
 _SPEC_LAUNCHED = REGISTRY.counter("speculative_launched_total")
 _SPEC_WON = REGISTRY.counter("speculative_won_total")
 _NODES_DRAINED = REGISTRY.counter("node_drained_total")
+_NODES_JOINED = REGISTRY.counter("node_joined_total")
+_SPOOL_REPLAYED = REGISTRY.counter("spool_replayed_task_total")
 
 
 class StageMonitor:
@@ -396,7 +398,7 @@ class _QueryExecution:
     def __init__(self, runner: "ClusterRunner", fp: FragmentedPlan,
                  init_values: List[object], workers: List[str],
                  exec_id: str, monitor: StageMonitor,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None, session=None):
         self.runner = runner
         self.fp = fp
         self.init_values = init_values
@@ -404,7 +406,8 @@ class _QueryExecution:
         self.exec_id = exec_id
         self.monitor = monitor
         self.deadline = deadline        # time.monotonic() cutoff
-        session = runner.session
+        session = session if session is not None else runner.session
+        self.session = session
         self.policy = _retry_policy(session)
         self.max_task_retries = int(
             session.properties.get("task_retry_attempts", 2))
@@ -413,10 +416,19 @@ class _QueryExecution:
         from ..planner.planner import bool_property
         self.spec_enabled = self.policy == "TASK" and bool_property(
             session, "speculative_execution", True)
-        # retained buffers let a re-created consumer re-read a healthy
-        # upstream attempt's complete output from token 0 — the
-        # in-memory stand-in for reference spooled exchange storage
-        self.retain = self.policy == "TASK"
+        # spooled exchange (exec/spool.py, default on): non-root tasks
+        # write every output page through to the durable page-
+        # addressed spool, so consumers replay by token (retries and
+        # speculative attempts never re-run healthy upstreams), a
+        # drained worker exits without lingering, and shuffle size is
+        # no longer capped by worker RAM. spool_exchange=false falls
+        # back to PR 5's retained in-memory buffers.
+        self.spool = self.policy == "TASK" and bool_property(
+            session, "spool_exchange", True)
+        self.retain = self.policy == "TASK" and not self.spool
+        #: keys whose lost-but-spool-complete attempt was preserved
+        #: instead of re-created (the replay-not-rerun ledger)
+        self.spool_preserved: Set[Tuple[int, int]] = set()
         # -- graph ------------------------------------------------------------
         self.frag_of: Dict[int, PlanFragment] = {
             f.id: f for f in fp.fragments}
@@ -548,13 +560,15 @@ class _QueryExecution:
             self.attempt_no[key] = attempt
             task_id = self._task_id(key, attempt)
             retain = self.retain and key[0] != self.root_fid
+            spool = self.spool and key[0] != self.root_fid
             try:
                 url = self.runner._create_task(
                     worker, self.exec_id, f, key[1],
                     self.n_buffers_of[f.id],
                     self.splits_of.get(key, []),
                     self._sources_for(f), self.init_values,
-                    task_id=task_id, retain=retain)
+                    task_id=task_id, retain=retain, spool=spool,
+                    session=self.session)
             except QueryFailedError:
                 # the chosen worker is unreachable: exclude it and try
                 # the next one (its running tasks are recovered by the
@@ -625,8 +639,19 @@ class _QueryExecution:
             self.abort_all()
             raise QueryFailedError(
                 "query exceeded query_max_run_time "
-                f"({self.runner.session.properties.get('query_max_run_time')})"
+                f"({self.session.properties.get('query_max_run_time')})"
             )
+
+    def _spool_complete(self, at: _TaskAttempt) -> bool:
+        """True when this attempt committed its full output to the
+        durable spool (its ``.done`` marker exists): consumers replay
+        its pages from storage, so losing the worker does NOT require
+        re-running the task."""
+        if not self.spool:
+            return False
+        from .spool import SPOOL
+        return SPOOL.finished_tokens(self.exec_id,
+                                     at.task_id) is not None
 
     def _probe(self):
         """One status sweep over current attempts. Returns
@@ -656,8 +681,33 @@ class _QueryExecution:
                 return None, f"worker {at.worker} unreachable: {e}"
 
         for key, at in list(self.tasks.items()):
+            if key in self.spool_preserved:
+                # this attempt's worker is gone but its complete
+                # output lives in the spool — report it FINISHED
+                # without probing the dead host again
+                statuses.append({"taskId": at.task_id,
+                                 "state": "FINISHED", "elapsedMs": 0,
+                                 "rowsOut": 0, "bytesOut": 0})
+                continue
             st, why = fetch(at)
             if st is None:
+                if self._spool_complete(at):
+                    # the task finished and committed its spool before
+                    # its worker vanished (drain exit, crash after
+                    # FINISH): replay, don't re-run — the whole point
+                    # of the spooled exchange
+                    self.spool_preserved.add(key)
+                    _SPOOL_REPLAYED.inc()
+                    self.events.append(
+                        {"kind": "spool_replay", "task": at.task_id,
+                         "worker": at.worker})
+                    LOG.log("spool_replayed", query_id=self.exec_id,
+                            task_id=at.task_id, worker=at.worker)
+                    statuses.append({"taskId": at.task_id,
+                                     "state": "FINISHED",
+                                     "elapsedMs": 0, "rowsOut": 0,
+                                     "bytesOut": 0})
+                    continue
                 failed[key] = f"lost task {at.task_id} ({why})"
                 continue
             statuses.append(st)
@@ -728,6 +778,10 @@ class _QueryExecution:
         upstream attempts' retained buffers from token 0."""
         for fid in self._downstream_fids(fids):
             for key in self.parts[fid]:
+                # the fresh attempt is live again: a stale spool
+                # preservation would make _probe fabricate FINISHED
+                # for it forever and blind lost-task detection
+                self.spool_preserved.discard(key)
                 old = self.tasks[key]
                 sp = self.spec.pop(key, None)
                 if sp is not None:
@@ -792,15 +846,23 @@ class _QueryExecution:
                 raise QueryFailedError(
                     f"task {self.tasks[key].task_id} failed after "
                     f"{used} attempts: {why}")
-        time.sleep(min(self.backoff_s * (2 ** (max_used - 1)), 2.0))
+        from .backoff import jittered
+        time.sleep(jittered(min(self.backoff_s * (2 ** (max_used - 1)),
+                                2.0)))
         # replace failed attempts upstream-first, then cascade to every
-        # transitive consumer (they re-read retained buffers)
+        # transitive consumer (they re-read spooled/retained output
+        # from token 0)
         replace = {k for k in failed if k not in collateral} \
             or set(failed)
         for f in self.fp.fragments:
             for key in self.parts[f.id]:
                 if key not in replace:
                     continue
+                # an explicitly-billed upstream (e.g. its spool copy
+                # came back corrupt) must actually re-run: drop the
+                # preservation so _probe stops reporting the dead
+                # attempt FINISHED
+                self.spool_preserved.discard(key)
                 old = self.tasks[key]
                 sp = self.spec.pop(key, None)
                 self._delete(old)
@@ -954,6 +1016,12 @@ class ClusterRunner:
         node registry — the feed of ``system.runtime.nodes`` and of the
         node-labeled series on the coordinator's ``/v1/metrics``."""
         nid = str(info.get("nodeId") or url)
+        if url not in self._node_ids:
+            # first contact with this worker — covers boot-time
+            # membership AND mid-query elastic joins (a worker that
+            # announced while queries were running)
+            _NODES_JOINED.inc()
+            LOG.log("node_joined", node_id=nid, uri=url)
         self._node_ids[url] = nid
         state = str(info.get("state", "ACTIVE"))
         if state == "SHUTTING_DOWN" \
@@ -1050,7 +1118,11 @@ class ClusterRunner:
         last: Optional[Exception] = None
         for attempt in range(budget + 1):
             if attempt:
-                time.sleep(self.REQUEST_BACKOFF_S * (2 ** (attempt - 1)))
+                # jittered exponential backoff: N clients retrying a
+                # recovering worker must not synchronize into bursts
+                from .backoff import jittered
+                time.sleep(jittered(
+                    self.REQUEST_BACKOFF_S * (2 ** (attempt - 1))))
             req = urllib.request.Request(url, data=data, method=method)
             if data is not None:
                 req.add_header("Content-Type", "application/json")
@@ -1076,43 +1148,93 @@ class ClusterRunner:
             f"{budget + 1} attempts: {url}: {last}")
 
     # -- public API ----------------------------------------------------------
-    def execute(self, sql: str) -> QueryResult:
-        from ..sql.parser import parse_statement
+    def execute(self, sql: str,
+                properties: Optional[Dict[str, object]] = None,
+                user: str = "", cancel_event=None,
+                serving=None) -> QueryResult:
+        """Run one statement across the cluster. The keyword surface
+        matches LocalRunner.execute, so the statement protocol serves
+        a ClusterRunner through the SAME resource-group admission,
+        per-query session overlay, cancellation, and serving handoff —
+        multi-worker deployments get the PR 8 limits too. SELECTs ride
+        the compiled-plan cache (serving/plancache.py): a repeated
+        statement skips parse/plan/optimize straight to fragmenting."""
+        import dataclasses as _dc
+        from ..serving.plancache import cached_plan, parse_cached
         from ..sql import ast as A
-        stmt = parse_statement(sql)
-        if isinstance(stmt, A.Explain) and stmt.analyze \
-                and isinstance(stmt.statement, A.Query) \
-                and stmt.type == "logical" and stmt.format == "text":
-            return self._explain_analyze(stmt.statement, sql)
-        if not isinstance(stmt, A.Query):
-            return self.local.execute(sql)
-        plan = self.local.plan(sql)
+        stmt = parse_cached(sql)
+        analyze = isinstance(stmt, A.Explain) and stmt.analyze \
+            and isinstance(stmt.statement, A.Query) \
+            and stmt.type == "logical" and stmt.format == "text"
+        if not isinstance(stmt, A.Query) and not analyze:
+            return self.local.execute(sql, properties=properties,
+                                      user=user,
+                                      cancel_event=cancel_event,
+                                      serving=serving)
+        session = self.session
+        secured = bool(self.local.access_control.catalog_rules)
+        if properties or secured or serving is not None:
+            catalogs = session.catalogs
+            if secured:
+                from ..server.security import SecuredCatalogs
+                catalogs = SecuredCatalogs(catalogs, user,
+                                           self.local.access_control)
+            session = _dc.replace(
+                session, catalogs=catalogs, serving=serving,
+                properties={**session.properties, **(properties or {})})
+        if analyze:
+            # EXPLAIN ANALYZE runs the inner query: it goes through
+            # the SAME secured session overlay, privilege checks, and
+            # cancellation as a plain SELECT — analyzing a statement
+            # must never be a way around running it
+            return self._explain_analyze(stmt.statement, sql,
+                                         session=session, user=user,
+                                         cancel_event=cancel_event)
+        plan = cached_plan(stmt, session, user=user,
+                           secured=secured or self.local.roles.enforce)
+        if secured:
+            self.local._check_catalog_access(plan, user)
+        if self.local.roles.enforce:
+            self.local._check_select_privileges(plan, user)
         # init plans (uncorrelated scalar subqueries) run on the
         # coordinator; their values ship inside every task update
         from .local import run_init_plans, _Executor
-        ex = _Executor(self.session, self.rows_per_batch)
+        ex = _Executor(session, self.rows_per_batch)
         run_init_plans(ex, plan)
         init_values = ex.init_values
         fragmented = fragment_plan(plan.root)
-        return self._run_fragments(fragmented, init_values, sql)
+        return self._run_fragments(fragmented, init_values, sql,
+                                   session=session,
+                                   cancel_event=cancel_event,
+                                   user=user)
 
-    def _explain_analyze(self, query_stmt, sql: str) -> QueryResult:
+    def _explain_analyze(self, query_stmt, sql: str, session=None,
+                         user: str = "",
+                         cancel_event=None) -> QueryResult:
         """Cluster EXPLAIN ANALYZE: run the inner query on the cluster,
         then render the plan plus the stage summary and the
-        fault-tolerance section (retries/speculation) — the cluster
-        analogue of the local runner's trace/skew/scan-cache sections."""
+        fault-tolerance section (retries/speculation/spool replays) —
+        the cluster analogue of the local runner's trace/skew/scan-cache
+        sections. ``session`` is the caller's (possibly secured)
+        per-query overlay; planning against its catalogs enforces the
+        same access control as a plain SELECT."""
         from .. import types as T
         from ..planner.planner import plan_query
         from ..planner.optimizer import optimize
         from ..planner.printer import format_retry_summary, print_plan
         from .local import run_init_plans, _Executor
+        session = session if session is not None else self.session
         t0 = time.perf_counter()
-        plan = optimize(plan_query(query_stmt, self.session),
-                        self.session)
-        ex = _Executor(self.session, self.rows_per_batch)
+        plan = optimize(plan_query(query_stmt, session), session)
+        if self.local.roles.enforce:
+            self.local._check_select_privileges(plan, user)
+        ex = _Executor(session, self.rows_per_batch)
         run_init_plans(ex, plan)
         fragmented = fragment_plan(plan.root)
-        out = self._run_fragments(fragmented, ex.init_values, sql)
+        out = self._run_fragments(fragmented, ex.init_values, sql,
+                                  session=session,
+                                  cancel_event=cancel_event,
+                                  user=user)
         wall_ms = (time.perf_counter() - t0) * 1e3
         text = print_plan(plan)
         info = dict(self._last_run_info)
@@ -1122,7 +1244,7 @@ class ClusterRunner:
         if retry:
             text += "\n" + retry
         from ..planner.planner import bool_property
-        if bool_property(self.session, "profile", False):
+        if bool_property(session, "profile", False):
             # in-process workers share this process's EXECUTABLES
             # registry, so the section shows the run's compiled
             # kernels; remote workers keep theirs queryable on their
@@ -1146,7 +1268,9 @@ class ClusterRunner:
 
     def _run_fragments(self, fp: FragmentedPlan,
                        init_values: List[object],
-                       sql: str = "") -> QueryResult:
+                       sql: str = "", session=None,
+                       cancel_event=None, user: str = "") -> QueryResult:
+        session = session if session is not None else self.session
         workers = self._schedulable_or_raise()
         self._seq += 1
         qid = f"cq_{self._seq:06d}"
@@ -1156,14 +1280,14 @@ class ClusterRunner:
         # validate session properties BEFORE the RUNNING log entry is
         # appended: a bad value must raise without leaving a phantom
         # forever-RUNNING row in system.runtime.queries
-        policy = _retry_policy(self.session)
-        q_budget = int(self.session.properties.get(
+        policy = _retry_policy(session)
+        q_budget = int(session.properties.get(
             "query_retry_attempts", 1)) if policy == "QUERY" else 0
         max_run = parse_duration_s(
-            self.session.properties.get("query_max_run_time"))
+            session.properties.get("query_max_run_time"))
         deadline = (time.monotonic() + max_run) if max_run else None
         entry = QueryLogEntry(qid, "RUNNING", sql.strip(), 0.0,
-                              create_time=time.time())
+                              user=user, create_time=time.time())
         with self.local._state_lock:
             self.local.query_log.append(entry)
             # same bound LocalRunner.execute applies: a cluster-only
@@ -1186,10 +1310,12 @@ class ClusterRunner:
                     monitor = StageMonitor(qid)
                     run = _QueryExecution(self, fp, init_values,
                                           workers, exec_id, monitor,
-                                          deadline=deadline)
+                                          deadline=deadline,
+                                          session=session)
                     try:
                         run.schedule_all()
-                        out = self._collect(fp, run)
+                        out = self._collect(fp, run,
+                                            cancel_event=cancel_event)
                         break
                     except _QueryRetry as e:
                         run.abort_all()
@@ -1201,7 +1327,7 @@ class ClusterRunner:
                         LOG.log("query_retried", query_id=qid,
                                 attempt=qtry + 1, reason=str(e))
                         time.sleep(min(
-                            float(self.session.properties.get(
+                            float(session.properties.get(
                                 "task_retry_backoff_s", 0.05))
                             * (2 ** qtry), 2.0))
                         workers = self._schedulable_or_raise()
@@ -1214,6 +1340,15 @@ class ClusterRunner:
                             self._task_statuses(run.all_urls()))
                         self._harvest_spans(run.all_urls())
                         run.cleanup()
+                        # spool GC: this exec attempt's pages can
+                        # never be read again once its tasks are gone
+                        # (success, failure and abort all pass here) —
+                        # no orphaned per-query spool directories.
+                        # Spool-less runs (NONE policy,
+                        # spool_exchange=false) skip the per-worker
+                        # DELETE round trips entirely.
+                        if run.spool:
+                            self._release_spool(exec_id)
                         total_retries += run.retries
                         self._last_run_info = {
                             **run.summary(), "retries": total_retries,
@@ -1229,7 +1364,7 @@ class ClusterRunner:
             entry.error = error
             summary = monitor.summary()
             history = {
-                "query_id": qid, "query": entry.query, "user": "",
+                "query_id": qid, "query": entry.query, "user": user,
                 "state": entry.state, "error": error,
                 "error_code": None, "create_time": entry.create_time,
                 "elapsed_ms": round(entry.elapsed_ms, 3),
@@ -1247,7 +1382,7 @@ class ClusterRunner:
                     for st in monitor.last_statuses],
             }
             self.local.events.query_completed(QueryCompletedEvent(
-                query_id=qid, query=entry.query, user="",
+                query_id=qid, query=entry.query, user=user,
                 state=entry.state, elapsed_ms=entry.elapsed_ms,
                 error=error, create_time=entry.create_time,
                 history=history))
@@ -1310,34 +1445,49 @@ class ClusterRunner:
                      splits: List[Split], sources: Dict[int, List[str]],
                      init_values: List[object],
                      task_id: Optional[str] = None,
-                     retain: bool = False) -> str:
+                     retain: bool = False, spool: bool = False,
+                     session=None) -> str:
         if task_id is None:
             task_id = f"{qid}.{f.id}.{partition}"
+        session = session if session is not None else self.session
         doc = {
             "fragment": codec.encode(f.root),
             "output": {
                 "kind": f.output.kind if f.output else "single",
                 "keys": list(f.output.keys) if f.output else [],
                 "n_buffers": n_buffers,
-                # retain=True: acked pages survive so a re-created
-                # consumer attempt can re-read from token 0 (the
-                # fault-tolerance precondition)
+                # retain=True: acked pages survive in memory so a
+                # re-created consumer attempt can re-read from token 0
+                # (the spool_exchange=false fallback)
                 "retain": bool(retain),
+                # spool=True: every page writes through to the durable
+                # page-addressed spool (exec/spool.py) — replay
+                # storage that outlives this worker process
+                "spool": bool(spool),
             },
             "splits": [codec.encode(s) for s in splits],
             "sources": {str(k): v for k, v in sources.items()},
             "partition": partition,
             "session": {
-                "catalog": self.session.catalog,
-                "schema": self.session.schema,
+                "catalog": session.catalog,
+                "schema": session.schema,
                 "properties": {
-                    k: v for k, v in self.session.properties.items()
+                    k: v for k, v in session.properties.items()
                     if isinstance(v, (str, int, float, bool))
                 },
             },
             "init_values": codec.encode(list(init_values)),
             "rows_per_batch": self.rows_per_batch,
         }
+        serving = getattr(session, "serving", None)
+        if serving is not None:
+            # admitted-query handoff: the worker registers the query's
+            # device-scheduler handle under the admitting group's
+            # stride share, so cluster queries obey the same group
+            # weights as LocalRunner queries (serving/groups.py)
+            doc["serving"] = {"group": serving.scheduler_group,
+                              "weight": serving.weight,
+                              "label": serving.group_path}
         ctx = TRACER.context()
         if ctx is not None:
             # span context over the wire (the stage span is current):
@@ -1347,9 +1497,22 @@ class ClusterRunner:
                       body=doc)
         return f"{worker}/v1/task/{task_id}"
 
+    def _release_spool(self, exec_id: str) -> None:
+        """Per-query spool GC, everywhere: the coordinator's local
+        store (shared with in-process workers) plus a DELETE to every
+        worker for node-local spool directories."""
+        from .spool import SPOOL
+        SPOOL.release_query(exec_id)
+        for url in list(self.worker_urls):
+            try:
+                self._request(f"{url}/v1/spool/{exec_id}",
+                              method="DELETE", retries=0, timeout=5)
+            except Exception:
+                continue
+
     # -- result collection ---------------------------------------------------
-    def _collect(self, fp: FragmentedPlan,
-                 run: _QueryExecution) -> QueryResult:
+    def _collect(self, fp: FragmentedPlan, run: _QueryExecution,
+                 cancel_event=None) -> QueryResult:
         from .pages import deserialize_page
         from ..server.worker import unframe_pages
         out_node = fp.root.root
@@ -1359,6 +1522,12 @@ class ClusterRunner:
         token = 0
         cur = run.root_url()
         while True:
+            if cancel_event is not None and cancel_event.is_set():
+                # client-side cancel (protocol DELETE): abort every
+                # task everywhere and surface the cancellation
+                run.abort_all()
+                from ..errors import QueryCancelledError
+                raise QueryCancelledError("query cancelled")
             run.check_deadline()
             if run.root_url() != cur:
                 # the root task was re-created (retry cascade or a

@@ -31,9 +31,20 @@ class DiscoveryNodeManager:
 
     def announce(self, node_id: str, url: str,
                  state: str = "ACTIVE") -> None:
+        """Join/refresh membership — any time, mid-query included (the
+        scheduler's next sweep sees the node and re-created tasks land
+        on it). State ``GONE`` is an explicit leave: the node drops
+        out immediately instead of waiting out the TTL."""
+        if state == "GONE":
+            self.remove(node_id)
+            return
         with self._lock:
             self._nodes[node_id] = (url, time.monotonic(),
                                     state or "ACTIVE")
+
+    def remove(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
 
     def active_urls(self) -> List[str]:
         """Fresh announcements, draining nodes included — they still
@@ -100,6 +111,14 @@ class Announcer:
 
     def stop(self) -> None:
         self._stop.set()
+
+    def deregister(self) -> None:
+        """Explicit leave: stop the loop and push one final ``GONE``
+        announcement so the coordinator drops this node now (elastic
+        scale-in), not after the TTL."""
+        self._stop.set()
+        self.state = "GONE"
+        self.announce_once()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):

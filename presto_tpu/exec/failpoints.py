@@ -63,6 +63,14 @@ SITES: Dict[str, str] = {
                       "/v1/info (exec/cluster.py)",
     "scan.decode": "scan pipeline decodes one split batch, before "
                    "staging (exec/scancache.py)",
+    "spool.write": "exchange spool appends one output-buffer page "
+                   "(exec/spool.py); error fails the producing task",
+    "spool.read": "exchange spool reads one page back "
+                  "(exec/spool.py); error loses the spool copy",
+    "spool.corrupt": "error action flips one byte of the page being "
+                     "spooled while keeping the original checksum — "
+                     "plants an on-disk corruption for the read path "
+                     "to detect (exec/spool.py)",
 }
 
 

@@ -121,17 +121,40 @@ def _gather_chunks(schema: Schema,
 class SpillFile:
     """Append-only spill file of compressed wire pages (the role of
     reference spiller/FileSingleStreamSpiller.java's async file IO,
-    synchronous here — staging already decoupled the device)."""
+    synchronous here — staging already decoupled the device).
 
-    def __init__(self, directory: Optional[str] = None):
-        fd, self.path = tempfile.mkstemp(
-            prefix="presto-tpu-spill-", suffix=".bin", dir=directory)
-        self._f = os.fdopen(fd, "w+b")
+    Two construction modes share one read/append surface:
+
+    - anonymous (default): a mkstemp'd scratch file unlinked on close —
+      the spill tier's lifetime is the operator's;
+    - named (``path=``, ``delete=False``): a durable file at a caller-
+      chosen location that SURVIVES close — the exchange spool
+      (exec/spool.py) builds its page logs on this, where another
+      process (or a consumer that outlives the writer) reads the bytes
+      back after the writing task is gone. ``flush()`` makes appended
+      bytes visible to those foreign readers.
+    """
+
+    def __init__(self, directory: Optional[str] = None,
+                 path: Optional[str] = None, delete: bool = True):
+        self.delete = delete
+        if path is not None:
+            self.path = path
+            self._f = open(path, "a+b")
+        else:
+            fd, self.path = tempfile.mkstemp(
+                prefix="presto-tpu-spill-", suffix=".bin", dir=directory)
+            self._f = os.fdopen(fd, "w+b")
 
     def append(self, data: bytes) -> Tuple[int, int]:
         off = self._f.seek(0, os.SEEK_END)
         self._f.write(data)
         return off, len(data)
+
+    def flush(self) -> None:
+        """Push appended bytes to the OS so concurrent readers (spool
+        consumers in another process) observe complete frames."""
+        self._f.flush()
 
     def read(self, off: int, length: int) -> bytes:
         self._f.seek(off)
@@ -141,10 +164,11 @@ class SpillFile:
         try:
             self._f.close()
         finally:
-            try:
-                os.unlink(self.path)
-            except OSError:
-                pass
+            if self.delete:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
 
 
 def _chunk_host_bytes(ch: _StagedChunk) -> int:

@@ -1,0 +1,431 @@
+"""Page-addressed exchange spool: durable shuffle storage.
+
+The role of the reference's spooling exchange manager (reference
+presto-main fault-tolerant execution spools every exchange page to
+external storage — a filesystem/object-store directory every node can
+reach — so task retries replay pages instead of re-running producers,
+and a drained worker's output outlives its process). PR 5's fault
+tolerance used ``retain=True`` in-memory output buffers as an explicit
+stand-in; this module is the real thing, grown out of
+``exec/spill.py``'s :class:`~presto_tpu.exec.spill.SpillFile`:
+
+- every output-buffer page is appended, **attempt-versioned** (the
+  task id embeds the attempt suffix) and **token-addressed**, to a
+  per-query directory of page logs, one
+  ``<query>/<task_id>.b<buffer>.pages`` file per output buffer;
+- each frame is **checksummed** (crc32) at write time and verified at
+  read time — a corrupted page surfaces as
+  :class:`SpoolCorruptionError`, which the exchange layer converts
+  into an upstream-task failure so the retry layer re-runs the
+  producer instead of serving garbage;
+- a ``<task_id>.done`` marker (final token count per buffer) commits
+  the attempt: readers treat a marker-less task as incomplete and fall
+  back to normal retry semantics;
+- disk usage is **accounted** against ``spool.max-bytes`` (writes past
+  it raise :class:`SpoolFullError`) and **GC'd per query** on query
+  end and abort (``release_query``), so the chaos suite can assert no
+  orphaned per-query directories.
+
+Frame layout (append-only, partial trailing frames are ignored by
+readers — a writer killed mid-append never corrupts earlier pages)::
+
+    [u32 token][u32 length][u32 crc32(payload)][payload bytes]
+
+The store interface is pluggable (:class:`SpoolStore`); the shipped
+backend is local disk (:class:`LocalDiskSpoolStore`), which doubles as
+"shared storage" whenever ``spool.dir`` points every node at one
+filesystem — exactly how the in-process test clusters and single-host
+multi-worker deployments run. The process-wide instance is
+:data:`SPOOL`, configured via ``spool.dir`` / ``spool.max-bytes`` in
+``etc/config.properties``.
+
+Failpoint sites (exec/failpoints.py): ``spool.write`` fails an append
+(the producing task fails and retries), ``spool.read`` fails a page
+read (the consumer treats the spool copy as lost), and
+``spool.corrupt`` — armed with the ``error`` action — makes the write
+path deliberately flip one payload byte while recording the ORIGINAL
+checksum, planting an on-disk corruption for the read path to detect.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+import tempfile
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.metrics import REGISTRY
+from .failpoints import FAILPOINTS, FailpointError
+from .spill import SpillFile
+
+_WRITE_BYTES = REGISTRY.counter("spool_write_bytes_total")
+_READ_BYTES = REGISTRY.counter("spool_read_bytes_total")
+_CORRUPTIONS = REGISTRY.counter("spool_corruption_total")
+_GC_BYTES = REGISTRY.counter("spool_gc_bytes_total")
+_RESIDENT = REGISTRY.gauge("spool_resident_bytes")
+
+_FRAME = struct.Struct("<III")          # token, length, crc32
+DEFAULT_MAX_BYTES = 4 << 30
+
+
+class SpoolCorruptionError(RuntimeError):
+    """A spooled page failed its checksum (or went unreadable): the
+    spool copy is unusable and the producer must be re-run."""
+
+
+class SpoolFullError(RuntimeError):
+    """The store is at ``spool.max-bytes``; the writing task fails
+    (and retries once queries release their spool space)."""
+
+
+class SpoolWriter:
+    """One task attempt's write handle: page logs for each output
+    buffer plus the completion marker. Single-threaded by construction
+    (the task's producer thread is the only writer)."""
+
+    def __init__(self, store: "LocalDiskSpoolStore", query_id: str,
+                 task_id: str, n_buffers: int):
+        self.store = store
+        self.query_id = query_id
+        self.task_id = task_id
+        self.n_buffers = n_buffers
+        self._files: Dict[int, SpillFile] = {}
+        self._closed = False
+
+    def _file(self, buffer_id: int) -> SpillFile:
+        f = self._files.get(buffer_id)
+        if f is None:
+            path = self.store._page_path(self.query_id, self.task_id,
+                                         buffer_id, create=True)
+            f = self._files[buffer_id] = SpillFile(path=path,
+                                                  delete=False)
+        return f
+
+    def append(self, buffer_id: int, token: int, page: bytes) -> None:
+        key = f"{self.task_id}/{buffer_id}/{token}"
+        FAILPOINTS.hit("spool.write", key=key, task_id=self.task_id)
+        crc = zlib.crc32(page) & 0xFFFFFFFF
+        try:
+            # deliberate corruption injection: the frame keeps the
+            # ORIGINAL checksum while one payload byte flips — the read
+            # path must catch it (chaos scenario spool_corrupt)
+            FAILPOINTS.hit("spool.corrupt", key=key,
+                           task_id=self.task_id)
+        except FailpointError:
+            page = bytes([page[0] ^ 0xFF]) + page[1:] if page else page
+        frame = _FRAME.pack(token, len(page), crc) + page
+        self.store._reserve(self.query_id, len(frame))
+        f = self._file(buffer_id)
+        f.append(frame)
+        f.flush()
+        _WRITE_BYTES.inc(len(frame))
+
+    def finish(self, next_tokens: List[int]) -> None:
+        """Commit the attempt: every buffer's final token count becomes
+        durable BEFORE the task announces FINISHED, so a consumer that
+        sees the marker can trust the page logs are complete."""
+        for f in self._files.values():
+            f.flush()
+        doc = json.dumps({"tokens": [int(t) for t in next_tokens]})
+        path = self.store._done_path(self.query_id, self.task_id,
+                                     create=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(doc)
+        os.replace(tmp, path)
+        self.store._reserve(self.query_id, len(doc))
+        self.close()
+
+    def abandon(self) -> None:
+        """Drop a failed/aborted attempt's partial page logs now (the
+        per-query GC at query end is the backstop)."""
+        self.close()
+        self.store._drop_task(self.query_id, self.task_id,
+                              self.n_buffers)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files = {}
+
+
+class SpoolStore:
+    """Backend interface; implementations must be safe for concurrent
+    writers (distinct task attempts) and readers."""
+
+    def writer(self, query_id: str, task_id: str,
+               n_buffers: int) -> SpoolWriter:
+        raise NotImplementedError
+
+    def finished_tokens(self, query_id: str,
+                        task_id: str) -> Optional[List[int]]:
+        raise NotImplementedError
+
+    def read_pages(self, query_id: str, task_id: str, buffer_id: int,
+                   token: int,
+                   max_bytes: int = 8 << 20) -> Tuple[List[bytes], int]:
+        raise NotImplementedError
+
+    def release_query(self, query_id: str) -> int:
+        raise NotImplementedError
+
+
+class _FileIndex:
+    """Incremental frame index over one append-only page log: repeated
+    reads re-scan only bytes appended since the last scan. Owns its
+    own lock so a cold scan of a large page log (disk I/O) never
+    holds the store-wide lock that every producer's per-page
+    ``_reserve`` takes."""
+
+    __slots__ = ("scanned", "frames", "lock")
+
+    def __init__(self):
+        from .._devtools.lockcheck import checked_lock
+        self.scanned = 0
+        self.frames: Dict[int, Tuple[int, int, int]] = {}
+        # token -> (payload offset, length, crc)
+        self.lock = checked_lock("spool.file-index")
+
+
+class LocalDiskSpoolStore(SpoolStore):
+    """Local-filesystem backend: ``<dir>/<query_id>/`` per query.
+    Point ``spool.dir`` at shared storage (NFS, a host-local dir for
+    in-process clusters) and every node reads every node's pages —
+    the property the drain fast-exit and worker-death replay paths
+    rely on."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        from .._devtools.lockcheck import checked_lock
+        self._lock = checked_lock("spool.store")
+        self._dir = directory
+        self.max_bytes = int(max_bytes)
+        self._query_bytes: Dict[str, int] = {}
+        self._index: Dict[str, _FileIndex] = {}
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, directory: Optional[str] = None,
+                  max_bytes: Optional[int] = None) -> None:
+        """Apply ``spool.dir`` / ``spool.max-bytes`` (config boot path;
+        per-node, BEFORE any query runs)."""
+        with self._lock:
+            if directory:
+                self._dir = directory
+            if max_bytes is not None:
+                self.max_bytes = int(max_bytes)
+
+    @property
+    def directory(self) -> str:
+        with self._lock:
+            if self._dir is None:
+                self._dir = tempfile.mkdtemp(prefix="presto-tpu-spool-")
+            os.makedirs(self._dir, exist_ok=True)
+            return self._dir
+
+    # -- paths ---------------------------------------------------------------
+    def _query_dir(self, query_id: str, create: bool = False) -> str:
+        d = os.path.join(self.directory, query_id)
+        if create:
+            os.makedirs(d, exist_ok=True)
+        return d
+
+    def _page_path(self, query_id: str, task_id: str, buffer_id: int,
+                   create: bool = False) -> str:
+        # readers never create: a late read after release_query must
+        # not resurrect an empty per-query directory (the chaos suite
+        # asserts zero orphans)
+        return os.path.join(self._query_dir(query_id, create=create),
+                            f"{task_id}.b{buffer_id}.pages")
+
+    def _done_path(self, query_id: str, task_id: str,
+                   create: bool = False) -> str:
+        return os.path.join(self._query_dir(query_id, create=create),
+                            f"{task_id}.done")
+
+    # -- accounting ----------------------------------------------------------
+    def _reserve(self, query_id: str, n: int) -> None:
+        with self._lock:
+            total = sum(self._query_bytes.values())
+            if total + n > self.max_bytes:
+                raise SpoolFullError(
+                    f"spool at {total} of {self.max_bytes} bytes "
+                    f"(spool.max-bytes); cannot append {n}")
+            self._query_bytes[query_id] = \
+                self._query_bytes.get(query_id, 0) + n
+            _RESIDENT.set(total + n)
+
+    def usage(self) -> Dict[str, int]:
+        with self._lock:
+            return {"bytes": sum(self._query_bytes.values()),
+                    "queries": len(self._query_bytes),
+                    "max_bytes": self.max_bytes}
+
+    # -- write side ----------------------------------------------------------
+    def writer(self, query_id: str, task_id: str,
+               n_buffers: int) -> SpoolWriter:
+        return SpoolWriter(self, query_id, task_id, n_buffers)
+
+    # -- read side -----------------------------------------------------------
+    def finished_tokens(self, query_id: str,
+                        task_id: str) -> Optional[List[int]]:
+        """The committed attempt's per-buffer token counts, or None
+        while the attempt is incomplete/unknown (normal retry applies)."""
+        path = self._done_path(query_id, task_id)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return [int(t) for t in json.load(f)["tokens"]]
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _scan(self, idx: _FileIndex, path: str) -> None:
+        """Extend the frame index over newly appended bytes (caller
+        holds the INDEX lock, not the store lock). A partial trailing
+        frame (writer mid-append) is left unindexed until it
+        completes."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size <= idx.scanned:
+            return
+        with open(path, "rb") as f:
+            f.seek(idx.scanned)
+            off = idx.scanned
+            while True:
+                head = f.read(_FRAME.size)
+                if len(head) < _FRAME.size:
+                    break
+                token, length, crc = _FRAME.unpack(head)
+                if off + _FRAME.size + length > size:
+                    break               # partial trailing frame
+                idx.frames[token] = (off + _FRAME.size, length, crc)
+                f.seek(length, os.SEEK_CUR)
+                off += _FRAME.size + length
+            idx.scanned = off
+
+    def read_pages(self, query_id: str, task_id: str, buffer_id: int,
+                   token: int,
+                   max_bytes: int = 8 << 20) -> Tuple[List[bytes], int]:
+        """Pages at/after ``token`` in token order (bounded by
+        ``max_bytes``), with checksum verification. Returns
+        ``(pages, next_token)``; an unreadable or checksum-failing page
+        raises :class:`SpoolCorruptionError`."""
+        path = self._page_path(query_id, task_id, buffer_id)
+        with self._lock:
+            idx = self._index.get(path)
+            if idx is None:
+                idx = self._index[path] = _FileIndex()
+        with idx.lock:
+            self._scan(idx, path)
+            want: List[Tuple[int, Tuple[int, int, int]]] = []
+            t = token
+            while t in idx.frames:
+                want.append((t, idx.frames[t]))
+                t += 1
+        out: List[bytes] = []
+        nxt = token
+        size = 0
+        if not want:
+            # nothing indexed at/after this token (unknown task,
+            # abandoned attempt, or the writer hasn't got there yet):
+            # an empty read, not an error — the caller's completion
+            # marker decides whether more was promised
+            return out, nxt
+        try:
+            with open(path, "rb") as f:
+                for t, (off, length, crc) in want:
+                    FAILPOINTS.hit(
+                        "spool.read",
+                        key=f"{task_id}/{buffer_id}/{t}",
+                        task_id=task_id)
+                    f.seek(off)
+                    page = f.read(length)
+                    if len(page) != length \
+                            or (zlib.crc32(page) & 0xFFFFFFFF) != crc:
+                        _CORRUPTIONS.inc()
+                        raise SpoolCorruptionError(
+                            f"spool page {task_id}/b{buffer_id}/t{t} "
+                            f"failed checksum")
+                    out.append(page)
+                    _READ_BYTES.inc(len(page))
+                    nxt = t + 1
+                    size += length
+                    if size >= max_bytes:
+                        break
+        except OSError as e:
+            raise SpoolCorruptionError(
+                f"spool page log {task_id}/b{buffer_id} unreadable: "
+                f"{e}") from None
+        return out, nxt
+
+    # -- GC ------------------------------------------------------------------
+    def _drop_task(self, query_id: str, task_id: str,
+                   n_buffers: int) -> None:
+        freed = 0
+        paths = [self._done_path(query_id, task_id)] + [
+            self._page_path(query_id, task_id, b)
+            for b in range(n_buffers)]
+        for p in paths:
+            try:
+                freed += os.path.getsize(p)
+                os.unlink(p)
+            except OSError:
+                pass
+            with self._lock:
+                self._index.pop(p, None)
+        # a straggler attempt appending AFTER its query's
+        # release_query (abort sets the flag, the task thread may be
+        # mid-append) briefly resurrects the per-query directory and
+        # its accounting entry; its abandon() lands here — drop the
+        # emptied directory and the zeroed entry so nothing orphans
+        try:
+            os.rmdir(os.path.join(self.directory, query_id))
+        except OSError:
+            pass                        # non-empty or already gone
+        with self._lock:
+            q = self._query_bytes.get(query_id, 0)
+            if q - freed <= 0:
+                self._query_bytes.pop(query_id, None)
+            else:
+                self._query_bytes[query_id] = q - freed
+            _RESIDENT.set(sum(self._query_bytes.values()))
+        if freed:
+            _GC_BYTES.inc(freed)
+
+    def release_query(self, query_id: str) -> int:
+        """Remove the query's spool directory (query end / abort).
+        Idempotent — coordinator and every worker may each release."""
+        d = os.path.join(self.directory, query_id)
+        with self._lock:
+            freed = self._query_bytes.pop(query_id, 0)
+            prefix = d + os.sep
+            for p in [p for p in self._index if p.startswith(prefix)]:
+                del self._index[p]
+            _RESIDENT.set(sum(self._query_bytes.values()))
+        shutil.rmtree(d, ignore_errors=True)
+        if freed:
+            _GC_BYTES.inc(freed)
+        return freed
+
+    def query_dirs(self) -> List[str]:
+        """Per-query spool directories currently on disk (the chaos
+        suite's no-orphans assertion)."""
+        with self._lock:
+            if self._dir is None or not os.path.isdir(self._dir):
+                return []
+            return sorted(
+                e for e in os.listdir(self._dir)
+                if os.path.isdir(os.path.join(self._dir, e)))
+
+
+#: the process-wide store (every worker/coordinator in this process
+#: shares it; separate processes share through ``spool.dir``)
+SPOOL = LocalDiskSpoolStore()

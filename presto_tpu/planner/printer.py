@@ -208,13 +208,17 @@ def format_retry_summary(info) -> str:
     q_retries = int(info.get("query_retries") or 0)
     launched = int(info.get("speculative_launched") or 0)
     won = int(info.get("speculative_won") or 0)
-    if not (retries or q_retries or launched or won):
+    replays = sum(1 for ev in info.get("events") or ()
+                  if ev.get("kind") == "spool_replay")
+    if not (retries or q_retries or launched or won or replays):
         return ""
     head = (f"Fault tolerance [{info.get('policy', 'TASK')}]: "
             f"{retries} task retr{'y' if retries == 1 else 'ies'}, "
             f"{launched} speculative launched, {won} won"
             + (f", {q_retries} query rerun"
-               f"{'' if q_retries == 1 else 's'}" if q_retries else ""))
+               f"{'' if q_retries == 1 else 's'}" if q_retries else "")
+            + (f", {replays} spool replay"
+               f"{'' if replays == 1 else 's'}" if replays else ""))
     lines = [head]
     for ev in info.get("events") or ():
         kind = ev.get("kind", "")
@@ -230,6 +234,10 @@ def format_retry_summary(info) -> str:
         elif kind == "speculative_won":
             lines.append(f"  speculative win {ev.get('task')} on "
                          f"{ev.get('worker')}")
+        elif kind == "spool_replay":
+            lines.append(f"  spool replay {ev.get('task')} "
+                         f"(worker {ev.get('worker')} gone, output "
+                         f"served from spool — not re-run)")
     return "\n".join(lines)
 
 

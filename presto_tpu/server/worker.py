@@ -39,6 +39,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from ..batch import Batch
 from ..connectors.spi import CatalogManager, Split
 from ..exec import local as local_exec
+from ..exec.backoff import jittered
 from ..exec.failpoints import FAILPOINTS, FailpointError
 from ..obs.log import LOG
 from ..obs.metrics import REGISTRY, TASKS
@@ -56,17 +57,29 @@ _EXCHANGE_SENT_BYTES = REGISTRY.counter("exchange_sent_bytes_total")
 _EXCHANGE_SENT_PAGES = REGISTRY.counter("exchange_sent_pages_total")
 _EXCHANGE_RECV_BYTES = REGISTRY.counter("exchange_received_bytes_total")
 _EXCHANGE_WAIT = REGISTRY.histogram("exchange_wait_seconds")
+_EXCHANGE_SPOOL_FALLBACK = REGISTRY.counter(
+    "exchange_spool_fallback_total")
 
 _query_handles: Dict[str, list] = {}
 _query_handles_lock = threading.Lock()
 
 
-def _query_handle(query_id: str):
+def _query_handle(query_id: str, serving: Optional[dict] = None):
     from ..exec.taskexec import GLOBAL as scheduler
     with _query_handles_lock:
         ent = _query_handles.get(query_id)
         if ent is None:
-            ent = _query_handles[query_id] = [scheduler.task(query_id), 0]
+            # serving-plane handoff riding the task doc: the admitting
+            # resource group's scheduler share + weight, so cluster
+            # queries get the same group-weighted device scheduling as
+            # LocalRunner queries (first task of the query wins — all
+            # of a query's tasks share one admission)
+            serving = serving or {}
+            handle = scheduler.task(
+                query_id, group=str(serving.get("group", "")),
+                weight=int(serving.get("weight", 1)),
+                label=str(serving.get("label", "")) or None)
+            ent = _query_handles[query_id] = [handle, 0]
         ent[1] += 1
         return ent[0]
 
@@ -99,18 +112,27 @@ def unframe_pages(body: bytes) -> List[bytes]:
 class OutputBuffer:
     """Per-task partitioned output with token/ack reread semantics.
 
-    With ``retain=True`` (set by the coordinator when
-    ``retry_policy=TASK``) acked pages are NOT dropped: a consumer task
-    that is restarted by the retry/speculation layer re-reads this
-    attempt's complete output from token 0 — the in-memory stand-in for
-    the reference's spooled-exchange storage that makes task-level
-    retry possible at all. Buffers are attempt-versioned by
-    construction: every attempt is its own task id with its own buffer,
+    Replay storage comes in two flavours:
+
+    - ``spool`` (a :class:`~presto_tpu.exec.spool.SpoolWriter`, set by
+      the coordinator when ``retry_policy=TASK`` and spooled exchange
+      is on — the default): every page is written through to the
+      durable page-addressed spool BEFORE it becomes visible, acked
+      pages are dropped from memory (shuffle size is no longer capped
+      by worker RAM), and a re-created consumer re-reading from token
+      0 is served back out of the spool by token;
+    - ``retain=True`` (the PR 5 in-memory fallback, still used when
+      ``spool_exchange=false``): acked pages are kept resident.
+
+    Buffers are attempt-versioned by construction: every attempt is
+    its own task id with its own buffer (and its own spool page logs),
     so a consumer can never interleave pages from two attempts."""
 
-    def __init__(self, n_buffers: int, retain: bool = False):
+    def __init__(self, n_buffers: int, retain: bool = False,
+                 spool=None):
         self.n = n_buffers
-        self.retain = retain
+        self.retain = retain and spool is None
+        self.spool = spool
         self.pages: List[List[Tuple[int, bytes]]] = \
             [[] for _ in range(n_buffers)]
         self.next_token = [0] * n_buffers
@@ -121,6 +143,13 @@ class OutputBuffer:
     def add(self, buffer_id: int, page: bytes) -> None:
         _EXCHANGE_SENT_BYTES.inc(len(page))
         _EXCHANGE_SENT_PAGES.inc()
+        if self.spool is not None:
+            # durable before visible: next_token only advances on this
+            # producer thread, so reading it unlocked is safe; a spool
+            # write failure propagates and fails the task (which the
+            # coordinator then retries elsewhere)
+            self.spool.append(buffer_id, self.next_token[buffer_id],
+                              page)
         with self.cond:
             self.pages[buffer_id].append(
                 (self.next_token[buffer_id], page))
@@ -130,6 +159,9 @@ class OutputBuffer:
     def add_broadcast(self, page: bytes) -> None:
         _EXCHANGE_SENT_BYTES.inc(len(page) * self.n)
         _EXCHANGE_SENT_PAGES.inc(self.n)
+        if self.spool is not None:
+            for b in range(self.n):
+                self.spool.append(b, self.next_token[b], page)
         with self.cond:
             for b in range(self.n):
                 self.pages[b].append((self.next_token[b], page))
@@ -149,10 +181,27 @@ class OutputBuffer:
                 self.failed = message
             self.cond.notify_all()
 
+    def drained(self) -> bool:
+        """True when nothing depends on this PROCESS to serve the
+        buffer anymore: terminal-failed, or finished with its replay
+        copy in the durable spool (consumers re-fetch from there), or
+        finished with every in-memory page acked. The drain fast-exit
+        gate (WorkerServer.begin_shutdown)."""
+        with self.cond:
+            if self.failed is not None:
+                return True
+            if not self.finished:
+                return False
+            if self.spool is not None:
+                return True
+            return all(not q for q in self.pages)
+
     def get(self, buffer_id: int, token: int, max_wait_s: float,
             max_bytes: int = 8 << 20):
         """Ack pages below ``token``, long-poll for pages at/after it.
-        Returns (pages, next_token, complete)."""
+        Returns (pages, next_token, complete). With a spool attached,
+        tokens below the in-memory window (a re-created consumer
+        re-reading from 0) are served from the spool."""
         deadline = time.monotonic() + max_wait_s
         with self.cond:
             if not self.retain:
@@ -164,7 +213,20 @@ class OutputBuffer:
                     raise RuntimeError(self.failed)
                 avail = [e for e in self.pages[buffer_id]
                          if e[0] >= token]
+                if self.spool is not None and not avail \
+                        and token < self.next_token[buffer_id]:
+                    # the requested token was produced but already
+                    # acked out of memory: replay from the spool
+                    # (outside the lock — disk reads must not block
+                    # the producer)
+                    break
                 if avail:
+                    if self.spool is not None and avail[0][0] != token:
+                        # gap below memory (acked away): spool replay.
+                        # Spool-less buffers keep the legacy behavior
+                        # (serve what memory holds) — they have no
+                        # second copy to consult.
+                        break
                     out, size = [], 0
                     for t, p in avail:
                         out.append(p)
@@ -179,6 +241,10 @@ class OutputBuffer:
                 if remaining <= 0:
                     return [], token, False
                 self.cond.wait(remaining)
+        pages, nxt = self.spool.store.read_pages(
+            self.spool.query_id, self.spool.task_id, buffer_id, token,
+            max_bytes)
+        return pages, nxt, False
 
 
 class ExchangeFailedError(RuntimeError):
@@ -231,6 +297,42 @@ class ExchangeClient:
             for u in locations
         ]
 
+    def _drain_spool(self, task_id: str, token: int) -> Optional[bool]:
+        """Serve the remainder of this upstream from the durable spool
+        when the attempt's completion marker is present (the producing
+        worker drained-and-exited, or died after finishing). Returns
+        True when fully drained, None when the spool has no committed
+        copy (caller keeps its normal retry semantics); raises
+        :class:`ExchangeFailedError` on a corrupt page — the retry
+        layer's cue to re-run the producer."""
+        from ..exec.spool import SPOOL, SpoolCorruptionError
+        query_id = task_id.split(".")[0]
+        tokens = SPOOL.finished_tokens(query_id, task_id)
+        if tokens is None or self.buffer_id >= len(tokens):
+            return None
+        _EXCHANGE_SPOOL_FALLBACK.inc()
+        end = tokens[self.buffer_id]
+        while token < end:
+            try:
+                pages, nxt = SPOOL.read_pages(
+                    query_id, task_id, self.buffer_id, token)
+            except (SpoolCorruptionError, FailpointError) as e:
+                raise ExchangeFailedError(
+                    f"upstream task {task_id} spool replay failed: "
+                    f"{e}", task_id=task_id) from None
+            if nxt == token:
+                # the marker promised more tokens than the page log
+                # holds: the spool copy is incomplete/damaged
+                raise ExchangeFailedError(
+                    f"upstream task {task_id} spool replay failed: "
+                    f"page log ends at token {token} of {end}",
+                    task_id=task_id)
+            for page in pages:
+                _EXCHANGE_RECV_BYTES.inc(len(page))
+                self.queue.put(page)
+            token = nxt
+        return True
+
     def _pull(self, url: str) -> None:
         token = 0
         task_id = url.rsplit("/v1/task/", 1)[-1]
@@ -257,10 +359,12 @@ class ExchangeClient:
                                                      token))
                 except urllib.error.HTTPError as e:
                     # the upstream answered: its task failed, was
-                    # aborted, or is unknown — not transient, surface
-                    # the real cause now (satellite of the retry layer:
-                    # a generic deadline here left the coordinator
-                    # unable to tell WHICH attempt died)
+                    # aborted, or is unknown — before declaring it
+                    # dead, check the durable spool: a drained (or
+                    # restarted) worker's committed attempt replays
+                    # from storage with no producer re-run
+                    if self._drain_spool(task_id, token):
+                        break
                     try:
                         detail = json.loads(
                             e.read() or b"{}").get("error") or ""
@@ -271,6 +375,11 @@ class ExchangeClient:
                         f"{e.code}: {detail or e.reason}",
                         task_id=task_id, url=url) from None
                 except Exception as e:  # transport: bounded retry
+                    # a dead producer whose attempt committed its
+                    # spool needs no retry window at all — drain the
+                    # rest from storage immediately
+                    if self._drain_spool(task_id, token):
+                        break
                     now = time.monotonic()
                     if first_err is None:
                         first_err = now
@@ -280,7 +389,7 @@ class ExchangeClient:
                             f"upstream task {task_id} unreachable "
                             f"for {now - first_err:.1f}s: {e}",
                             task_id=task_id, url=url) from None
-                    time.sleep(0.2)
+                    time.sleep(jittered(0.2))
                     continue
                 first_err = None
                 deadline = time.monotonic() + self.timeout_s
@@ -402,9 +511,21 @@ class Task:
         self.root = codec.decode(doc["fragment"])
         self.output_kind = doc["output"]["kind"]
         self.output_keys = list(doc["output"].get("keys", ()))
+        n_buffers = int(doc["output"]["n_buffers"])
+        #: spooled exchange (exec/spool.py): the coordinator sets
+        #: output.spool for non-root fragments under retry_policy=TASK
+        #: — every page becomes durable and replayable by token, so
+        #: retries/speculation/drain never need this process alive to
+        #: re-read this attempt's output
+        self.spool_writer = None
+        if bool(doc["output"].get("spool", False)):
+            from ..exec.spool import SPOOL
+            self.spool_writer = SPOOL.writer(
+                task_id.split(".")[0], task_id, n_buffers)
         self.buffer = OutputBuffer(
-            int(doc["output"]["n_buffers"]),
-            retain=bool(doc["output"].get("retain", False)))
+            n_buffers,
+            retain=bool(doc["output"].get("retain", False)),
+            spool=self.spool_writer)
         #: set by DELETE-abort; checked between quanta (and, via the
         #: executor's cancel_event, inside scans) so an aborted task
         #: stops burning device time instead of running to completion
@@ -413,6 +534,9 @@ class Task:
         self.sources = {int(k): list(v)
                         for k, v in doc.get("sources", {}).items()}
         self.partition = int(doc.get("partition", 0))
+        #: group scheduling handoff (serving/groups.py via the task
+        #: doc): {"group", "weight", "label"} or None
+        self.serving = doc.get("serving")
         session_doc = doc.get("session", {})
         self.session = Session(
             catalogs=catalogs,
@@ -462,7 +586,7 @@ class Task:
         # query's scheduler turn (reference TaskExecutor groups splits
         # under a per-task TaskHandle the same way)
         qid, fid = self._task_ids()
-        handle = _query_handle(qid)
+        handle = _query_handle(qid, self.serving)
         try:
             with TRACER.task_span(self.trace_ctx, "task",
                                   task_id=self.task_id, query_id=qid,
@@ -523,9 +647,19 @@ class Task:
                             self.bytes_out += len(page)
                             self.buffer.add(0, page)
                 ex.check_errors()
+            if self.spool_writer is not None:
+                # commit the spool BEFORE announcing FINISHED: a
+                # consumer (or the coordinator's lost-task probe) that
+                # sees the completion marker can trust the page logs
+                self.spool_writer.finish(self.buffer.next_token)
             self.buffer.finish()
             self._set_state("FINISHED")
         except Exception as e:   # noqa: BLE001 - reported to coordinator
+            if self.spool_writer is not None:
+                # a failed/aborted attempt's partial page logs are
+                # garbage: drop them now instead of squatting on
+                # spool.max-bytes until query-end GC
+                self.spool_writer.abandon()
             if self._abort.is_set():
                 # a DELETE-abort interrupted the run loop: ABORTED (set
                 # by abort()) is the verdict, not FAILED, and the
@@ -694,13 +828,21 @@ class _Handler(BaseHTTPRequestHandler):
             n = self.worker.abort_query(parts[2])
             self._json(200, {"aborted_tasks": n})
             return
+        if parts[:2] == ["v1", "spool"] and len(parts) == 3:
+            # per-query spool GC (coordinator-driven at query end; the
+            # abort path releases through abort_query)
+            from ..exec.spool import SPOOL
+            self._json(200,
+                       {"released_bytes": SPOOL.release_query(parts[2])})
+            return
         self._json(404, {"error": "not found"})
 
 
 class WorkerServer:
     def __init__(self, catalogs: Optional[CatalogManager] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 node_id: Optional[str] = None, tpch_sf: float = 0.01):
+                 node_id: Optional[str] = None, tpch_sf: float = 0.01,
+                 drain_grace_s: float = 5.0):
         if catalogs is None:
             from ..connectors.memory import MemoryConnector
             from ..connectors.system import SystemConnector
@@ -718,6 +860,12 @@ class WorkerServer:
         self.done: "OrderedDict[str, dict]" = OrderedDict()
         self.started_at = time.time()
         self.shutting_down = False
+        #: bounded consumer-drain window after active tasks finish:
+        #: spool-backed buffers skip it entirely (consumers re-fetch
+        #: already-acked pages from the durable spool), so a draining
+        #: worker EXITS within this grace instead of lingering until
+        #: every downstream consumer completes
+        self.drain_grace_s = float(drain_grace_s)
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.worker = self   # type: ignore[attr-defined]
         self.port = self.httpd.server_address[1]
@@ -742,8 +890,16 @@ class WorkerServer:
 
     def stop(self) -> None:
         if self._announcer is not None:
-            self._announcer.stop()
+            # explicit leave: a final GONE announcement removes this
+            # node from discovery immediately (elastic scale-in),
+            # instead of waiting out the announcement TTL
+            self._announcer.deregister()
         self.httpd.shutdown()
+        # release the listening socket too: a stopped worker must
+        # REFUSE connections — a bound-but-unserved socket makes every
+        # peer (exchange pulls, coordinator probes) hang to its full
+        # timeout instead of failing over to the spool instantly
+        self.httpd.server_close()
 
     def create_task(self, task_id: str, doc: dict) -> Task:
         # idempotent: the coordinator's transport retries task PUTs, so
@@ -821,10 +977,19 @@ class WorkerServer:
             ent = _query_handles.get(query_id)
             if ent is not None:
                 ent[0].aborted.set()
+        # an aborted query's spooled pages can never be read again —
+        # GC now so aborts don't orphan per-query spool directories
+        from ..exec.spool import SPOOL
+        SPOOL.release_query(query_id)
         return n
 
     def begin_shutdown(self) -> None:
-        """Drain: refuse new tasks, wait for active ones, then stop."""
+        """Drain: refuse new tasks, wait for active ones to finish
+        (their output commits to the spool), give un-spooled buffers a
+        bounded ``drain_grace_s`` for consumers to pull, then stop —
+        the worker EXITS without waiting for downstream completion;
+        consumers re-fetch already-acked pages from the durable spool
+        (ExchangeClient spool fallback)."""
         self.shutting_down = True
         if self._announcer is not None:
             # push the drain state to discovery immediately — the
@@ -832,9 +997,18 @@ class WorkerServer:
             self._announcer.set_state("SHUTTING_DOWN")
 
         def drain():
+            # snapshot per round: abort_query pops entries from other
+            # threads, and a dict-changed-mid-iteration RuntimeError
+            # here would silently kill the drain thread — the worker
+            # would linger forever with stop() never called
             while any(t.state in ("PLANNED", "RUNNING")
-                      for t in self.tasks.values()):
-                time.sleep(0.2)
+                      for t in list(self.tasks.values())):
+                time.sleep(0.1)
+            grace = time.monotonic() + self.drain_grace_s
+            while time.monotonic() < grace \
+                    and any(not t.buffer.drained()
+                            for t in list(self.tasks.values())):
+                time.sleep(0.1)
             self.stop()
         threading.Thread(target=drain, daemon=True).start()
 
@@ -848,6 +1022,9 @@ def main() -> None:
     p.add_argument("--node-id", default=None)
     p.add_argument("--etc-dir", default=None,
                    help="config directory (config.properties + catalog/)")
+    p.add_argument("--spool-dir", default=None,
+                   help="exchange spool directory (overrides etc "
+                        "spool.dir; point every node at shared storage)")
     p.add_argument("--coordinator", default=None,
                    help="coordinator URL to announce to "
                         "(overrides etc discovery.uri)")
@@ -856,6 +1033,7 @@ def main() -> None:
     node_id = args.node_id
     port = args.port
     discovery_uri = args.coordinator
+    spool_dir = args.spool_dir
     if args.etc_dir:
         from ..config import load_catalogs, load_node_config
         cfg = load_node_config(args.etc_dir)
@@ -865,6 +1043,14 @@ def main() -> None:
         discovery_uri = discovery_uri or cfg.discovery_uri
         if cfg.failpoints:
             FAILPOINTS.configure_from_spec(cfg.failpoints)
+        spool_dir = spool_dir or cfg.spool_dir
+        if spool_dir or cfg.spool_max_bytes is not None:
+            from ..exec.spool import SPOOL
+            SPOOL.configure(directory=spool_dir,
+                            max_bytes=cfg.spool_max_bytes)
+    elif spool_dir:
+        from ..exec.spool import SPOOL
+        SPOOL.configure(directory=spool_dir)
     w = WorkerServer(catalogs=catalogs, host=args.host, port=port,
                      node_id=node_id, tpch_sf=args.tpch_sf)
     print(json.dumps({"nodeId": w.node_id, "port": w.port}), flush=True)

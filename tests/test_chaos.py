@@ -1,12 +1,19 @@
-"""Tier-1 chaos smoke: every cluster recovery path under seeded
-failpoints, with row-exact parity against the fault-free run.
+"""Tier-1 chaos smoke: every cluster recovery + spooled-exchange path
+under seeded failpoints, with row-exact parity against the fault-free
+run.
 
 Thin pytest wrapper over tools/chaos_smoke.py (also runnable directly
-from the CLI) — a 3-worker in-process cluster survives one injected
-task failure, one exchange drop, one 15s straggler (speculative win),
-and one worker death; ``retry_policy=NONE`` still fails fast. Recovery
-is asserted observable through ``system.runtime.metrics`` and the
-query-history ``retries`` column inside the tool itself."""
+from the CLI) — an elastic discovery-fed in-process cluster survives
+one injected task failure, one exchange drop, one 15s straggler
+(speculative win), a worker death, a worker killed AFTER spooling its
+output (replayed, NOT re-run), an on-disk spool-page corruption
+(checksum -> retry from upstream), a fresh worker joining mid-query
+(re-created tasks land on it), and a mid-read drain (the worker exits
+within its grace; the consumer finishes from the spool);
+``retry_policy=NONE`` still fails fast. Recovery is asserted
+observable through ``system.runtime.metrics`` and the query-history
+``retries`` column inside the tool itself, and the spool directory
+must end the run with zero orphaned per-query directories."""
 import os
 import sys
 
@@ -25,6 +32,30 @@ def test_chaos_smoke():
     assert scenarios["straggler"]["speculative_won"] >= 1
     assert scenarios["worker_death"]["task_retries"] >= 1
     assert "retry_none" in scenarios
+    # spooled exchange + elastic membership (ISSUE 10)
+    assert scenarios["spool_replay"]["spool_replays"] >= 1
+    assert scenarios["spool_replay"]["spool_fallbacks"] >= 1
+    assert scenarios["spool_corrupt"]["corruptions"] >= 1
+    assert scenarios["spool_corrupt"]["task_retries"] >= 1
+    assert scenarios["worker_join"]["landed_on_joiner"] >= 1
+    assert scenarios["drain_exit"]["task_retries"] == 0
+    assert scenarios["drain_exit"]["spool_fallbacks"] >= 1
+    # the recovery-time summary feeds the ELASTIC_r* gate
+    assert summary["elastic"]["value"] > 0
+
+
+def test_elastic_regression_gate_smoke(capsys):
+    """The elastic recovery-time gate's self-consistency: the pinned
+    ELASTIC_r*.json passes against itself and a degraded (slower)
+    copy fails — same contract as the BENCH/SERVING gates."""
+    import check_bench_regression as gate
+    rc = gate.main(["--kind", "elastic", "--smoke"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    import json
+    verdict = json.loads(out)
+    assert verdict["verdict"] == "pass"
+    assert "elastic_recovery_ms" in verdict["metrics"]
 
 
 def test_lock_discipline_clean_after_chaos():

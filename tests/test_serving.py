@@ -468,6 +468,70 @@ def test_serving_gate_latency_metrics_invert():
     assert compare(base, faster)["verdict"] == "pass"
 
 
+# -- cluster path through admission + plan cache (ISSUE 10 satellite) ---------
+
+def test_cluster_runner_through_admission_and_plan_cache():
+    """The statement server fronts a ClusterRunner with the SAME
+    resource-group admission, serving handoff, and compiled-plan cache
+    that LocalRunner deployments get: repeated statements skip
+    parse/plan/optimize, the admitting group's slot frees on every
+    exit path, and the query's device quanta bill the group's
+    scheduler share on the (in-process) workers."""
+    from presto_tpu.exec.cluster import ClusterRunner
+    from presto_tpu.server.protocol import (
+        PrestoTpuServer, _runner_accepts_serving,
+    )
+    from presto_tpu.server.worker import WorkerServer
+
+    workers = [WorkerServer(tpch_sf=0.001) for _ in range(2)]
+    for w in workers:
+        w.start()
+    urls = [f"http://127.0.0.1:{w.port}" for w in workers]
+    runner = ClusterRunner(urls, tpch_sf=0.001, heartbeat=False)
+    assert _runner_accepts_serving(runner)
+    srv = PrestoTpuServer(runner=runner, resource_groups={
+        "rootGroups": [{"name": "fleet", "hardConcurrencyLimit": 2,
+                        "schedulingWeight": 3}],
+        "selectors": [{"group": "fleet"}]})
+    try:
+        sql = ("select n_regionkey, count(*) c from nation "
+               "group by n_regionkey order by n_regionkey")
+        h0 = _metric("plan_cache_hit_total")
+        q1 = srv.create_query(sql, {}, user="alice")
+        q1._thread.join(timeout=60)
+        assert q1.state == "FINISHED", q1.error
+        q2 = srv.create_query(sql, {}, user="alice")
+        q2._thread.join(timeout=60)
+        assert q2.state == "FINISHED", q2.error
+        # the repeated statement rode the compiled-plan cache on the
+        # CLUSTER path
+        assert _metric("plan_cache_hit_total") >= h0 + 1
+        # admission accounting drained on every exit path
+        info = srv.resource_groups.info()[0]
+        assert info["numRunning"] == 0 and info["numQueued"] == 0
+        # the admitting group's stride share exists on the device
+        # scheduler (the worker-side serving handoff landed)
+        from presto_tpu.exec.taskexec import GLOBAL
+        assert any(k.endswith("/fleet")
+                   for k in GLOBAL.group_shares()), \
+            GLOBAL.group_shares().keys()
+        # per-query session property overlays reach the cluster
+        # session (a bad value fails the statement, a good one binds)
+        q3 = srv.create_query(sql, {"retry_policy": "BOGUS"})
+        q3._thread.join(timeout=60)
+        assert q3.state == "FAILED"
+        q4 = srv.create_query(sql, {"retry_policy": "NONE"})
+        q4._thread.join(timeout=60)
+        assert q4.state == "FINISHED", q4.error
+    finally:
+        srv.stop()
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+
+
 # -- concurrency stress test --------------------------------------------------
 
 def test_concurrent_stress_parity_and_fairness():

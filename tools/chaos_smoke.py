@@ -1,10 +1,10 @@
 #!/usr/bin/env python
-"""Chaos smoke: a 3-worker in-process cluster under seeded failpoints.
+"""Chaos smoke: an elastic in-process cluster under seeded failpoints.
 
-Drives every recovery path of the fault-tolerance layer
-(presto_tpu/exec/cluster.py + exec/failpoints.py) without a real
-multi-host TPU cluster, and asserts ROW-EXACT parity with the
-fault-free run after each injected fault:
+Drives every recovery path of the fault-tolerance + spooled-exchange
+layers (presto_tpu/exec/cluster.py, exec/spool.py, exec/failpoints.py)
+without a real multi-host TPU cluster, and asserts ROW-EXACT parity
+with the fault-free run after each injected fault:
 
 - ``task_failure``   — one task FAILs at start (``worker.task_run``
   error); the coordinator re-creates it on a healthy worker.
@@ -19,15 +19,37 @@ fault-free run after each injected fault:
 - ``worker_death``   — a failpoint callback kills one worker's HTTP
   server mid-query; its tasks (same deterministic splits) reschedule
   onto the survivors.
+- ``spool_replay``   — a worker is killed AFTER its source task
+  committed its spool, mid-shuffle: consumers replay the pages from
+  the durable spool and the source task is NOT re-executed (asserted
+  via the task-attempt/retry events — the spooled-exchange headline).
+- ``spool_corrupt``  — one spooled page is corrupted on disk
+  (``spool.corrupt``) and its worker killed: the checksum catches it,
+  the consumer's failure names the upstream, and the retry layer
+  re-runs exactly that producer; results stay row-exact.
+- ``worker_join``    — a FRESH worker boots and announces mid-query
+  while another dies: the re-created tasks land on the late joiner
+  (elastic scale-out under the discovery + recovery machinery).
+- ``drain_exit``     — a worker is put into SHUTTING_DOWN mid-query
+  while the root is still reading its output: it exits within its
+  drain grace (no lingering until downstream completion) and the
+  consumer finishes from the spool, with zero task retries.
 
-Recovery is asserted observable: ``task_retry_total`` and
-``speculative_won_total`` move, via ``system.runtime.metrics`` over
-plain SQL.
+Recovery is asserted observable: ``task_retry_total``,
+``speculative_won_total``, ``spool_replayed_task_total``,
+``exchange_spool_fallback_total`` and ``node_joined_total`` move, via
+``system.runtime.metrics`` over plain SQL; at the end the spool
+directory must hold ZERO orphaned per-query directories.
 
 Run directly (prints a JSON summary) or from the tier-1 suite
 (tests/test_chaos.py):
 
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py [--sf 0.01]
+
+``--elastic-out PATH`` (or the ``ELASTIC_OUT`` env var) additionally
+writes a bench-style summary of per-scenario recovery times, gated by
+``tools/check_bench_regression.py --kind elastic`` against the
+committed ``ELASTIC_r*.json``.
 """
 from __future__ import annotations
 
@@ -35,6 +57,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -70,18 +93,40 @@ def _assert_rows_equal(got, want, scenario: str) -> None:
 def run_chaos(sf: float = 0.01, query: str = QUERY,
               verbose: bool = False) -> dict:
     from presto_tpu.exec.cluster import ClusterRunner, QueryFailedError
+    from presto_tpu.exec.discovery import DiscoveryNodeManager
     from presto_tpu.exec.failpoints import FAILPOINTS
+    from presto_tpu.exec.spool import SPOOL
     from presto_tpu.server.worker import WorkerServer
 
     def log(msg: str) -> None:
         if verbose:
             print(msg, file=sys.stderr, flush=True)
 
-    workers = [WorkerServer(tpch_sf=sf) for _ in range(3)]
-    for w in workers:
+    # discovery-fed membership (not a static URL list): workers may
+    # join or leave mid-query — the elastic half of the smoke
+    discovery = DiscoveryNodeManager(ttl_s=3600.0)
+    workers = []
+
+    def add_worker() -> WorkerServer:
+        w = WorkerServer(tpch_sf=sf, drain_grace_s=2.0)
         w.start()
-    urls = [f"http://127.0.0.1:{w.port}" for w in workers]
-    runner = ClusterRunner(urls, tpch_sf=sf, heartbeat=False)
+        workers.append(w)
+        discovery.announce(w.node_id, f"http://127.0.0.1:{w.port}")
+        return w
+
+    def kill_worker(w: WorkerServer) -> None:
+        """In-process stand-in for a worker process death: the network
+        surface goes away AND its task threads stop burning the shared
+        device scheduler."""
+        w.httpd.shutdown()
+        w.httpd.server_close()
+        for t in list(w.tasks.values()):
+            t.abort()
+
+    for _ in range(3):
+        add_worker()
+    runner = ClusterRunner(tpch_sf=sf, heartbeat=False,
+                           discovery=discovery)
     summary: dict = {"sf": sf, "scenarios": {}}
     FAILPOINTS.clear()
     try:
@@ -157,20 +202,12 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
         finish()
 
         # -- (e) worker death mid-query -> reschedule on survivors --------
-        # (last: the victim stays dead for the rest of the run)
         finish = scenario("worker_death")
         before = _metric_sql(runner, "task_retry_total")
         victim = workers[-1]
 
         def kill(key="", **ctx):
-            victim.httpd.shutdown()
-            victim.httpd.server_close()
-            # a real worker death takes its task threads with it; the
-            # in-process stand-in kills the network surface above and
-            # the compute below, so zombies don't hold the shared
-            # device scheduler
-            for t in list(victim.tasks.values()):
-                t.abort()
+            kill_worker(victim)
 
         FAILPOINTS.configure("worker.task_run", action="callback",
                              callback=kill, times=1,
@@ -183,6 +220,248 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
         assert f"http://127.0.0.1:{victim.port}" \
             not in runner._schedulable_workers()
         finish(task_retries=retries)
+        add_worker()               # replenish the pool to 3 live nodes
+
+        # fragment ids of the smoke query (the scenarios below target
+        # the source stage's tasks / the stage the root consumes)
+        from presto_tpu.planner.fragmenter import fragment_plan
+        from presto_tpu.planner.plan import RemoteSourceNode
+        fp = fragment_plan(runner.local.plan(query).root)
+        source_fid = next(f.id for f in fp.fragments
+                          if f.partitioning == "source")
+
+        def _nodes(n):
+            yield n
+            for c in n.children:
+                yield from _nodes(c)
+        feed_fid = next(fid for node in _nodes(fp.root.root)
+                        if isinstance(node, RemoteSourceNode)
+                        for fid in node.fragment_ids)
+
+        def live_workers():
+            return [w for w in workers if w.httpd.socket.fileno() != -1
+                    and not w.shutting_down]
+
+        def pick_victim():
+            # the single (root) fragment lands on the first worker of
+            # the schedulable sweep (sorted by URL): the max-URL live
+            # worker can never host the root, which keeps the
+            # drain/kill scenarios' retry accounting deterministic
+            return max(live_workers(),
+                       key=lambda w: f"http://127.0.0.1:{w.port}")
+
+        def wait_stage_finished(w: WorkerServer, fid: int,
+                                timeout_s: float = 30.0) -> None:
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                ts = [t for t in list(w.tasks.values())
+                      if t.task_id.split(".")[1] == str(fid)]
+                if ts and all(t.state == "FINISHED" for t in ts):
+                    return
+                time.sleep(0.05)
+            raise AssertionError(
+                f"stage {fid} on {w.node_id} never finished")
+
+        # -- (f) spool replay: kill a worker AFTER its source task ------
+        # committed its spool, mid-shuffle. Consumers replay the pages
+        # from the durable spool; the source task is NOT re-executed.
+        finish = scenario("spool_replay")
+        before = _metric_sql(runner, "task_retry_total")
+        before_replay = _metric_sql(runner, "spool_replayed_task_total")
+        before_fb = _metric_sql(runner,
+                                "exchange_spool_fallback_total")
+        victim2 = pick_victim()
+        killed = threading.Event()
+        kill_lock = threading.Lock()
+
+        def kill_after_spool(key="", **ctx):
+            # EVERY pull of the victim's source task funnels through
+            # here (times unlimited): no page is ever served live, so
+            # every consumer must replay from the spool — and the kill
+            # only lands once the spool is committed
+            with kill_lock:
+                if not killed.is_set():
+                    wait_stage_finished(victim2, source_fid)
+                    kill_worker(victim2)
+                    killed.set()
+
+        FAILPOINTS.configure(
+            "exchange.pull", action="callback",
+            callback=kill_after_spool, times=None,
+            match=rf":{victim2.port}/v1/task/[^/]*\.{source_fid}\.\d+$")
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "spool_replay")
+        FAILPOINTS.clear()
+        replays = _metric_sql(
+            runner, "spool_replayed_task_total") - before_replay
+        fallbacks = _metric_sql(
+            runner, "exchange_spool_fallback_total") - before_fb
+        retries = _metric_sql(runner, "task_retry_total") - before
+        assert replays >= 1, \
+            "lost-but-spooled task was not preserved"
+        assert fallbacks >= 1, \
+            "no consumer replayed from the spool"
+        # the headline assertion: NO source-stage task was re-executed
+        # (retries are the victim's other tasks — never the producer
+        # whose output lives in the spool)
+        events = runner._last_run_info.get("events") or []
+        source_retries = [
+            ev for ev in events if ev.get("kind") == "task_retry"
+            and str(ev.get("task", "")).split(".")[1]
+            == str(source_fid)]
+        assert not source_retries, \
+            f"spooled source task was re-executed: {source_retries}"
+        finish(spool_replays=replays, spool_fallbacks=fallbacks,
+               task_retries=retries)
+        add_worker()
+
+        # -- (g) spool corruption: checksum -> retry from upstream ------
+        finish = scenario("spool_corrupt")
+        before = _metric_sql(runner, "task_retry_total")
+        before_cor = _metric_sql(runner, "spool_corruption_total")
+        victim3 = pick_victim()
+        killed3 = threading.Event()
+        kill3_lock = threading.Lock()
+        corrupt_armed = threading.Event()
+
+        def arm_corrupt(key="", task_id="", **ctx):
+            # corrupt the first spooled page of a source task ON THE
+            # VICTIM (the task id is only known once the worker starts
+            # it): the frame keeps the original checksum, the payload
+            # flips one byte on disk. Arming by exact task id matters:
+            # a survivor's corrupted page would be served from the
+            # clean in-memory fast path and never detected.
+            import re as _re
+            if task_id.split(".")[1] == str(source_fid) \
+                    and not corrupt_armed.is_set():
+                corrupt_armed.set()
+                FAILPOINTS.configure(
+                    "spool.corrupt", action="error", times=1,
+                    match=rf"^{_re.escape(task_id)}/")
+
+        FAILPOINTS.configure("worker.task_run", action="callback",
+                             callback=arm_corrupt, times=None,
+                             match=f"@{victim3.node_id}$")
+
+        def kill_after_corrupt(key="", **ctx):
+            with kill3_lock:
+                if not killed3.is_set():
+                    wait_stage_finished(victim3, source_fid)
+                    kill_worker(victim3)
+                    killed3.set()
+
+        FAILPOINTS.configure(
+            "exchange.pull", action="callback",
+            callback=kill_after_corrupt, times=None,
+            match=rf":{victim3.port}/v1/task/[^/]*\.{source_fid}\.\d+$")
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "spool_corrupt")
+        FAILPOINTS.clear()
+        corruptions = _metric_sql(
+            runner, "spool_corruption_total") - before_cor
+        retries = _metric_sql(runner, "task_retry_total") - before
+        assert corrupt_armed.is_set(), \
+            "victim never ran a source task to corrupt"
+        assert corruptions >= 1, \
+            "corrupted spool page was served without detection"
+        assert retries >= 1, \
+            "spool corruption did not re-run the producer"
+        finish(corruptions=corruptions, task_retries=retries)
+        add_worker()
+
+        # -- (h) elastic join: a fresh worker boots + announces -------
+        # mid-query while another dies; the re-created tasks land on
+        # the late joiner
+        finish = scenario("worker_join")
+        before = _metric_sql(runner, "task_retry_total")
+        before_join = _metric_sql(runner, "node_joined_total")
+        victim4 = pick_victim()
+        joiner: dict = {}
+
+        def kill_and_join(key="", **ctx):
+            kill_worker(victim4)
+            joiner["w"] = add_worker()
+
+        FAILPOINTS.configure("worker.task_run", action="callback",
+                             callback=kill_and_join, times=1,
+                             match=f"@{victim4.node_id}$")
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "worker_join")
+        FAILPOINTS.clear()
+        retries = _metric_sql(runner, "task_retry_total") - before
+        joined = _metric_sql(runner, "node_joined_total") - before_join
+        assert retries >= 1, "worker death did not trigger a retry"
+        assert joined >= 1, "the late joiner was never federated"
+        joiner_url = f"http://127.0.0.1:{joiner['w'].port}"
+        events = runner._last_run_info.get("events") or []
+        landed = [ev for ev in events
+                  if ev.get("kind") == "task_retry"
+                  and ev.get("to") == joiner_url]
+        assert landed, \
+            f"no re-created task landed on the late joiner: {events}"
+        finish(task_retries=retries, joined=joined,
+               landed_on_joiner=len(landed))
+
+        # -- (i) drain-and-exit: SHUTTING_DOWN mid-read ----------------
+        # the worker exits within its drain grace while the root is
+        # still consuming its output; the root finishes from the spool
+        # with ZERO task retries
+        finish = scenario("drain_exit")
+        before = _metric_sql(runner, "task_retry_total")
+        before_fb = _metric_sql(runner,
+                                "exchange_spool_fallback_total")
+        victim5 = pick_victim()
+        drained = threading.Event()
+        drain_lock = threading.Lock()
+
+        def drain_after_finish(key="", **ctx):
+            with drain_lock:
+                if not drained.is_set():
+                    wait_stage_finished(victim5, feed_fid)
+                    victim5.begin_shutdown()
+                    drained.set()
+
+        # the root's pulls of the victim's feed-stage task trigger the
+        # drain (once that task finished), then slow to one page per
+        # second — guaranteeing the worker is GONE before the root
+        # drains the buffer, so the tail must come from the spool
+        FAILPOINTS.configure(
+            "exchange.pull", action="callback",
+            callback=drain_after_finish, times=None,
+            match=rf":{victim5.port}/v1/task/[^/]*\.{feed_fid}\.\d+$")
+        FAILPOINTS.configure(
+            "exchange.pull", action="sleep", sleep_s=1.0, times=None,
+            match=rf":{victim5.port}/v1/task/[^/]*\.{feed_fid}\.\d+$")
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "drain_exit")
+        FAILPOINTS.clear()
+        retries = _metric_sql(runner, "task_retry_total") - before
+        fallbacks = _metric_sql(
+            runner, "exchange_spool_fallback_total") - before_fb
+        assert retries == 0, \
+            f"drain caused {retries} retries (spool should replay)"
+        assert fallbacks >= 1, \
+            "root never replayed the drained worker's output"
+        # the drained worker's process actually EXITED within its
+        # grace (no lingering until downstream completion): its socket
+        # must refuse within a short post-query window
+        exit_deadline = time.time() + 5.0
+        gone = False
+        while time.time() < exit_deadline:
+            try:
+                import urllib.request
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{victim5.port}/v1/info",
+                        timeout=1):
+                    pass
+            except Exception:
+                gone = True
+                break
+            time.sleep(0.1)
+        assert gone, "drained worker lingered past its grace"
+        assert f"http://127.0.0.1:{victim5.port}" \
+            not in runner._schedulable_workers()
+        finish(task_retries=retries, spool_fallbacks=fallbacks)
 
         # the retry count is part of the query history record
         res = runner.local.execute(
@@ -190,6 +469,12 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
             "where mode = 'cluster' order by create_time")
         assert res.rows and any(int(r[0]) >= 1 for r in res.rows), \
             "no completed_queries record carries a retry count"
+
+        # spool GC: after every scenario (successes, kills, drains and
+        # fail-fast aborts alike) no per-query spool directory may
+        # survive — disk is accounted and returned
+        orphans = SPOOL.query_dirs()
+        assert not orphans, f"orphaned spool directories: {orphans}"
 
         # -- (f) typo'd spec rejected at parse time -----------------------
         # a chaos config naming an unregistered site would inject
@@ -203,6 +488,24 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
             rejected = "unknown failpoint site" in str(e)
         assert rejected, "typo'd failpoint spec was silently accepted"
         finish(rejected=True)
+
+        # bench-style recovery-time summary: the elastic axis pinned
+        # as ELASTIC_r*.json, gated by check_bench_regression
+        # --kind elastic (all *_ms => lower is better)
+        elastic_scenarios = ("worker_death", "spool_replay",
+                             "spool_corrupt", "worker_join",
+                             "drain_exit")
+        summary["elastic"] = {
+            "metric": "elastic_recovery_ms",
+            "value": round(sum(
+                summary["scenarios"][s]["elapsed_s"]
+                for s in elastic_scenarios) * 1e3, 1),
+            "sub_metrics": [
+                {"metric": f"{s}_ms",
+                 "value": round(
+                     summary["scenarios"][s]["elapsed_s"] * 1e3, 1)}
+                for s in elastic_scenarios],
+        }
         summary["ok"] = True
         return summary
     finally:
@@ -219,9 +522,18 @@ def main(argv=None) -> int:
     ap.add_argument("--sf", type=float, default=0.01,
                     help="TPC-H scale factor (default 0.01)")
     ap.add_argument("-q", "--quiet", action="store_true")
+    ap.add_argument("--elastic-out", default=os.environ.get(
+        "ELASTIC_OUT"), metavar="PATH",
+        help="write the elastic recovery-time summary (bench format) "
+             "for check_bench_regression --kind elastic")
     args = ap.parse_args(argv)
     summary = run_chaos(sf=args.sf, verbose=not args.quiet)
     print(json.dumps(summary, indent=2))
+    if args.elastic_out and summary.get("elastic"):
+        tmp = args.elastic_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(summary["elastic"], f, indent=2)
+        os.replace(tmp, args.elastic_out)
     return 0 if summary.get("ok") else 1
 
 

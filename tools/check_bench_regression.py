@@ -18,6 +18,10 @@ by ``SERVING_OUT=path python bench.py serving``): pass ``--kind
 serving`` to diff QPS / p95 latency / warm-speedup against the latest
 committed serving round. Latency metrics (``*_ms`` / ``*_latency_ms``)
 are lower-is-better — the gate inverts their ratio automatically.
+``--kind elastic`` gates the chaos recovery-time axis the same way
+(``ELASTIC_r*.json``, written by ``python tools/chaos_smoke.py
+--elastic-out``): per-scenario recovery milliseconds, all
+lower-is-better.
 
 Usage:
     python tools/check_bench_regression.py --run bench_out.json
@@ -243,14 +247,17 @@ def main(argv=None) -> int:
                     help="self-consistency mode (no engine run): "
                          "baseline-vs-itself must pass, a degraded "
                          "copy must fail")
-    ap.add_argument("--kind", choices=("bench", "serving"),
+    ap.add_argument("--kind", choices=("bench", "serving", "elastic"),
                     default="bench",
                     help="which pinned trajectory to gate: per-query "
-                         "BENCH_r*.json (default) or the concurrent-"
-                         "throughput SERVING_r*.json")
+                         "BENCH_r*.json (default), the concurrent-"
+                         "throughput SERVING_r*.json, or the chaos "
+                         "recovery-time ELASTIC_r*.json "
+                         "(tools/chaos_smoke.py --elastic-out)")
     args = ap.parse_args(argv)
 
-    prefix = "SERVING" if args.kind == "serving" else "BENCH"
+    prefix = {"serving": "SERVING",
+              "elastic": "ELASTIC"}.get(args.kind, "BENCH")
     baseline_path = args.baseline or latest_bench_file(prefix=prefix)
     if baseline_path is None or not os.path.exists(baseline_path):
         print(json.dumps({"verdict": "error",
