@@ -106,6 +106,14 @@ _sp("fused_pipeline", "boolean", True,
     "fuse filter->project->join chains into one jitted pipeline")
 _sp("grouped_execution", "boolean", True,
     "run bucketed scans one lifespan at a time")
+_sp("join_dense_path", "boolean", True,
+    "stats-driven dense-key direct-address join builds: the planner "
+    "attaches hard build-key bounds (JoinNode.key_bounds) and the "
+    "executor answers bounded key tuples in two gathers")
+_sp("join_pallas_probe", "boolean", True,
+    "fuse direct-join probe lookup + liveness + payload gathers into "
+    "the Pallas ragged-gather kernel on TPU backends (pure-XLA gather "
+    "fallback otherwise, and on any kernel compile failure)")
 _sp("plan_cache", "boolean", True,
     "serve repeated statements from the compiled-plan cache "
     "(fingerprinted bound AST; skips parse/plan/optimize)")

@@ -599,6 +599,31 @@ class _Metadata(ConnectorMetadata):
                 _rows("customer", self.sf), 0.0, 1,
                 _rows("customer", self.sf))
             cols["o_orderdate"] = ColumnStats(ORDERDATE_SPAN, 0.0, START_DATE, END_ORDERDATE)
+        # dimension key bounds are EXACT from the generator (sequential
+        # 1..n keys; nation/region domains fixed by spec) — hard bounds,
+        # so the planner may select dense-key direct-address joins
+        # (optimizer._attach_join_strategy) and stats-bounded grouping
+        # on these columns, same contract as TpcdsConnector.table_stats
+        if t == "customer":
+            cols["c_custkey"] = ColumnStats(n, 0.0, 1, int(n))
+            cols["c_nationkey"] = ColumnStats(25, 0.0, 0, 24)
+        if t == "part":
+            cols["p_partkey"] = ColumnStats(n, 0.0, 1, int(n))
+            cols["p_size"] = ColumnStats(50, 0.0, 1, 50)
+        if t == "supplier":
+            cols["s_suppkey"] = ColumnStats(n, 0.0, 1, int(n))
+            cols["s_nationkey"] = ColumnStats(25, 0.0, 0, 24)
+        if t == "partsupp":
+            cols["ps_partkey"] = ColumnStats(
+                _rows("part", self.sf), 0.0, 1, _rows("part", self.sf))
+            cols["ps_suppkey"] = ColumnStats(
+                _rows("supplier", self.sf), 0.0, 1,
+                _rows("supplier", self.sf))
+        if t == "nation":
+            cols["n_nationkey"] = ColumnStats(25, 0.0, 0, 24)
+            cols["n_regionkey"] = ColumnStats(5, 0.0, 0, 4)
+        if t == "region":
+            cols["r_regionkey"] = ColumnStats(5, 0.0, 0, 4)
         for pk in self._PRIMARY_KEYS.get(t, ()):
             if pk not in cols:
                 cols[pk] = ColumnStats(distinct_count=n if len(self._PRIMARY_KEYS[t]) == 1 else None)

@@ -98,12 +98,17 @@ class DistributedExecutor(_Executor):
         return Batch(batch.schema, cols, put(batch.row_mask))
 
     def _smap(self, fn, n_in: int, replicated_in: Sequence[int] = (),
-              n_out: int = 1):
+              n_out: int = 1, replicated_out: bool = False):
         in_specs = tuple(
             P() if i in replicated_in else P(self.axis)
             for i in range(n_in))
-        out_specs = (P(self.axis) if n_out == 1
-                     else tuple(P(self.axis) for _ in range(n_out)))
+        # replicated_out: every shard computes the identical value (e.g.
+        # preparing a replicated build side), so the output stays P() —
+        # specs are PREFIX pytrees, so one spec covers a whole prepared
+        # tuple of arrays
+        one = P() if replicated_out else P(self.axis)
+        out_specs = (one if n_out == 1
+                     else tuple(one for _ in range(n_out)))
         # registered entry, not a raw jax.jit: every shard_map program
         # is an executable like any jitcache kernel — compiles and
         # (profiled) device time land in obs.profiler.EXECUTABLES
@@ -458,6 +463,44 @@ class DistributedExecutor(_Executor):
             # FIXED_HASH: build repartitioned by join key over ICI once
             build_side = self._repartitioner(rkeys)(build)
 
+        # prepare the build ONCE per shard (the LookupSource role, same
+        # contract as exec/local.py): every probe program takes the
+        # prepared pytree instead of re-sorting the build per probe
+        # batch. Planner key_bounds (stats-driven strategy selection)
+        # build the mixed-radix direct-address table; the build is
+        # cross-checked against the promised bounds through the
+        # row-error channel before any probe runs.
+        from ..ops.join import (direct_keyed_plan, prepare_build,
+                                prepare_direct_keyed)
+        from ..ops.jitcache import key_bounds_violation_jit
+        from .local import _note_join_strategy, bool_property
+        kb_plan = (direct_keyed_plan(tuple(node.key_bounds))
+                   if node.key_bounds
+                   and bool_property(self.session, "join_dense_path",
+                                     True) else None)
+        if kb_plan is not None:
+            los, sizes, K = kb_plan
+            cap = bucket_capacity(K)
+
+            def prep_local(b: Batch):
+                return prepare_direct_keyed(b, rkeys, los, sizes, cap)
+            # GSPMD reduces the sharded violation scan to one scalar;
+            # it joins the query's single end-of-run error sync
+            self.error_flags.append(key_bounds_violation_jit(
+                build, tuple(rkeys), tuple(node.key_bounds)))
+        else:
+            def prep_local(b: Batch):
+                return prepare_build(b, rkeys)
+        prep_in = (0,) if replicated else ()
+        prepared = self._smap(prep_local, 1, replicated_in=prep_in,
+                              replicated_out=replicated)(build_side)
+        _note_join_strategy(
+            self.stats, node,
+            ("direct" if kb_plan is not None else "sorted")
+            if node.build_unique else "expand", node.distribution)
+        # probe programs: build + prepared ride the same sharding
+        rep_in2 = (1, 2) if replicated else ()
+
         # FULL OUTER probes like LEFT; the unmatched-build tail is emitted
         # after the probe stream (per shard — the optimizer forces
         # partitioned distribution, so each build row lives on one shard)
@@ -465,19 +508,20 @@ class DistributedExecutor(_Executor):
 
         npro = len(node.left.fields)
 
-        def local_probe(probe_l: Batch, build_l: Batch,
+        def local_probe(probe_l: Batch, build_l: Batch, prep_l,
                         maxk: int) -> Batch:
             if node.build_unique:
                 out = lookup_join(probe_l, build_l, lkeys, rkeys,
-                                  payload, payload_names, jt)
+                                  payload, payload_names, jt,
+                                  prepared=prep_l)
             else:
                 out = expand_join(probe_l, build_l, lkeys, rkeys,
                                   payload, payload_names, jt,
-                                  max_matches=maxk)
+                                  max_matches=maxk, prepared=prep_l)
             out = Batch(out_schema, out.columns, out.row_mask)
             return residual_fn(out) if residual_fn else out
 
-        def local_probe_outer(probe_l: Batch, build_l: Batch,
+        def local_probe_outer(probe_l: Batch, build_l: Batch, prep_l,
                               maxk: int):
             """LEFT/FULL with a residual, shard-local (same contract as
             the local executor's _probe_outer_residual: residual gates
@@ -488,8 +532,10 @@ class DistributedExecutor(_Executor):
                                     unique_match_build_mask)
             if node.build_unique:
                 out = lookup_join(probe_l, build_l, lkeys, rkeys,
-                                  payload, payload_names, "left")
-                match = semi_join_mask(probe_l, build_l, lkeys, rkeys)
+                                  payload, payload_names, "left",
+                                  prepared=prep_l)
+                match = semi_join_mask(probe_l, build_l, lkeys, rkeys,
+                                       prepared=prep_l)
                 gated = residual_fn(Batch(out_schema, out.columns,
                                           probe_l.row_mask & match))
                 survived = gated.row_mask
@@ -499,13 +545,15 @@ class DistributedExecutor(_Executor):
                                        c.validity & survived,
                                        c.dictionary))
                 bmask = (unique_match_build_mask(
-                    probe_l, build_l, lkeys, rkeys, survived)
+                    probe_l, build_l, lkeys, rkeys, survived,
+                    prepared=prep_l)
                     if track_full
                     else jnp.zeros(build_l.capacity, dtype=bool))
                 return Batch(out_schema, cols, probe_l.row_mask), bmask
             k = max(1, maxk)
             e = expand_join(probe_l, build_l, lkeys, rkeys, payload,
-                            payload_names, "inner", max_matches=k)
+                            payload_names, "inner", max_matches=k,
+                            prepared=prep_l)
             gated = residual_fn(Batch(out_schema, e.columns,
                                       e.row_mask))
             survived = gated.row_mask
@@ -525,7 +573,8 @@ class DistributedExecutor(_Executor):
                                        c.dictionary))
             if track_full:
                 orig, _ = expand_match_origins(probe_l, build_l, lkeys,
-                                               rkeys, k)
+                                               rkeys, k,
+                                               prepared=prep_l)
                 n = build_l.capacity
                 bmask = jnp.zeros(n, dtype=bool).at[
                     jnp.where(survived, orig, n)].max(survived,
@@ -542,28 +591,29 @@ class DistributedExecutor(_Executor):
             # batch's match count (mirrors exec/local.py): the per-probe-
             # batch count sync only returns for skewed builds, where the
             # bound would oversize every batch's expansion
-            from ..ops.join import build_sorted, max_multiplicity
+            from ..ops.join import max_multiplicity
             mult_fn = self._smap(
-                lambda b: max_multiplicity(
-                    build_sorted(b, rkeys))[None].astype(jnp.int64), 1,
-                replicated_in=(0,) if replicated else ())
+                lambda pr: max_multiplicity(pr)[None].astype(jnp.int64),
+                1, replicated_in=(0,) if replicated else ())
             with TRACER.span("device-sync", what="join-multiplicity"):
                 bound = int(np.asarray(
-                    jax.device_get(mult_fn(build_side))).max())
+                    jax.device_get(mult_fn(prepared))).max())
             if bound <= self.SKEW_MATCH_LIMIT:
                 maxk_static = bucket_capacity(max(bound, 1), minimum=1)
             else:
-                def local_count(p: Batch, b: Batch) -> jnp.ndarray:
-                    return match_count_max(p, b, lkeys, rkeys)[None]
-                count_fn = self._smap(
-                    local_count, 2,
-                    replicated_in=(1,) if replicated else ())
+                def local_count(p: Batch, b: Batch, pr) -> jnp.ndarray:
+                    return match_count_max(p, b, lkeys, rkeys,
+                                           prepared=pr)[None]
+                count_fn = self._smap(local_count, 3,
+                                      replicated_in=rep_in2)
 
         repart_probe = None if replicated else self._repartitioner(lkeys)
         join_fns: Dict[int, object] = {}
         track_full = node.join_type == "full"
         match_fn = (self._smap(
-            lambda p, b: build_match_mask(p, b, lkeys, rkeys), 2)
+            lambda p, b, pr: build_match_mask(p, b, lkeys, rkeys,
+                                              prepared=pr), 3,
+            replicated_in=rep_in2)
             if track_full else None)
         build_matched = None
         for probe in self.run(node.left):
@@ -576,30 +626,33 @@ class DistributedExecutor(_Executor):
                 with TRACER.span("device-sync", what="join-match-count"):
                     maxk = bucket_capacity(
                         max(int(np.asarray(jax.device_get(
-                            count_fn(probe, build_side))).max()), 1),
+                            count_fn(probe, build_side,
+                                     prepared))).max()), 1),
                         minimum=1)
             fn = join_fns.get(maxk)
             if fn is None:
                 if residual_outer:
                     fn = join_fns[maxk] = self._smap(
-                        lambda p, b, _k=maxk: local_probe_outer(p, b, _k),
-                        2, replicated_in=(1,) if replicated else ())
+                        lambda p, b, pr, _k=maxk: local_probe_outer(
+                            p, b, pr, _k),
+                        3, replicated_in=rep_in2)
                 else:
                     fn = join_fns[maxk] = self._smap(
-                        lambda p, b, _k=maxk: local_probe(p, b, _k), 2,
-                        replicated_in=(1,) if replicated else ())
+                        lambda p, b, pr, _k=maxk: local_probe(
+                            p, b, pr, _k), 3,
+                        replicated_in=rep_in2)
             if residual_outer:
-                out, m = fn(probe, build_side)
+                out, m = fn(probe, build_side, prepared)
                 if track_full:
                     build_matched = (m if build_matched is None
                                      else build_matched | m)
                 yield out
                 continue
             if track_full:
-                m = match_fn(probe, build_side)
+                m = match_fn(probe, build_side, prepared)
                 build_matched = (m if build_matched is None
                                  else build_matched | m)
-            yield fn(probe, build_side)
+            yield fn(probe, build_side, prepared)
         if track_full:
             left_fields = node.left.fields
 
@@ -627,17 +680,52 @@ class DistributedExecutor(_Executor):
                 if neg:
                     yield b
             return
-        build_rep = self._replicate_device(build)
+        # stats-driven distribution (optimizer._attach_join_strategy):
+        # a large filtering set hash-partitions BOTH sides by key so
+        # membership never broadcasts — matching keys colocate, so
+        # per-shard verdicts compose exactly. NULL-aware anti joins
+        # always replicate (their build_has_null/build_empty facts are
+        # global) — the optimizer never marks them partitioned.
+        # (mark-joins — residual semis — keep the replicated path: their
+        # expansion probes are already bounded per shard)
+        partitioned = (node.distribution == "partitioned"
+                       and not (neg and node.null_aware)
+                       and node.residual is None)
+        from .local import _note_join_strategy
+        if partitioned:
+            build_rep = self._repartitioner(fkeys)(build)
+            repart_src = self._repartitioner(skeys)
+        else:
+            build_rep = self._replicate_device(build)
+            repart_src = None
+        # record the EXECUTED distribution: a residual mark-join the
+        # planner marked partitioned still runs replicated here
+        _note_join_strategy(self.stats, node, "sorted",
+                            "partitioned" if partitioned
+                            else "replicated")
 
         if node.residual is None:
-            def local(b: Batch, flt: Batch) -> Batch:
+            # prepare the membership table ONCE per shard (instead of
+            # re-sorting the filtering side inside every probe program)
+            from ..ops.join import prepare_build
+            prep = self._smap(lambda f: prepare_build(f, fkeys), 1,
+                              replicated_in=(0,) if not partitioned
+                              else (),
+                              replicated_out=not partitioned)(build_rep)
+
+            def local(b: Batch, flt: Batch, pr) -> Batch:
                 mask = semi_join_mask(b, flt, skeys, fkeys, negated=neg,
-                                      null_aware=node.null_aware)
+                                      null_aware=node.null_aware,
+                                      prepared=pr)
                 return Batch(b.schema, b.columns, mask)
 
-            fn = self._smap(local, 2, replicated_in=(1,))
+            fn = self._smap(local, 3,
+                            replicated_in=(1, 2) if not partitioned
+                            else ())
             for b in self.run(node.source):
-                yield fn(b, build_rep)
+                if repart_src is not None:
+                    b = repart_src(b)
+                yield fn(b, build_rep, prep)
             return
 
         # mark-join (EXISTS with residual): shard-local against the

@@ -46,7 +46,10 @@ class JoinStage:
     """One unique-build lookup join. ``dyn_keys`` are probe-schema column
     indices with runtime [lo, hi] bounds from the build summary (inner
     joins only) — values arrive as traced scalars so changing bounds
-    never recompiles."""
+    never recompiles. ``pallas`` routes this stage's probe through the
+    fused Pallas ragged-gather kernel (ops/pallas_join) — the executor
+    sets it only for direct-address prepared builds within the VMEM
+    budget, and strips it (strip_pallas) if the kernel fails to lower."""
     lkeys: Tuple[int, ...]
     rkeys: Tuple[int, ...]
     payload: Tuple[int, ...]
@@ -54,6 +57,15 @@ class JoinStage:
     join_type: str                        # inner | left
     out_fields: Tuple[Tuple[str, object], ...]
     dyn_keys: Tuple[int, ...] = ()
+    pallas: bool = False
+
+
+def strip_pallas(stages: Tuple[object, ...]) -> Tuple[object, ...]:
+    """The same chain with every JoinStage forced onto the XLA gather
+    path — the fused-pipeline fallback after a kernel compile failure."""
+    return tuple(dataclasses.replace(st, pallas=False)
+                 if isinstance(st, JoinStage) and st.pallas else st
+                 for st in stages)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,9 +102,15 @@ def _apply_stages(cur: Batch, stages, preps, builds, dyns, errs):
                     keep = keep & c.validity & (c.data >= b[j, 0]) \
                         & (c.data <= b[j, 1])
                 cur = Batch(cur.schema, cur.columns, keep)
-            out = lookup_join(cur, builds[ji], st.lkeys, st.rkeys,
-                              st.payload, st.names, st.join_type,
-                              prepared=preps[ji])
+            if st.pallas:
+                from ..ops.pallas_join import lookup_join_direct
+                out = lookup_join_direct(cur, builds[ji], st.lkeys,
+                                         st.rkeys, st.payload, st.names,
+                                         st.join_type, preps[ji])
+            else:
+                out = lookup_join(cur, builds[ji], st.lkeys, st.rkeys,
+                                  st.payload, st.names, st.join_type,
+                                  prepared=preps[ji])
             cur = Batch(Schema(list(st.out_fields)), out.columns,
                         out.row_mask)
             ji += 1

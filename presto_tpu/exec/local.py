@@ -29,8 +29,9 @@ from ..ops.aggregation import AggSpec
 from ..ops.jitcache import global_aggregate_jit as global_aggregate, grouped_aggregate_jit as grouped_aggregate
 from ..ops.jitcache import (
     build_key_ranks_jit, build_match_mask_jit, expand_join_jit,
-    key_bounds_violation_jit, lookup_join_jit, match_count_max_jit,
-    prepare_build_jit, prepare_direct_jit, semi_join_mask_jit,
+    key_bounds_violation_jit, lookup_join_jit, lookup_join_pallas_jit,
+    match_count_max_jit, prepare_build_jit, prepare_direct_jit,
+    prepare_direct_keyed_jit, semi_join_mask_jit,
 )
 from ..obs.metrics import REGISTRY
 from ..obs.trace import TRACER
@@ -48,6 +49,17 @@ _AGG_SORT_SELECTED = REGISTRY.counter("agg_sort_path_selected_total")
 #: the observable the q27-shaped star-chain tests assert on.
 _FUSED_SOURCE_LANES = REGISTRY.counter("fused_source_lanes_total")
 _FUSED_TAIL_LANES = REGISTRY.counter("fused_tail_lanes_total")
+
+
+def _note_join_strategy(stats, node, strategy: str, dist: str) -> None:
+    """Join-dispatch observability: one count per executed join/semi
+    operator, labeled strategy (direct / sorted / expand) x
+    distribution — the trace-level signal the strategy-selection tests
+    assert on, next to EXPLAIN ANALYZE's per-row [strategy ...] suffix."""
+    REGISTRY.counter(
+        f"join_strategy_selected_total.{strategy}.{dist}").inc()
+    if stats is not None and hasattr(stats, "record_join_strategy"):
+        stats.record_join_strategy(node, strategy, dist)
 from ..ops.join import expand_join, semi_join_mask
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
 from ..planner.plan import (
@@ -1059,11 +1071,11 @@ class _Executor:
             "fused_compact_window", 4)))
         return self._stream_fused(fn_head, fn_tail, source, pre_vals,
                                   preps_t, builds_t, dyns_t, window,
-                                  close_bufs)
+                                  close_bufs, tail_stages=tuple(tail))
 
     def _stream_fused(self, fn_head, fn_tail, source, pre_vals, preps_t,
-                      builds_t, dyns_t, window, close_bufs
-                      ) -> Iterator[Batch]:
+                      builds_t, dyns_t, window, close_bufs,
+                      tail_stages=()) -> Iterator[Batch]:
         """Head -> windowed compaction -> tail streaming loop. One
         liveness readback per ``window`` probe batches (the head carries
         each batch's live count as a traced scalar); the check disables
@@ -1103,9 +1115,29 @@ class _Executor:
             pend.clear()
             return outs
 
+        tail_fn = {"fn": fn_tail}
+        has_pallas = any(getattr(st, "pallas", False)
+                         for st in tail_stages)
+
         def run_tail(hb: Batch) -> Iterator[Batch]:
             _FUSED_TAIL_LANES.inc(hb.capacity)
-            out, err = fn_tail(hb, preps_t, builds_t, dyns_t)
+            try:
+                out, err = tail_fn["fn"](hb, preps_t, builds_t, dyns_t)
+            except Exception as e:
+                # a Pallas stage that fails to lower falls back to the
+                # pure-XLA chain for this and every later batch (the
+                # ops/pallas_join breaker) — any other failure is real
+                from ..ops import pallas_join as PJ
+                if not has_pallas or PJ.FORCE_PALLAS_PROBE:
+                    raise
+                from .fused import fused_pipeline, strip_pallas
+                stripped = fused_pipeline(strip_pallas(tail_stages))
+                # stripped rerun FIRST: a failure that also breaks the
+                # XLA chain (OOM, an upstream-stage bug) propagates
+                # without tripping the process-wide breaker
+                out, err = stripped(hb, preps_t, builds_t, dyns_t)
+                PJ.note_kernel_failure(e)
+                tail_fn["fn"] = stripped
             if err is not None:
                 self.error_flags.append(err)
             yield compact(out)
@@ -1179,7 +1211,18 @@ class _Executor:
                 from ..ops.jitcache import compact_jit
                 build = compact_jit(build, scap)
             prep = self._prepare_join_build(build, nd.right_keys,
-                                            summary=summary)
+                                            summary=summary,
+                                            key_bounds=nd.key_bounds)
+            from ..ops import pallas_join as PJ
+            from ..ops.join import is_direct_prepared
+            payload_cols = tuple(range(len(nd.right.fields)))
+            use_pallas = (self._pallas_probe_on()
+                          and PJ.supports_join(prep, build,
+                                               payload_cols))
+            _note_join_strategy(
+                self.stats, nd,
+                "direct" if is_direct_prepared(prep) else "sorted",
+                nd.distribution)
             dyn_keys: Tuple[int, ...] = ()
             dyn_val = jnp.zeros((0, 2), dtype=jnp.int64)
             if nd.join_type == "inner" and dyn_enabled:
@@ -1209,12 +1252,13 @@ class _Executor:
                                 scan, []).extend(extra)
             stages.append(JoinStage(
                 lkeys=tuple(nd.left_keys), rkeys=tuple(nd.right_keys),
-                payload=tuple(range(len(nd.right.fields))),
+                payload=payload_cols,
                 names=tuple(f"$b{i}"
                             for i in range(len(nd.right.fields))),
                 join_type=nd.join_type,
                 out_fields=tuple((f.name, f.type) for f in nd.fields),
-                dyn_keys=dyn_keys))
+                dyn_keys=dyn_keys,
+                pallas=use_pallas))
             preps.append(prep)
             builds.append(build)
             dyns.append(dyn_val)
@@ -1305,8 +1349,16 @@ class _Executor:
                     from ..ops.jitcache import compact_jit
                     build = compact_jit(build, scap)
             prep = (self._prepare_join_build(build, node.right_keys,
-                                             summary=summary)
+                                             summary=summary,
+                                             key_bounds=node.key_bounds)
                     if build is not None else None)
+            if build is not None:
+                from ..ops.join import is_direct_prepared
+                _note_join_strategy(
+                    self.stats, node,
+                    ("direct" if is_direct_prepared(prep) else "sorted")
+                    if node.build_unique else "expand",
+                    node.distribution)
             # ONE build-side multiplicity readback replaces the per-probe-
             # batch match_count_max sync (each a tunnel RTT): the max key
             # multiplicity of the build bounds every probe batch's match
@@ -1415,6 +1467,12 @@ class _Executor:
         (reference GenericPartitioningSpiller.java probe protocol)."""
         from .spill import HostPartitionStore
         pstore: Optional[HostPartitionStore] = None
+        # spilled builds join partition-serially over the sorted path:
+        # a K-slot direct table per partition would multiply the very
+        # memory pressure that forced the spill
+        _note_join_strategy(
+            self.stats, node,
+            "sorted" if node.build_unique else "expand", "partitioned")
         if probe_batches is None:
             probe_batches = self.run(node.left)
         try:
@@ -1519,15 +1577,37 @@ class _Executor:
                 out.append((pk, lo, hi))
         return out
 
-    def _prepare_join_build(self, build: Batch, keys, summary=None):
+    def _prepare_join_build(self, build: Batch, keys, summary=None,
+                            key_bounds=()):
         """LookupSource choice (reference HashBuilderOperator's
-        BigintGroupByHash-vs-MultiChannel split): a single integer key
-        with a bounded host-known range gets a direct-address table —
-        O(1) gathers per probe lane on hardware where random gathers
-        dominate join cost; anything else gets the sorted composite
-        search. Key bounds come from the caller's fused build summary
-        (no extra sync)."""
+        BigintGroupByHash-vs-MultiChannel split), stats-first:
+
+        1. planner-promised ``key_bounds`` (JoinNode.key_bounds, any
+           arity) build a mixed-radix composite direct-address table
+           with PLAN-TIME-KNOWN capacity — stable executable shapes
+           across batches and queries sharing the plan. The build batch
+           is cross-checked against the promised bounds through the
+           row-error channel (STATS_BOUND_VIOLATION — the dense-group
+           contract: stats that lie fail the query, never corrupt it);
+        2. a single integer key with a bounded MEASURED range gets the
+           runtime direct table (bounds from the caller's fused build
+           summary, no extra sync);
+        3. anything else gets the sorted composite search.
+
+        Direct tables answer a probe key in TWO gathers independent of
+        build size, where the sorted path pays O(log n) random gathers
+        per probe lane — the dominant join cost on this hardware."""
         keys = tuple(keys)
+        if key_bounds and bool_property(self.session, "join_dense_path",
+                                        True):
+            from ..ops.join import direct_keyed_plan
+            plan = direct_keyed_plan(tuple(key_bounds))
+            if plan is not None:
+                los, sizes, K = plan
+                self.error_flags.append(key_bounds_violation_jit(
+                    build, keys, tuple(key_bounds)))
+                return prepare_direct_keyed_jit(build, keys, los, sizes,
+                                                bucket_capacity(K))
         if len(keys) == 1 and isinstance(build.columns[keys[0]].type,
                                          _DYN_TYPES):
             if summary is None:
@@ -1539,6 +1619,39 @@ class _Executor:
                     return prepare_direct_jit(
                         build, keys, lo, bucket_capacity(span))
         return prepare_build_jit(build, keys)
+
+    def _pallas_probe_on(self) -> bool:
+        return bool_property(self.session, "join_pallas_probe", True)
+
+    def _dispatch_lookup(self, probe: Batch, build: Batch, lkeys, rkeys,
+                         payload, payload_names, jt: str, prepared):
+        """Unique-build probe dispatch: the Pallas fused probe kernel
+        when the session/backend/VMEM gate admits it, the XLA gather
+        path otherwise. The FIRST kernel dispatch that fails to lower
+        trips the process-wide breaker (ops/pallas_join) and this very
+        batch transparently re-runs on XLA — an unproven Mosaic
+        lowering can cost one failed compile, never a failed query."""
+        from ..ops import pallas_join as PJ
+        if self._pallas_probe_on() and PJ.supports_join(prepared, build,
+                                                        payload):
+            try:
+                return lookup_join_pallas_jit(
+                    probe, build, lkeys, rkeys, payload, payload_names,
+                    jt, prepared)
+            except Exception as e:
+                if PJ.FORCE_PALLAS_PROBE:
+                    raise      # tests want kernel failures loud
+                # XLA rerun FIRST: only when it succeeds is the kernel
+                # proven at fault — a failure that also breaks the XLA
+                # path (OOM, a bug upstream) propagates from it without
+                # tripping the process-wide breaker
+                out = lookup_join_jit(probe, build, lkeys, rkeys,
+                                      payload, payload_names, jt,
+                                      prepared)
+                PJ.note_kernel_failure(e)
+                return out
+        return lookup_join_jit(probe, build, lkeys, rkeys, payload,
+                               payload_names, jt, prepared)
 
     def _build_multiplicity(self, prepared) -> Optional[int]:
         """Host int of the build's max key multiplicity (one readback,
@@ -1564,8 +1677,9 @@ class _Executor:
         # unmatched-build tail separately
         jt = "left" if node.join_type == "full" else node.join_type
         if node.build_unique:
-            out = lookup_join_jit(probe, build, lkeys, rkeys,
-                                  payload, payload_names, jt, prepared)
+            out = self._dispatch_lookup(probe, build, lkeys, rkeys,
+                                        payload, payload_names, jt,
+                                        prepared)
             yield Batch(schema, out.columns, out.row_mask)
             return
         if maxk is None:
@@ -1623,8 +1737,9 @@ class _Executor:
                     else full_acc["m"] | mask
 
         if node.build_unique:
-            out = lookup_join_jit(probe, build, lkeys, rkeys, payload,
-                                  payload_names, "left", prepared)
+            out = self._dispatch_lookup(probe, build, lkeys, rkeys,
+                                        payload, payload_names, "left",
+                                        prepared)
             match = semi_join_mask_jit(probe, build, lkeys, rkeys,
                                        False, False, prepared)
             gated = residual_fn(Batch(schema, out.columns,
@@ -1731,8 +1846,15 @@ class _Executor:
         build = self._drain(node.filtering)
         skeys = list(node.source_keys)
         fkeys = list(node.filtering_keys)
-        prep = (self._prepare_join_build(build, fkeys)
+        prep = (self._prepare_join_build(build, fkeys,
+                                         key_bounds=node.key_bounds)
                 if build is not None else None)
+        if build is not None:
+            from ..ops.join import is_direct_prepared
+            _note_join_strategy(
+                self.stats, node,
+                "direct" if is_direct_prepared(prep) else "sorted",
+                node.distribution)
         res_maxk = (self._build_multiplicity(prep)
                     if build is not None and node.residual is not None
                     else None)

@@ -56,6 +56,11 @@ class StatsCollector:
         #: FLOPs/HBM bytes derive at render time (record.analyze() is
         #: lazy XLA introspection, never paid per call)
         self.exe_by_node: Dict[object, Dict[object, list]] = {}
+        #: plan node -> (strategy, distribution) the join dispatch
+        #: actually executed (direct/sorted/expand x replicated/
+        #: partitioned) — the EXPLAIN ANALYZE join-row annotation and
+        #: the per-query view of join_strategy_selected_total
+        self.join_strategy: Dict[object, tuple] = {}
         import threading
         # record_cache fires from concurrent prefetch worker threads;
         # an unsynchronized += would drop increments
@@ -120,6 +125,15 @@ class StatsCollector:
             })
         out.sort(key=lambda d: -d["device_time_s"])
         return out
+
+    def record_join_strategy(self, node, strategy: str,
+                             distribution: str) -> None:
+        """Executed join-dispatch verdict for one join/semi-join
+        operator (exec/local._Executor._note_join_strategy's sink)."""
+        self.join_strategy[node] = (strategy, distribution)
+
+    def join_strategy_for(self, node) -> Optional[tuple]:
+        return self.join_strategy.get(node)
 
     def record_split(self, table: str, split_no: int, started_at: float,
                      wall_s: float, batches: int) -> None:

@@ -318,6 +318,45 @@ def prepare_direct_jit(build, key_cols, lo0, size: int):
     return _prepare_direct(tuple(key_cols), size)(build, lo0)
 
 
+from .join import prepare_direct_keyed  # noqa: E402
+
+
+_prepare_direct_keyed = _entry_cache(
+    "prepare_direct_keyed",
+    lambda key_cols, los, sizes, size: jax.jit(
+        lambda b: prepare_direct_keyed(b, key_cols, los, sizes, size)))
+
+
+def prepare_direct_keyed_jit(build, key_cols, los, sizes, size: int):
+    """Planner-bounded multi-key direct table: los/sizes/size are
+    host-static (from JoinNode.key_bounds), so the table capacity — and
+    every probe executable shape over it — is known at plan time."""
+    return _prepare_direct_keyed(tuple(key_cols), tuple(los),
+                                 tuple(sizes), size)(build)
+
+
+def _lookup_pallas_factory(pkeys, bkeys, payload, names, jt):
+    from .pallas_join import lookup_join_direct
+
+    def run(p, b, prep):
+        return lookup_join_direct(p, b, pkeys, bkeys, payload, names,
+                                  jt, prep)
+    return jax.jit(run)
+
+
+_lookup_pallas = _entry_cache("lookup_join_pallas", _lookup_pallas_factory)
+
+
+def lookup_join_pallas_jit(probe, build, probe_keys, build_keys, payload,
+                           payload_names, join_type, prepared):
+    """The Pallas probe-kernel twin of lookup_join_jit (direct prepared
+    only — callers gate on ops/pallas_join.supports_join and fall back
+    to the XLA path on any kernel failure)."""
+    return _lookup_pallas(tuple(probe_keys), tuple(build_keys),
+                          tuple(payload), tuple(payload_names),
+                          join_type)(probe, build, prepared)
+
+
 def _build_summary_factory(key_cols, int_flags):
     import jax.numpy as jnp
 

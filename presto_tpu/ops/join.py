@@ -133,26 +133,135 @@ def prepare_direct(build: Batch, key_cols: Sequence[int], lo0,
             s_ops, slive, perm)
 
 
+#: largest composite slot-table size a planner-keyed direct build may
+#: allocate (slots x 2 x i32 = 512MB of HBM at the cap); the planner
+#: gate (optimizer._attach_join_strategy) and the executor both respect
+#: it, so key_bounds on a JoinNode always fit
+DIRECT_KEYED_LIMIT = 1 << 26
+
+
+def direct_keyed_plan(key_bounds, limit: int = DIRECT_KEYED_LIMIT):
+    """Host-static (los, sizes, K) for a planner-bounded multi-key
+    direct-address table, or None when it cannot engage: every key needs
+    a hard [lo, hi] and the mixed-radix composite product must stay
+    under ``limit`` — the join-side mirror of
+    ``ops/aggregation.dense_group_plan``'s dispatch rule."""
+    if not key_bounds or any(b is None for b in key_bounds):
+        return None
+    los: List[int] = []
+    sizes: List[int] = []
+    K = 1
+    for lo, hi in key_bounds:
+        if hi < lo:
+            return None
+        span = int(hi) - int(lo) + 1
+        los.append(int(lo))
+        sizes.append(span)
+        K *= span
+        if K > limit:
+            return None
+    return tuple(los), tuple(sizes), K
+
+
+def _composite_code(ops: Sequence[jnp.ndarray], los, sizes):
+    """(code, in_domain) of key-operand tuples against per-key
+    [lo, lo+size) domains: code is the mixed-radix slot index — the same
+    composite i32 code ``dense_group_plan`` builds for GROUP BY, minus
+    the NULL component (null keys never match a join). ``los``/``sizes``
+    index positionally (host tuples or traced i64 arrays both work)."""
+    code = jnp.zeros(ops[0].shape, dtype=jnp.int64)
+    ind = jnp.ones(ops[0].shape, dtype=bool)
+    for i, op in enumerate(ops):
+        lo = los[i]
+        size = sizes[i]
+        off = op.astype(jnp.int64) - lo
+        ind = ind & (off >= 0) & (off < size)
+        code = code * size + jnp.clip(off, 0, size - 1)
+    return code, ind
+
+
+def prepare_direct_keyed(build: Batch, key_cols: Sequence[int],
+                         los: Sequence[int], sizes: Sequence[int],
+                         size: int):
+    """Multi-key direct-address table from PLANNER-PROMISED key bounds
+    (``JoinNode.key_bounds``): composite mixed-radix slot per key tuple,
+    answered in TWO gathers per probe lane regardless of arity or build
+    size. Table capacity is host-known at PLAN time, so every batch of
+    every query sharing the plan reuses one executable shape.
+
+    Live build keys outside their promised bounds land in the overflow
+    slot (they can never match) — the executor independently raises
+    STATS_BOUND_VIOLATION for such rows through the row-error channel
+    (the ``dense_group_plan`` contract), so an overclaiming connector
+    fails the query instead of silently dropping matches.
+
+    Returns (los, sizes, lo_table, cnt_table, s_ops, slive, perm)."""
+    s_ops, slive, perm = build_sorted(build, key_cols)
+    n = s_ops[0].shape[0]
+    code, inr = _composite_code(s_ops, los, sizes)
+    # lexicographic sort == composite-code sort inside the domain, so
+    # equal-tuple runs are contiguous and [lo, lo+cnt) is exact
+    tgt = jnp.where(slive & inr, code, size).astype(jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    lo_table = jnp.full(size + 1, n, dtype=jnp.int32) \
+        .at[tgt].min(idx)[:size]
+    cnt_table = jnp.zeros(size + 1, dtype=jnp.int32) \
+        .at[tgt].add(jnp.int32(1))[:size]
+    return (jnp.asarray(los, dtype=jnp.int64),
+            jnp.asarray(sizes, dtype=jnp.int64),
+            lo_table, cnt_table, s_ops, slive, perm)
+
+
 def _is_direct(prepared) -> bool:
     return prepared is not None and len(prepared) == 6
+
+
+def _is_direct_keyed(prepared) -> bool:
+    return prepared is not None and len(prepared) == 7
+
+
+def is_direct_prepared(prepared) -> bool:
+    """Either direct layout (single-key measured or multi-key planner
+    bounds) — the dispatch the executors report as strategy=direct."""
+    return _is_direct(prepared) or _is_direct_keyed(prepared)
 
 
 def _split_prepared(prepared):
     if _is_direct(prepared):
         return prepared[3], prepared[4], prepared[5]
+    if _is_direct_keyed(prepared):
+        return prepared[4], prepared[5], prepared[6]
     return prepared
+
+
+def direct_slot_codes(q_ops, prepared):
+    """(slot, in_domain) probe-side addressing of a direct prepared —
+    slot is a clipped i32 index into the lookup tables. Shared by the
+    XLA probe path and the Pallas probe kernel so the two stay
+    row-exact by construction."""
+    if _is_direct(prepared):
+        lo0, lo_table = prepared[0], prepared[1]
+        size = lo_table.shape[0]
+        off = q_ops[0] - lo0
+        inr = (off >= 0) & (off < size)
+        return jnp.clip(off, 0, size - 1).astype(jnp.int32), inr
+    los, sizes, lo_table = prepared[0], prepared[1], prepared[2]
+    size = lo_table.shape[0]
+    code, inr = _composite_code(q_ops, los, sizes)
+    return jnp.clip(code, 0, size - 1).astype(jnp.int32), inr
 
 
 def _range_lookup(q_ops, prepared):
     """Per-probe-lane [lo, hi) over the SORTED build — via the direct
-    table (2 gathers) or composite binary search (2 log n gathers)."""
-    if _is_direct(prepared):
-        lo0, lo_table, cnt_table, s_ops, slive, _ = prepared
+    table (2 gathers, single-key or composite) or composite binary
+    search (2 log n gathers)."""
+    if is_direct_prepared(prepared):
+        s_ops = _split_prepared(prepared)[0]
+        lo_table, cnt_table = ((prepared[1], prepared[2])
+                               if _is_direct(prepared)
+                               else (prepared[2], prepared[3]))
         n = s_ops[0].shape[0]
-        size = lo_table.shape[0]
-        off = q_ops[0] - lo0
-        inr = (off >= 0) & (off < size)
-        idx = jnp.clip(off, 0, size - 1).astype(jnp.int32)
+        idx, inr = direct_slot_codes(q_ops, prepared)
         lo = jnp.where(inr, jnp.take(lo_table, idx, axis=0), n)
         cnt = jnp.where(inr, jnp.take(cnt_table, idx, axis=0), 0)
         return lo.astype(jnp.int32), (lo + cnt).astype(jnp.int32)
@@ -164,9 +273,9 @@ def _range_lookup(q_ops, prepared):
 
 def _point_lookup(q_ops, prepared):
     """(pos, hit) of each probe lane's first match in the sorted build."""
-    if _is_direct(prepared):
+    if is_direct_prepared(prepared):
         lo, hi = _range_lookup(q_ops, prepared)
-        n = prepared[3][0].shape[0]
+        n = _split_prepared(prepared)[0][0].shape[0]
         return jnp.clip(lo, 0, n - 1), hi > lo
     s_ops, slive, _ = prepared
     pos = _lex_searchsorted(s_ops, q_ops, side="left")
@@ -292,10 +401,14 @@ def max_multiplicity(prepared) -> jnp.ndarray:
     are likewise a property of the build alone (reference
     operator/ArrayPositionLinks.java).
     """
-    if _is_direct(prepared):
-        cnt_table = prepared[2]
+    if is_direct_prepared(prepared):
+        cnt_table = prepared[2] if _is_direct(prepared) else prepared[3]
         if cnt_table.shape[0] == 0:
             return jnp.asarray(0, dtype=jnp.int64)
+        # keyed tables route bound-violating build rows to the overflow
+        # slot, so the table max alone would undercount a (failing)
+        # query's multiplicity — but such queries die on the error
+        # channel before any expansion sizing matters
         return jnp.max(cnt_table).astype(jnp.int64)
     s_ops, slive, _ = prepared
     n = s_ops[0].shape[0]

@@ -225,6 +225,22 @@ class _Fragmenter:
             return dataclasses.replace(node, source=source,
                                        filtering=filtering), \
                 ("single" if "single" in (sloc, floc) else "any")
+        if node.distribution == "partitioned" \
+                and not (node.negated and node.null_aware):
+            # stats said the filtering set is too large to broadcast
+            # (optimizer._attach_join_strategy): hash BOTH sides by key
+            # into a fixed stage — matching keys colocate, so the
+            # per-partition membership verdicts compose exactly.
+            # NULL-aware anti joins never take this branch (their
+            # build_has_null / build_empty facts are global).
+            source = self.cut(source, sloc if sloc != "any" else "single",
+                              OutputSpec("partition",
+                                         tuple(node.source_keys)))
+            filtering = self.cut(
+                filtering, floc if floc != "any" else "single",
+                OutputSpec("partition", tuple(node.filtering_keys)))
+            return dataclasses.replace(node, source=source,
+                                       filtering=filtering), "fixed"
         # the filtering set broadcasts: every source task needs every key
         # (and NULL-aware anti semantics need global NULL knowledge)
         if floc != "any":

@@ -55,6 +55,9 @@ def optimize(plan: LogicalPlan, session: Session) -> LogicalPlan:
             node = _push_partial_agg_through_join(node, session)
         if bool_property(session, "stats_bounded_grouping", True):
             node = _attach_group_bounds(node, session)
+        node = _attach_join_strategy(
+            node, session,
+            dense=bool_property(session, "join_dense_path", True))
         return _attach_scan_pushdown(node)
     # one memoized StatsCalculator for the whole pass: join ordering,
     # distribution choice, and the eager-agg gate all estimate the same
@@ -1049,6 +1052,82 @@ def _bounds_for_keys(child: PlanNode, key_cols: Sequence[int],
     if not any_bound or domain > DENSE_SCATTER_LIMIT:
         return ()
     return tuple(bounds)
+
+
+# ---------------------------------------------------------------------------
+# Pass 6: stats-driven join strategy (direct-address builds + semi-join
+# distribution) — the rewrite gate for ops/join.prepare_direct_keyed
+# ---------------------------------------------------------------------------
+
+def _join_key_bounds(node: PlanNode, keys: Sequence[int],
+                     session: Session
+                     ) -> Tuple[Optional[Tuple[int, int]], ...]:
+    """Hard [lo, hi] per build/filtering key when statistics prove them
+    all, or () when the direct-address table cannot engage. Bounds must
+    be TRUE bounds (the _group_key_bound contract): the stats calculus
+    only narrows ranges from connector min/max, and the executor
+    cross-checks every build batch through the row-error channel
+    (STATS_BOUND_VIOLATION), so an overclaiming connector fails the
+    query instead of dropping matches. The composite mixed-radix
+    product gates against ops/join.DIRECT_KEYED_LIMIT — the same
+    dispatch shape as dense grouping's DENSE_SCATTER_LIMIT."""
+    from ..ops.join import direct_keyed_plan
+    import math
+    if not keys:
+        return ()
+    calc = _stats_calc(session)
+    bounds: List[Tuple[int, int]] = []
+    for k in keys:
+        t = node.fields[k].type
+        if not isinstance(t, _BOUNDABLE):
+            return ()
+        ce = calc.estimate(node).column(k)
+        if ce.lo is None or ce.hi is None or ce.hi < ce.lo:
+            return ()
+        bounds.append((int(math.floor(ce.lo)), int(math.ceil(ce.hi))))
+    if direct_keyed_plan(tuple(bounds)) is None:
+        return ()
+    return tuple(bounds)
+
+
+def _attach_join_strategy(node: PlanNode, session: Session,
+                          dense: bool = True) -> PlanNode:
+    """Attach stats-derived build-key bounds to joins whose composite
+    key domain is provably small — the planner side of the dense-key
+    direct-address join (ops/join.prepare_direct_keyed: a bounded key
+    tuple answers in TWO gathers independent of build size, where the
+    sorted fallback pays O(log n) gathers per probe lane) — and pick
+    semi-join distribution from the estimated filtering size instead of
+    broadcast-membership-everywhere. Runs AFTER _implement_joins /
+    the eager-agg push, so build sides are final. ``dense`` is the
+    `join_dense_path` escape hatch — it gates ONLY the direct-address
+    bounds; distribution selection is an independent decision and stays
+    on either way."""
+    node = node.with_children([_attach_join_strategy(c, session, dense)
+                               for c in node.children])
+    if dense and isinstance(node, JoinNode) and node.join_type != "cross" \
+            and node.right_keys:
+        kb = _join_key_bounds(node.right, node.right_keys, session)
+        if kb:
+            node = dataclasses.replace(node, key_bounds=kb)
+    if isinstance(node, SemiJoinNode):
+        if dense:
+            kb = _join_key_bounds(node.filtering, node.filtering_keys,
+                                  session)
+            if kb:
+                node = dataclasses.replace(node, key_bounds=kb)
+        if not (node.negated and node.null_aware):
+            # NULL-aware anti joins (NOT IN) must see the GLOBAL
+            # filtering set (any NULL build key poisons every shard's
+            # verdict; an empty set passes everything) — they stay
+            # replicated. Everything else partitions when the
+            # filtering set is too large to broadcast.
+            rows = _estimate_rows(node.filtering, session)
+            node = dataclasses.replace(
+                node,
+                distribution=_distribution(node.filtering, rows,
+                                           session))
+    return node
 
 
 def _attach_group_bounds(node: PlanNode, session: Session) -> PlanNode:

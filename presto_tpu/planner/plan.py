@@ -165,6 +165,17 @@ class JoinNode(PlanNode):
     # execution hints (filled by the optimizer)
     distribution: str = "partitioned"   # partitioned | replicated
     build_unique: bool = False          # build keys known unique (PK)
+    # stats-derived hard [lo, hi] per BUILD key (aligned with
+    # right_keys; () = no planner bounds). When attached, every key's
+    # domain is statistics-proven and the mixed-radix composite product
+    # is small, so the executor builds a multi-key direct-address table
+    # (ops/join.prepare_direct_keyed) with plan-time-known capacity —
+    # the join-side twin of AggregationNode.key_bounds. The executor
+    # cross-checks every build batch through the row-error channel
+    # (STATS_BOUND_VIOLATION), so an overclaiming connector fails the
+    # query instead of dropping matches. Attached by
+    # optimizer._attach_join_strategy.
+    key_bounds: Tuple[Optional[Tuple[int, int]], ...] = ()
 
     @property
     def children(self) -> Tuple[PlanNode, ...]:
@@ -195,6 +206,16 @@ class SemiJoinNode(PlanNode):
     negated: bool = False
     residual: Optional[ir.Expr] = None
     null_aware: bool = True
+    # stats-driven distribution (optimizer._attach_join_strategy):
+    # "replicated" broadcasts the filtering set to every source task
+    # (membership-everywhere — mandatory for NULL-aware anti joins,
+    # whose build_has_null/build_empty facts are global); "partitioned"
+    # hashes BOTH sides by key so a huge filtering set never replicates
+    # (reference DetermineSemiJoinDistributionType.java).
+    distribution: str = "replicated"
+    # stats-derived hard [lo, hi] per FILTERING key (see
+    # JoinNode.key_bounds — enables the direct-address membership table)
+    key_bounds: Tuple[Optional[Tuple[int, int]], ...] = ()
 
     @property
     def children(self) -> Tuple[PlanNode, ...]:

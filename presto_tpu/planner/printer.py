@@ -322,11 +322,13 @@ def _label(n: PlanNode) -> str:
     if isinstance(n, JoinNode):
         return (f"Join[{n.join_type}, {n.distribution}, "
                 f"L{list(n.left_keys)}=R{list(n.right_keys)}"
-                f"{', unique' if n.build_unique else ''}]")
+                f"{', unique' if n.build_unique else ''}"
+                f"{_bounds_label(n.key_bounds)}]")
     if isinstance(n, SemiJoinNode):
         res = ", residual" if n.residual is not None else ""
         return (f"SemiJoin[{'anti' if n.negated else 'semi'}, "
-                f"keys={list(n.source_keys)}{res}]")
+                f"{n.distribution}, keys={list(n.source_keys)}{res}"
+                f"{_bounds_label(n.key_bounds)}]")
     if isinstance(n, SortNode):
         return f"Sort[{[(k.index, 'asc' if k.ascending else 'desc') for k in n.keys]}]"
     if isinstance(n, TopNNode):
@@ -344,6 +346,17 @@ def _label(n: PlanNode) -> str:
     if isinstance(n, OutputNode):
         return f"Output => [{cols}]"
     return type(n).__name__
+
+
+def _bounds_label(key_bounds) -> str:
+    """Planner-promised build-key bounds on a join row: the EXPLAIN
+    signal that the dense-key direct-address strategy was selected
+    (optimizer._attach_join_strategy), mirroring the Aggregate
+    ``bounds=[...]`` label of the dense-grouping gate."""
+    if not key_bounds:
+        return ""
+    spans = ["?" if b is None else f"{b[0]}..{b[1]}" for b in key_bounds]
+    return f", direct bounds=[{', '.join(spans)}]"
 
 
 def _si(v: float) -> str:
@@ -377,6 +390,12 @@ def _walk(n: PlanNode, depth: int, lines: List[str], stats=None) -> None:
                 suffix += (f" [device {dev['device_time_s'] * 1e3:,.1f}ms"
                            f", {_si(dev['flops'])}FLOP"
                            f", {_si(dev['hbm_bytes'])}B hbm]")
+            # executed join dispatch (strategy x distribution): the
+            # runtime verdict next to the planner's promised bounds
+            js = (stats.join_strategy_for(n)
+                  if hasattr(stats, "join_strategy_for") else None)
+            if js is not None:
+                suffix += f" [strategy {js[0]}/{js[1]}]"
         elif not isinstance(n, OutputNode):
             suffix = "   [not executed]"
     lines.append("  " * depth + "- " + _label(n) + suffix)

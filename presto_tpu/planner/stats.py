@@ -267,8 +267,28 @@ class StatsCalculator:
         return PlanEstimate(max(rows, 1.0), cols)
 
     def _SemiJoinNode(self, node: SemiJoinNode) -> PlanEstimate:
+        """Containment selectivity (the JoinStatsRule formula applied to
+        membership): the fraction of source rows with a match is bounded
+        by ndv(filtering key) / ndv(source key). Feeds the semi-join
+        distribution choice (optimizer._attach_join_strategy) and join
+        ordering above; falls back to the old flat 0.5 when NDVs are
+        unknown. Anti joins invert, floored to stay upper-bound-biased."""
         src = self.estimate(node.source)
-        return PlanEstimate(max(0.5 * src.rows, 1.0), src.columns)
+        filt = self.estimate(node.filtering)
+        sel: Optional[float] = None
+        for sk, fk in zip(node.source_keys, node.filtering_keys):
+            sn = src.column(sk).distinct
+            fn = filt.column(fk).distinct
+            if sn and fn and sn > 0:
+                frac = min(1.0, fn / sn)
+                sel = frac if sel is None else min(sel, frac)
+        if sel is None:
+            sel = 0.5
+        if node.negated:
+            sel = max(1.0 - sel, 0.1)
+        rows = max(src.rows * sel, 1.0)
+        cols = {i: ce.capped(rows) for i, ce in src.columns.items()}
+        return PlanEstimate(rows, cols)
 
     def _AggregationNode(self, node: AggregationNode) -> PlanEstimate:
         child = self.estimate(node.child)

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Device-truth profile of one bench query as machine-readable JSON.
+
+The repeatable path behind "profile q55 and let the cost verdict pick
+the fight" (docs/perf.md round 8): runs a bench query — by name (q1,
+q3, q55, q27) over the bench harness's connector at ``--sf``, or any
+``--sql`` — under the PR 6 profiling plane (``profile`` semantics:
+every jit dispatch bracketed with block_until_ready and attributed to
+the plan operator whose frame made it) and emits the per-operator
+``device_time_s``/``flops``/``hbm_bytes`` table, the executed join
+strategies, the executables ranked by device time, and the
+input-bound-vs-compute-bound cost verdict as ONE JSON document — so
+future perf PRs start from device truth instead of wall-clock guesses.
+
+Usage:
+    python -m tools.profile_query --query q55 --sf 1 --out q55_prof.json
+    python -m tools.profile_query --catalog tpch --sql "select ..."
+
+The timed run is the SECOND execution (first pays compile + scan
+staging, mirroring bench.py's warmup), unless ``--cold`` keeps the
+first. Exit 0 on success with the JSON on stdout (and in ``--out``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: TPC-H Q3 through the ENGINE SQL path (the bench.py q3 config is a
+#: hand pipeline with no SQL text; the gate queries must all be
+#: profileable by name)
+_TPCH_Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+  o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+#: named bench queries -> (catalog, bench.py SQL attribute or text)
+_NAMED = {
+    "q1": ("tpch", "_TPCH_Q1"),
+    "q3": ("tpch", _TPCH_Q3),
+    "q55": ("tpcds", "_DS_Q55"),
+    "q27": ("tpcds", "_DS_Q27"),
+}
+
+
+def _node_rows(plan, stats):
+    """Flattened per-operator table, plan order (root first)."""
+    from presto_tpu.planner.printer import _label
+    rows = []
+
+    def walk(n, depth):
+        st = stats.stats_for(n)
+        dev = stats.device_for(n)
+        js = stats.join_strategy_for(n)
+        row = {"depth": depth, "operator": _label(n)}
+        if st is not None:
+            child_wall = sum(
+                (stats.stats_for(c).wall_s
+                 if stats.stats_for(c) is not None else 0.0)
+                for c in n.children)
+            row.update({
+                "wall_s": round(st.wall_s, 6),
+                "self_s": round(max(st.wall_s - child_wall, 0.0), 6),
+                "rows": st.rows, "batches": st.batches,
+            })
+        if dev is not None:
+            row.update({
+                "device_time_s": round(dev["device_time_s"], 6),
+                "flops": dev["flops"], "hbm_bytes": dev["hbm_bytes"],
+            })
+        if js is not None:
+            row["join_strategy"] = f"{js[0]}/{js[1]}"
+        rows.append(row)
+        for c in n.children:
+            walk(c, depth + 1)
+
+    walk(plan.root, 0)
+    return rows
+
+
+def profile_query(runner, sql: str, warm_runs: int = 1) -> dict:
+    """One profiled execution (after ``warm_runs`` untimed warmups) ->
+    the JSON document. Importable for tests."""
+    from presto_tpu.exec.local import execute_plan
+    from presto_tpu.exec.stats import StatsCollector
+    from presto_tpu.obs.profiler import cost_verdict
+
+    plan = runner.plan(sql)
+    session = runner.session
+    for _ in range(max(warm_runs, 0)):
+        execute_plan(plan, session, runner.rows_per_batch,
+                     collect_rows=False)
+    stats = StatsCollector(count_rows=True)
+    t0 = time.perf_counter()
+    execute_plan(plan, session, runner.rows_per_batch, stats=stats,
+                 collect_rows=False)
+    stats.total_wall_s = time.perf_counter() - t0
+    verdict = cost_verdict(stats)
+    return {
+        "sql": " ".join(sql.split()),
+        "wall_s": round(stats.total_wall_s, 6),
+        "backend": _backend(),
+        "operators": _node_rows(plan, stats),
+        "executables": [
+            {k: e[k] for k in ("name", "invocations", "device_time_s",
+                               "compile_seconds", "flops",
+                               "bytes_accessed")}
+            for e in stats.executables_used()],
+        "cost_verdict": verdict,
+    }
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="profile one bench query; emit per-operator device "
+                    "time + cost verdict as JSON")
+    ap.add_argument("--query", choices=sorted(_NAMED),
+                    help="named bench query (bench.py SQL text)")
+    ap.add_argument("--sql", help="arbitrary SQL instead of --query")
+    ap.add_argument("--catalog", default=None,
+                    help="catalog for --sql (default from --query, "
+                         "else tpch)")
+    ap.add_argument("--sf", type=float, default=1.0,
+                    help="scale factor (default 1)")
+    ap.add_argument("--rows-per-batch", type=int, default=1 << 20)
+    ap.add_argument("--cold", action="store_true",
+                    help="profile the FIRST run (includes compile + "
+                         "staging) instead of a warmed run")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the JSON here (temp+rename)")
+    args = ap.parse_args(argv)
+
+    if bool(args.query) == bool(args.sql):
+        print(json.dumps({"error": "exactly one of --query/--sql"}))
+        return 2
+    if args.query:
+        catalog, attr = _NAMED[args.query]
+        if attr.startswith("_") and "\n" not in attr:
+            import bench
+            sql = getattr(bench, attr)
+        else:
+            sql = attr
+    else:
+        catalog, sql = args.catalog or "tpch", args.sql
+
+    from presto_tpu.connectors.spi import CatalogManager
+    from presto_tpu.exec.runner import LocalRunner
+    catalogs = CatalogManager()
+    if catalog == "tpcds":
+        from presto_tpu.connectors.tpcds import TpcdsConnector
+        catalogs.register("tpcds", TpcdsConnector(sf=args.sf))
+    else:
+        from presto_tpu.connectors.tpch import TpchConnector
+        catalogs.register("tpch", TpchConnector(sf=args.sf))
+    runner = LocalRunner(catalogs=catalogs, catalog=catalog,
+                         rows_per_batch=args.rows_per_batch)
+
+    doc = profile_query(runner, sql,
+                        warm_runs=0 if args.cold else 1)
+    doc["sf"] = args.sf
+    text = json.dumps(doc, indent=2, default=str)
+    print(text)
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text + "\n")
+        os.replace(tmp, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
