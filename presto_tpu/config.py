@@ -114,6 +114,25 @@ _sp("join_pallas_probe", "boolean", True,
     "fuse direct-join probe lookup + liveness + payload gathers into "
     "the Pallas ragged-gather kernel on TPU backends (pure-XLA gather "
     "fallback otherwise, and on any kernel compile failure)")
+
+
+def _valid_mesh_execution(v):
+    m = str(v).lower()
+    if m not in ("auto", "on", "off"):
+        raise SessionPropertyError(
+            f"mesh_execution must be auto, on or off, got {v!r}")
+    return m
+
+
+_sp("mesh_execution", "varchar", "auto",
+    "multi-chip SPMD execution substrate: auto runs SQL on the device "
+    "mesh whenever more than one device is visible and the plan "
+    "fragments into mesh stages, on forces it, off pins the "
+    "single-device path (PRESTO_TPU_MESH_EXECUTION overrides the "
+    "unset default)", _valid_mesh_execution)
+_sp("mesh_devices", "integer", 0,
+    "devices in the execution mesh (0 = every visible device); 1 "
+    "behaves like mesh_execution=off under auto")
 _sp("plan_cache", "boolean", True,
     "serve repeated statements from the compiled-plan cache "
     "(fingerprinted bound AST; skips parse/plan/optimize)")

@@ -1072,6 +1072,41 @@ class ClusterRunner:
                 continue
             self._note_node_info(url, info)
 
+    def _mesh_route(self, properties: Optional[Dict[str, object]] = None
+                    ) -> bool:
+        """Should this query run on the local device mesh instead of
+        remote worker tasks? ``mesh_execution=on`` always; ``auto``
+        (the default) only when >1 device is effective AND no remote
+        worker is schedulable — a cluster that HAS healthy workers
+        keeps the task/exchange path (spool, retries, speculation),
+        while a worker-less multi-chip coordinator gets the SPMD
+        substrate instead of failing with no nodes."""
+        import dataclasses as _dc
+
+        from ..config import validate_session_property
+        from .distributed import mesh_device_count, mesh_mode
+        session = self.session
+        if properties:
+            # only the two routing props matter here, and they must go
+            # through the registry gate NOW: a malformed mesh_devices
+            # raises the declared SessionPropertyError instead of a
+            # bare int() crash before the overlay's own validation
+            overlay = {k: validate_session_property(k, properties[k])
+                       for k in ("mesh_execution", "mesh_devices")
+                       if k in properties}
+            if overlay:
+                session = _dc.replace(
+                    session,
+                    properties={**session.properties, **overlay})
+        mode = mesh_mode(session)
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        if mesh_device_count(session) < 2:
+            return False
+        return not self._schedulable_workers()
+
     def _schedulable_workers(self) -> List[str]:
         """Workers eligible for NEW task assignment: heartbeat-alive and
         not draining (reference NodeScheduler skips nodes the
@@ -1167,6 +1202,18 @@ class ClusterRunner:
             and isinstance(stmt.statement, A.Query) \
             and stmt.type == "logical" and stmt.format == "text"
         if not isinstance(stmt, A.Query) and not analyze:
+            return self.local.execute(sql, properties=properties,
+                                      user=user,
+                                      cancel_event=cancel_event,
+                                      serving=serving)
+        if self._mesh_route(properties):
+            # mesh-native execution: with multiple chips on this host
+            # the device mesh IS the cluster substrate — shards of one
+            # SPMD program replace worker tasks. Route through the
+            # embedded LocalRunner (same admission/serving/security
+            # surface), whose execute_plan picks the SPMD executor.
+            # Under ``auto`` remote workers still win when any are
+            # schedulable; ``on`` forces the mesh.
             return self.local.execute(sql, properties=properties,
                                       user=user,
                                       cancel_event=cancel_event,

@@ -27,6 +27,7 @@ globally-sharded batch with equal per-shard capacity.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -52,9 +53,7 @@ from ..ops.join import (
     semi_join_mask,
 )
 from ..ops.sort import SortKey, limit as limit_kernel, sort_batch, top_n
-from ..parallel.exchange import (
-    partition_counts, repartition_by_hash, repartition_by_hash_compact,
-)
+from ..parallel.exchange import partition_counts
 from ..parallel.mesh import make_mesh
 from ..planner.plan import (
     AggregationNode, DistinctNode, FilterNode, JoinNode, LimitNode,
@@ -63,6 +62,274 @@ from ..planner.plan import (
 )
 from ..planner.planner import LogicalPlan, Session, bool_property
 from .local import QueryResult, _Executor, _plan_schema
+
+#: mesh-path auto-selection observable: one count per query the router
+#: placed on the SPMD substrate (the signal the default-on tests and
+#: the MULTICHIP bench assert on)
+_MESH_SELECTED = REGISTRY.counter("mesh_path_selected_total")
+#: adaptive re-splits: one count per hot-bucket re-assignment a
+#: _PartitionMap performed mid-query (StageMonitor's skew verdict
+#: turned into action)
+_MESH_RESPLITS = REGISTRY.counter("mesh_repartition_resplit_total")
+
+#: cached 1-D meshes per device count (Mesh construction is cheap, but
+#: a stable object keeps sharding identity stable across queries)
+_MESH_CACHE: Dict[int, jax.sharding.Mesh] = {}
+
+
+def mesh_mode(session) -> str:
+    """Resolved ``mesh_execution`` mode: the session property when set,
+    else the ``PRESTO_TPU_MESH_EXECUTION`` environment default, else
+    ``auto`` (mesh whenever >1 device is visible and the plan cuts into
+    mesh stages)."""
+    v = session.properties.get("mesh_execution")
+    if v is None:
+        v = os.environ.get("PRESTO_TPU_MESH_EXECUTION", "auto")
+    return str(v).lower()
+
+
+def mesh_device_count(session) -> int:
+    """Effective mesh width: every visible device, clamped by the
+    ``mesh_devices`` session property when positive."""
+    have = len(jax.devices())
+    want = int(session.properties.get("mesh_devices", 0) or 0)
+    return min(want, have) if want > 0 else have
+
+
+def _walk_scans(node) -> Iterator[TableScanNode]:
+    if isinstance(node, TableScanNode):
+        yield node
+    for c in node.children:
+        yield from _walk_scans(c)
+
+
+#: memoized router verdicts per LogicalPlan identity: the serving hot
+#: path re-executes one cached plan thousands of times, and the
+#: O(plan-size) fragmenter walk must run once per plan, not once per
+#: query. Entries carry a weakref to the plan and only serve while it
+#: still points at the same live object — id reuse after GC can never
+#: resurrect a dead plan's verdict. Lock-guarded: concurrent serving
+#: queries route through here on many threads (lockcheck: leaf lock,
+#: never held across a dispatch).
+_PLAN_VERDICTS: Dict[int, Tuple[object, Tuple[bool, bool, str]]] = {}
+from .._devtools.lockcheck import checked_lock
+_PLAN_VERDICTS_LOCK = checked_lock("distributed.plan_verdicts")
+
+
+def _plan_mesh_verdict(plan: LogicalPlan) -> Tuple[bool, bool, str]:
+    """(fragments-into-mesh-stages, reads-real-data, reason)."""
+    import weakref
+    key = id(plan)
+    with _PLAN_VERDICTS_LOCK:
+        hit = _PLAN_VERDICTS.get(key)
+        if hit is not None and hit[0]() is plan:
+            return hit[1]
+    from ..planner.fragmenter import plan_mesh_stages
+    roots = [plan.root] + list(plan.init_plans)
+    supported, reason = True, ""
+    for r in roots:
+        mp = plan_mesh_stages(r)
+        if not mp.supported:
+            supported, reason = False, mp.reason
+            break
+    scans = [s for r in roots for s in _walk_scans(r)]
+    scannable = bool(scans) and all(s.catalog != "system"
+                                    for s in scans)
+    verdict = (supported, scannable, reason)
+    with _PLAN_VERDICTS_LOCK:
+        if len(_PLAN_VERDICTS) > 512:
+            # evict dead plans first, then oldest-inserted live ones —
+            # never clear(): wiping live cached plans' verdicts would
+            # re-run the fragmenter walk on exactly the hot path this
+            # memo exists for
+            for k in [k for k, (ref, _) in _PLAN_VERDICTS.items()
+                      if ref() is None]:
+                _PLAN_VERDICTS.pop(k, None)
+            while len(_PLAN_VERDICTS) > 512:
+                _PLAN_VERDICTS.pop(next(iter(_PLAN_VERDICTS)), None)
+        _PLAN_VERDICTS[key] = (weakref.ref(plan), verdict)
+    return verdict
+
+
+def select_mesh(session: Session,
+                plan: LogicalPlan) -> Optional[jax.sharding.Mesh]:
+    """The mesh auto-router: the Mesh this query should execute on, or
+    None for the single-device path. ``auto`` (the default) selects the
+    mesh when more than one device is effective, the plan (init plans
+    included) cuts into mesh stages (planner/fragmenter.plan_mesh_stages)
+    and the query reads real data (system-catalog metadata queries gain
+    nothing from SPMD); ``on`` forces the mesh — an unfragmentable plan
+    then raises instead of silently degrading; ``off`` never meshes."""
+    mode = mesh_mode(session)
+    if mode == "off":
+        return None
+    n = mesh_device_count(session)
+    if n < 2 and mode != "on":
+        return None
+    supported, scannable, reason = _plan_mesh_verdict(plan)
+    if not supported:
+        if mode == "on":
+            raise NotImplementedError(
+                f"mesh_execution=on: plan has no mesh form ({reason})")
+        return None
+    if mode != "on" and not scannable:
+        return None
+    mesh = _MESH_CACHE.get(n)
+    if mesh is None:
+        mesh = _MESH_CACHE[n] = make_mesh(max(n, 1))
+    _MESH_SELECTED.inc()
+    return mesh
+
+
+#: bucket subdivisions per shard in the adaptive exchange: B = n*4
+#: buckets give the greedy re-balancer ~25%-of-a-shard granularity
+#: without growing the quota readback beyond a few hundred scalars
+_RESPLIT_FACTOR = 4
+
+
+def _skew_ratio() -> float:
+    """One engine-wide definition of "skewed": the coordinator
+    StageMonitor's verdict ratio (exec/cluster.py, PR 3) also decides
+    when the mesh exchange re-splits hot buckets."""
+    from .cluster import StageMonitor
+    return float(StageMonitor.skew_ratio)
+
+
+def _per_dest_quota(counts: np.ndarray, assign: Sequence[int],
+                    n: int) -> int:
+    """Max live rows any (src shard, dst shard) pair ships under
+    ``assign``: the static quota the compacted exchange needs."""
+    a = np.asarray(assign)
+    worst = 1
+    for d in range(n):
+        sel = counts[:, a == d]
+        if sel.size:
+            worst = max(worst, int(sel.sum(axis=1).max()))
+    return worst
+
+
+class _PartitionMap:
+    """Bucket -> shard assignment shared by every exchange of one
+    operator. Both sides of a partitioned join ship through ONE map, so
+    equal keys colocate under ANY assignment (keys hash to buckets,
+    buckets move atomically). The map observes per-bucket live counts
+    as batches flow and re-splits hot buckets between batches: when one
+    shard's load crosses the StageMonitor skew ratio over the median
+    shard and a greedy LPT re-balance of bucket totals actually lowers
+    the max, the assignment flips, ``epoch`` bumps, and the owning
+    operator re-ships its prepared side under the new map."""
+
+    #: re-balancing converges or it stops — never thrash the build side
+    MAX_CHANGES = 2
+
+    def __init__(self, n: int, adaptive: bool = True,
+                 ratio: Optional[float] = None):
+        self.n = n
+        self.buckets = n * _RESPLIT_FACTOR
+        self.assign: Tuple[int, ...] = tuple(
+            b % n for b in range(self.buckets))
+        self.epoch = 0
+        self.adaptive = bool(adaptive) and n > 1
+        self.ratio = float(ratio) if ratio is not None else _skew_ratio()
+        self.changes = 0
+        self._totals = np.zeros(self.buckets, dtype=np.int64)
+
+    def observe(self, counts: np.ndarray) -> None:
+        """Fold one batch's [n_src, buckets] live counts in; maybe
+        re-assign."""
+        if not self.adaptive:
+            return
+        self._totals += counts.sum(axis=0, dtype=np.int64)
+        if self.changes >= self.MAX_CHANGES:
+            return
+        loads = np.zeros(self.n, dtype=np.int64)
+        np.add.at(loads, np.asarray(self.assign), self._totals)
+        # skew verdict against the BALANCED load (total/n), not the
+        # median: with most shards idle the median collapses to zero
+        # and a median test would never fire exactly when it matters
+        fair = float(self._totals.sum()) / self.n
+        if fair < 1.0 or float(loads.max()) <= self.ratio * fair:
+            return
+        new = self._greedy()
+        new_loads = np.zeros(self.n, dtype=np.int64)
+        np.add.at(new_loads, np.asarray(new), self._totals)
+        if new == self.assign or new_loads.max() >= loads.max():
+            return            # a single hot KEY cannot be split further
+        self.assign = new
+        self.epoch += 1
+        self.changes += 1
+        _MESH_RESPLITS.inc()
+
+    def _greedy(self) -> Tuple[int, ...]:
+        """LPT: heaviest bucket first onto the least-loaded shard."""
+        order = np.argsort(-self._totals, kind="stable")
+        loads = [0] * self.n
+        out = [0] * self.buckets
+        for b in order:
+            d = min(range(self.n), key=lambda i: (loads[i], i))
+            out[int(b)] = d
+            loads[d] += int(self._totals[int(b)])
+        return tuple(out)
+
+
+class _Repartitioner:
+    """Quota-compacted bucket-hash exchange driver: one cheap collective
+    reads per-(src, bucket) live counts, the host sizes the static quota
+    and (through the shared _PartitionMap) may re-balance hot buckets,
+    and the exchange ships exactly quota slots per peer (wire cost ~C
+    instead of the masked all_to_all's n*C; reference
+    operator/PartitionedOutputOperator.java PagePartitioner). Jitted
+    exchanges are cached per (assignment, quota bucket)."""
+
+    def __init__(self, ex: "DistributedExecutor",
+                 key_cols: Sequence[int], pmap: _PartitionMap):
+        self.ex = ex
+        self.keys = tuple(key_cols)
+        self.map = pmap
+        self._counts_fn = ex._smap(
+            lambda b: partition_counts(b, self.keys, pmap.buckets), 1)
+        self._fns: Dict[Tuple, object] = {}
+        self._last_counts: Optional[np.ndarray] = None
+
+    @property
+    def epoch(self) -> int:
+        return self.map.epoch
+
+    def _counts(self, batch: Batch) -> np.ndarray:
+        with TRACER.span("device-sync", what="exchange-quota"):
+            raw = np.asarray(jax.device_get(self._counts_fn(batch)))
+        return raw.reshape(self.ex.n, self.map.buckets)
+
+    def _ship(self, batch: Batch, counts: np.ndarray) -> Batch:
+        from .failpoints import FAILPOINTS
+        FAILPOINTS.hit("mesh.repartition")
+        assign = self.map.assign
+        quota = bucket_capacity(
+            _per_dest_quota(counts, assign, self.ex.n))
+        key = (assign, quota)
+        fn = self._fns.get(key)
+        if fn is None:
+            from ..parallel.exchange import repartition_by_buckets_compact
+            fn = self._fns[key] = self.ex._smap(
+                lambda b, _a=assign, _q=quota:
+                repartition_by_buckets_compact(
+                    b, self.keys, self.ex.axis, self.ex.n, _a, _q), 1)
+        REGISTRY.counter("exchange_repartitions_total").inc()
+        return fn(batch)
+
+    def __call__(self, batch: Batch) -> Batch:
+        counts = self._counts(batch)
+        self._last_counts = counts
+        self.map.observe(counts)
+        return self._ship(batch, counts)
+
+    def replay(self, batch: Batch) -> Batch:
+        """Re-ship a batch this exchange already observed (the join's
+        build side after a probe-driven re-split) under the CURRENT
+        assignment, without folding its counts in twice."""
+        counts = (self._last_counts if self._last_counts is not None
+                  else self._counts(batch))
+        return self._ship(batch, counts)
 
 
 class DistributedExecutor(_Executor):
@@ -78,8 +345,8 @@ class DistributedExecutor(_Executor):
     #                            compaction happens in the exchange path
 
     def __init__(self, session: Session, rows_per_batch: int,
-                 mesh: jax.sharding.Mesh):
-        super().__init__(session, rows_per_batch)
+                 mesh: jax.sharding.Mesh, stats=None):
+        super().__init__(session, rows_per_batch, stats=stats)
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n = mesh.shape[self.axis]
@@ -154,43 +421,55 @@ class DistributedExecutor(_Executor):
                 jax.jit(lambda b: b, out_shardings=self._replicated))
         return fn(batch)
 
-    def _repartitioner(self, key_cols: Sequence[int]):
-        """Quota-compacted hash exchange driver: one cheap collective
-        reads per-(src,dst) live counts, the host buckets the max into a
-        static quota, and the exchange ships exactly quota slots per peer
-        (wire cost ~C instead of the masked all_to_all's n*C; reference
-        operator/PartitionedOutputOperator.java PagePartitioner). The
-        jitted exchange is cached per quota bucket."""
-        keys = tuple(key_cols)
-        counts_fn = self._smap(
-            lambda b: partition_counts(b, keys, self.n), 1)
-        fns: Dict[int, object] = {}
-
-        def repart(batch: Batch) -> Batch:
-            with TRACER.span("device-sync", what="exchange-quota"):
-                quota = bucket_capacity(
-                    max(int(np.asarray(
-                        jax.device_get(counts_fn(batch))).max()), 1))
-            fn = fns.get(quota)
-            if fn is None:
-                fn = fns[quota] = self._smap(
-                    lambda b, _q=quota: repartition_by_hash_compact(
-                        b, keys, self.axis, self.n, _q), 1)
-            REGISTRY.counter("exchange_repartitions_total").inc()
-            return fn(batch)
-        return repart
+    def _repartitioner(self, key_cols: Sequence[int],
+                       pmap: Optional[_PartitionMap] = None,
+                       adaptive: bool = True) -> _Repartitioner:
+        """An adaptive quota-compacted hash exchange (see
+        :class:`_Repartitioner`). Pass one shared ``pmap`` for every
+        exchange whose outputs must colocate (both sides of a
+        partitioned join); single-shot exchanges get their own map."""
+        if pmap is None:
+            pmap = _PartitionMap(self.n, adaptive=adaptive)
+        return _Repartitioner(self, key_cols, pmap)
 
     # -- scan: split placement ------------------------------------------------
     def _TableScanNode(self, node: TableScanNode) -> Iterator[Batch]:
-        """Round-robin split batches across shards; emit globally-sharded
-        chunks with equal per-shard capacity."""
+        """Round-robin split streams across shards THROUGH the device
+        scan cache + async prefetch pipeline (exec/scancache.py): each
+        shard's stream is a cached ``scan_splits`` pipeline, so hot
+        split data replays device-resident across mesh queries instead
+        of re-decoding per query, cold splits decode/stage on
+        background threads ahead of the mesh program, and hits/misses
+        land on the same ``scan_cache_*`` observables as the local
+        path. Per-round shard chunks stack into one globally-sharded
+        batch — device-to-device when every chunk is resident
+        (_assemble's composed path), through the host otherwise."""
+        import time as _time
+
+        from . import scancache
+
         conn = self.session.catalogs.get(node.catalog)
+        opts = scancache.options_from_session(self.session)
         splits = conn.split_manager.splits(node.table, self.n)
-        streams = [
-            conn.page_source(s, list(node.columns),
-                             pushdown=node.pushdown or None,
-                             rows_per_batch=self.rows_per_batch).batches()
-            for s in splits
+        pushdown = node.pushdown or None
+        t_query0 = _time.perf_counter()
+
+        def record_for(shard: int):
+            def record_split(i: int, t0: float, batches: int) -> None:
+                if self.stats is not None:
+                    self.stats.record_split(
+                        node.table.table, shard, t0 - t_query0,
+                        _time.perf_counter() - t0, batches)
+            return record_split
+
+        streams: List[Iterator[Batch]] = [
+            scancache.scan_splits(
+                conn, node.catalog, list(node.columns), [s],
+                lambda: pushdown, self.rows_per_batch, opts,
+                record_split=record_for(i),
+                check_cancel=self._check_cancel, stats=self.stats,
+                static_pushdown=pushdown)
+            for i, s in enumerate(splits)
         ]
         while len(streams) < self.n:
             streams.append(iter(()))
@@ -210,10 +489,74 @@ class DistributedExecutor(_Executor):
                 break
             yield self._assemble(parts, _plan_schema(node))
 
+    def _assemble_resident(self, parts: List[Optional[Batch]],
+                           schema: Schema, cap: int) -> Optional[Batch]:
+        """Stack per-shard device chunks into one globally-sharded batch
+        WITHOUT a host round trip: pad each chunk to the round's bucket
+        on device, copy it device-to-device onto its shard, and compose
+        the global array from the per-shard pieces
+        (jax.make_array_from_single_device_arrays). Returns None — and
+        the caller falls back to host staging (_stage_parts) — when
+        shards disagree on a dictionary (vocab merge needs the host) or
+        the backend refuses the composition."""
+        compose = getattr(jax, "make_array_from_single_device_arrays",
+                          None)
+        if compose is None:
+            return None
+        ncols = len(schema)
+        vocabs: List[Optional[Tuple[str, ...]]] = []
+        for ci in range(ncols):
+            vs = {p.columns[ci].dictionary for p in parts
+                  if p is not None
+                  and p.columns[ci].dictionary is not None}
+            if len(vs) > 1:
+                return None
+            vocabs.append(next(iter(vs)) if vs
+                          else (() if schema.types[ci].is_string
+                                else None))
+        from ..ops.jitcache import pad_capacity_jit
+        devs = list(self.mesh.devices.flat)
+        padded: List[Optional[Batch]] = []
+        for i in range(self.n):
+            p = parts[i] if i < len(parts) else None
+            if p is not None and p.capacity < cap:
+                p = pad_capacity_jit(p, cap)
+            padded.append(p)
+        try:
+            def compose_col(ci: int, which: str):
+                proto = next(getattr(p.columns[ci], which)
+                             for p in padded if p is not None)
+                shards = []
+                for i, p in enumerate(padded):
+                    a = (getattr(p.columns[ci], which)
+                         if p is not None
+                         else jnp.zeros(proto.shape, proto.dtype))
+                    shards.append(jax.device_put(a, devs[i]))
+                shape = (self.n * cap,) + tuple(proto.shape[1:])
+                return compose(shape, self._row_sharding, shards)
+
+            cols = [Column(schema.types[ci], compose_col(ci, "data"),
+                           compose_col(ci, "validity"), vocabs[ci])
+                    for ci in range(ncols)]
+            mask = compose(
+                (self.n * cap,), self._row_sharding,
+                [jax.device_put(
+                    p.row_mask if p is not None
+                    else jnp.zeros((cap,), dtype=bool), devs[i])
+                 for i, p in enumerate(padded)])
+            return Batch(schema, cols, mask)
+        except Exception:
+            return None          # any residency surprise: host staging
+
     def _assemble(self, parts: List[Optional[Batch]],
                   schema: Schema) -> Batch:
-        """Stack per-shard host batches into one globally-sharded batch."""
+        """Stack per-shard batches into one globally-sharded batch —
+        device-resident when possible, staged through the host when a
+        vocab merge or backend limitation forces it."""
         cap = max(p.capacity for p in parts if p is not None)
+        resident = self._assemble_resident(parts, schema, cap)
+        if resident is not None:
+            return resident
         ncols = len(schema)
         datas: List[List[np.ndarray]] = [[] for _ in range(ncols)]
         valids: List[List[np.ndarray]] = [[] for _ in range(ncols)]
@@ -455,13 +798,21 @@ class DistributedExecutor(_Executor):
 
         lkeys, rkeys = list(node.left_keys), list(node.right_keys)
         replicated = node.distribution == "replicated"
+        track_full = node.join_type == "full"
+        pmap = repart_build = None
         if replicated:
             # FIXED_BROADCAST: build side replicated to every shard —
             # device-to-device all-gather, no host staging
             build_side = self._replicate_device(build)
         else:
-            # FIXED_HASH: build repartitioned by join key over ICI once
-            build_side = self._repartitioner(rkeys)(build)
+            # FIXED_HASH: build repartitioned by join key over ICI once.
+            # ONE _PartitionMap covers build AND probe exchanges, so
+            # equal keys colocate under any (re-balanced) assignment.
+            # FULL joins pin the map (adaptive=False): their per-shard
+            # unmatched-build masks cannot survive rows moving shards.
+            pmap = _PartitionMap(self.n, adaptive=not track_full)
+            repart_build = self._repartitioner(rkeys, pmap)
+            build_side = repart_build(build)
 
         # prepare the build ONCE per shard (the LookupSource role, same
         # contract as exec/local.py): every probe program takes the
@@ -492,8 +843,9 @@ class DistributedExecutor(_Executor):
             def prep_local(b: Batch):
                 return prepare_build(b, rkeys)
         prep_in = (0,) if replicated else ()
-        prepared = self._smap(prep_local, 1, replicated_in=prep_in,
-                              replicated_out=replicated)(build_side)
+        prep_smap = self._smap(prep_local, 1, replicated_in=prep_in,
+                               replicated_out=replicated)
+        prepared = prep_smap(build_side)
         _note_join_strategy(
             self.stats, node,
             ("direct" if kb_plan is not None else "sorted")
@@ -599,6 +951,10 @@ class DistributedExecutor(_Executor):
                 bound = int(np.asarray(
                     jax.device_get(mult_fn(prepared))).max())
             if bound <= self.SKEW_MATCH_LIMIT:
+                # the bound survives re-assignment: a key's rows move
+                # between shards ATOMICALLY (bucket granularity), so a
+                # shard's max per-key multiplicity never exceeds the
+                # global max this readback saw
                 maxk_static = bucket_capacity(max(bound, 1), minimum=1)
             else:
                 def local_count(p: Batch, b: Batch, pr) -> jnp.ndarray:
@@ -607,18 +963,28 @@ class DistributedExecutor(_Executor):
                 count_fn = self._smap(local_count, 3,
                                       replicated_in=rep_in2)
 
-        repart_probe = None if replicated else self._repartitioner(lkeys)
+        repart_probe = (None if replicated
+                        else self._repartitioner(lkeys, pmap))
         join_fns: Dict[int, object] = {}
-        track_full = node.join_type == "full"
         match_fn = (self._smap(
             lambda p, b, pr: build_match_mask(p, b, lkeys, rkeys,
                                               prepared=pr), 3,
             replicated_in=rep_in2)
             if track_full else None)
         build_matched = None
+        built_epoch = pmap.epoch if pmap is not None else 0
         for probe in self.run(node.left):
             if repart_probe is not None:
                 probe = repart_probe(probe)
+                if pmap.epoch != built_epoch:
+                    # adaptive re-split (StageMonitor's skew verdict in
+                    # action): a hot bucket moved shards, so the
+                    # prepared build is stale — re-ship the retained
+                    # build under the new assignment and re-prepare,
+                    # once per epoch, before the next probe batch
+                    build_side = repart_build.replay(build)
+                    prepared = prep_smap(build_side)
+                    built_epoch = pmap.epoch
             maxk = 1
             if maxk_static is not None:
                 maxk = maxk_static
@@ -692,9 +1058,14 @@ class DistributedExecutor(_Executor):
                        and not (neg and node.null_aware)
                        and node.residual is None)
         from .local import _note_join_strategy
+        pmap = repart_build = None
         if partitioned:
-            build_rep = self._repartitioner(fkeys)(build)
-            repart_src = self._repartitioner(skeys)
+            # one map for both sides (see _JoinNode): verdicts compose
+            # per shard under any re-balanced assignment
+            pmap = _PartitionMap(self.n)
+            repart_build = self._repartitioner(fkeys, pmap)
+            build_rep = repart_build(build)
+            repart_src = self._repartitioner(skeys, pmap)
         else:
             build_rep = self._replicate_device(build)
             repart_src = None
@@ -708,10 +1079,11 @@ class DistributedExecutor(_Executor):
             # prepare the membership table ONCE per shard (instead of
             # re-sorting the filtering side inside every probe program)
             from ..ops.join import prepare_build
-            prep = self._smap(lambda f: prepare_build(f, fkeys), 1,
-                              replicated_in=(0,) if not partitioned
-                              else (),
-                              replicated_out=not partitioned)(build_rep)
+            prep_smap = self._smap(lambda f: prepare_build(f, fkeys), 1,
+                                   replicated_in=(0,) if not partitioned
+                                   else (),
+                                   replicated_out=not partitioned)
+            prep = prep_smap(build_rep)
 
             def local(b: Batch, flt: Batch, pr) -> Batch:
                 mask = semi_join_mask(b, flt, skeys, fkeys, negated=neg,
@@ -722,9 +1094,16 @@ class DistributedExecutor(_Executor):
             fn = self._smap(local, 3,
                             replicated_in=(1, 2) if not partitioned
                             else ())
+            built_epoch = pmap.epoch if pmap is not None else 0
             for b in self.run(node.source):
                 if repart_src is not None:
                     b = repart_src(b)
+                    if pmap.epoch != built_epoch:
+                        # adaptive re-split: re-ship + re-prepare the
+                        # filtering side under the new assignment
+                        build_rep = repart_build.replay(build)
+                        prep = prep_smap(build_rep)
+                        built_epoch = pmap.epoch
                 yield fn(b, build_rep, prep)
             return
 
@@ -989,7 +1368,6 @@ class DistributedRunner:
                  rows_per_batch: int = 1 << 16):
         from ..connectors.spi import CatalogManager
         from ..connectors.tpch import TpchConnector
-        from ..planner.optimizer import optimize
         if catalogs is None:
             from ..connectors.tpcds import TpcdsConnector
             catalogs = CatalogManager()
@@ -999,18 +1377,35 @@ class DistributedRunner:
                                schema=schema)
         self.mesh = make_mesh(n_devices)
         self.rows_per_batch = rows_per_batch
-        self._optimize = optimize
         self._seq = 0
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str,
+                properties: Optional[Dict[str, object]] = None,
+                user: str = "", cancel_event=None) -> QueryResult:
+        """Run one query on the mesh. The keyword surface matches
+        ``ClusterRunner.execute``: ``properties`` overlays per-query
+        session properties — validated through the declared registry,
+        so an unknown or mistyped property fails the query instead of
+        silently doing nothing on the SPMD path — ``user`` scopes the
+        history record, and ``cancel_event`` interrupts between
+        batches. SELECTs ride the compiled-plan cache
+        (serving/plancache.py): a repeated statement skips
+        parse/plan/optimize straight onto warm shard_map executables."""
+        from ..serving.plancache import cached_plan, parse_cached
         from ..sql import ast as A
-        from ..sql.parser import parse_statement
-        from ..planner.planner import plan_query
-        stmt = parse_statement(sql)
+        stmt = parse_cached(sql)
         if not isinstance(stmt, A.Query):
             raise NotImplementedError(
                 "DistributedRunner serves queries; use LocalRunner for "
                 "session statements")
+        session = self.session
+        if properties:
+            from ..config import validate_session_property
+            overlay = {k: validate_session_property(k, v)
+                       for k, v in properties.items()}
+            session = dataclasses.replace(
+                session,
+                properties={**session.properties, **overlay})
         self._seq += 1
         qid = f"dq_{self._seq:06d}"
         import time as _time
@@ -1020,17 +1415,20 @@ class DistributedRunner:
         error: Optional[str] = None
         rows = None
         try:
-            with TRACER.span("query", query_id=qid,
+            with TRACER.span("query", query_id=qid, user=user,
                              mode="spmd", shards=self.mesh.devices.size):
                 with TRACER.span("plan"):
-                    plan = self._optimize(plan_query(stmt, self.session),
-                                          self.session)
+                    plan = cached_plan(stmt, session, user=user)
                 from .local import run_init_plans
-                ex = DistributedExecutor(self.session,
+                ex = DistributedExecutor(session,
                                          self.rows_per_batch, self.mesh)
+                ex.cancel_event = cancel_event
                 run_init_plans(ex, plan)
                 root = plan.root
-                batches = list(ex.run(root.child))
+                batches = []
+                for b in ex.run(root.child):
+                    ex._check_cancel()
+                    batches.append(b)
                 ex.check_errors()
                 with TRACER.span("device-sync", what="result-gather"):
                     rows = [r for b in batches for r in b.to_pylist()]
@@ -1044,9 +1442,10 @@ class DistributedRunner:
             # the SPMD path has no EventListenerManager; feed the
             # persistent query history directly so
             # system.runtime.completed_queries covers all three
-            # executors
+            # executors (with the caller's user for audit attribution,
+            # like the cluster path)
             HISTORY.add({
-                "query_id": qid, "query": sql.strip(), "user": "",
+                "query_id": qid, "query": sql.strip(), "user": user,
                 "state": "FAILED" if error is not None else "FINISHED",
                 "error": error, "create_time": create_time,
                 "elapsed_ms": round(

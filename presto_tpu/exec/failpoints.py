@@ -71,6 +71,9 @@ SITES: Dict[str, str] = {
                      "spooled while keeping the original checksum — "
                      "plants an on-disk corruption for the read path "
                      "to detect (exec/spool.py)",
+    "mesh.repartition": "mesh executor ships one hash-exchange batch "
+                        "over ICI (exec/distributed.py); error fails "
+                        "the query before the collective dispatches",
 }
 
 

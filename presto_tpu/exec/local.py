@@ -121,17 +121,31 @@ def execute_plan(plan: LogicalPlan, session: Session,
                  collect_rows: bool = True, cancel_event=None) -> QueryResult:
     from ..obs.profiler import profiled
     from .taskexec import GLOBAL as scheduler
-    ex = _Executor(session, rows_per_batch, stats=stats)
+    # mesh-native execution (the default with >1 device): the SPMD
+    # executor shards this plan over the device mesh whenever the
+    # auto-router (exec/distributed.select_mesh) accepts it —
+    # mesh_execution=off pins the single-device path
+    from .distributed import DistributedExecutor, select_mesh
+    mesh = select_mesh(session, plan)
+    if mesh is not None:
+        ex = DistributedExecutor(session, rows_per_batch, mesh,
+                                 stats=stats)
+        n_chips = int(mesh.devices.size)
+    else:
+        ex = _Executor(session, rows_per_batch, stats=stats)
+        n_chips = 1
     ex.cancel_event = cancel_event
     # admitted queries register under their resource group's scheduler
     # share (serving/groups.py): quanta are allotted per group by
-    # schedulingWeight, then per task within the group
+    # schedulingWeight, then per task within the group — and billed
+    # per chip, so a mesh query pays for every device it occupies
     serving = getattr(session, "serving", None)
     handle = (scheduler.task(
         name=str(id(ex)),
         group=serving.scheduler_group if serving is not None else "",
         weight=serving.weight if serving is not None else 1,
-        label=serving.group_path if serving is not None else None)
+        label=serving.group_path if serving is not None else None,
+        devices=n_chips)
         if bool_property(session, "fair_scheduling", True) else None)
     # device-time profiling: per-dispatch block_until_ready bracketing +
     # per-operator attribution (obs/profiler.py). On under the `profile`

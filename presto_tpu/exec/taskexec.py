@@ -42,6 +42,10 @@ from ..obs.trace import TRACER
 
 _DEVICE_SECONDS = REGISTRY.counter("scheduler_device_seconds_total")
 _QUANTA = REGISTRY.counter("scheduler_quanta_total")
+#: quanta weighted by the chips a task occupies: a mesh query's quantum
+#: holds EVERY chip in its mesh for the duration, so fair-share
+#: accounting (and this observable) bills per chip, not per dispatch
+_CHIP_QUANTA = REGISTRY.counter("scheduler_chip_quanta_total")
 _WAIT_SECONDS = REGISTRY.histogram("scheduler_wait_seconds")
 
 #: level thresholds in cumulative device seconds (reference
@@ -77,10 +81,14 @@ class GroupShare:
 
 class TaskHandle:
     def __init__(self, scheduler: "DeviceScheduler", name: str,
-                 share: Optional[GroupShare] = None):
+                 share: Optional[GroupShare] = None, devices: int = 1):
         self.scheduler = scheduler
         self.name = name
         self.share = share
+        #: chips this task's quanta occupy (mesh queries hold the whole
+        #: mesh per quantum): billed seconds multiply by it so a
+        #: weight-1 tenant cannot buy n chips for the price of one
+        self.devices = max(int(devices), 1)
         self.device_seconds = 0.0
         self.quanta = 0
         self.closed = False
@@ -134,7 +142,8 @@ class DeviceScheduler:
 
     def task(self, name: str = "", group: str = "",
              weight: int = 1,
-             label: Optional[str] = None) -> TaskHandle:
+             label: Optional[str] = None,
+             devices: int = 1) -> TaskHandle:
         with self._lock:
             share = self._shares.get(group)
             if share is None:
@@ -151,7 +160,7 @@ class DeviceScheduler:
                 floor = min(s.vtime for s in active)
                 if share.vtime < floor:
                     share.vtime = floor
-            h = TaskHandle(self, name, share)
+            h = TaskHandle(self, name, share, devices=devices)
             self._tasks.append(h)
             if len(self._shares) > _MAX_SHARES:
                 live = {t.share for t in self._tasks
@@ -237,8 +246,11 @@ class DeviceScheduler:
                 # the levels for compute it never dispatched
                 credit = min(handle.stall_credit, dt)
                 handle.stall_credit = 0.0
-                billed = dt - credit
+                # per-chip billing: a quantum on an n-device mesh
+                # consumed n chip-seconds of the fleet per wall second
+                billed = (dt - credit) * handle.devices
                 _DEVICE_SECONDS.inc(billed)
+                _CHIP_QUANTA.inc(handle.devices)
                 handle.device_seconds += billed
                 handle.quanta += 1
                 if handle.share is not None:

@@ -200,6 +200,14 @@ class MetricsRegistry:
                 out.append(m.state())
         return out
 
+    def value(self, name: str, default: float = 0.0) -> float:
+        """One family's scalar from the snapshot — the convenience
+        tests/benches use to watch a single counter move."""
+        for m in self.snapshot():
+            if m["name"] == name:
+                return float(m["value"])
+        return default
+
     def snapshot(self) -> List[Dict]:
         """JSON-able rows, one per scalar: histograms flatten to
         ``name.count/sum/min/max/p50/p95/p99`` — the

@@ -153,8 +153,35 @@ def repartition_by_hash_compact(batch: Batch, key_cols: Sequence[int],
     shards (host-max of ``partition_counts``); rows beyond it would be
     silently dropped. Output capacity = n_partitions * quota.
     """
-    cap = batch.capacity
     pid = hash_partition_ids(batch, key_cols, n_partitions)
+    return repartition_by_pids_compact(batch, pid, axis_name,
+                                       n_partitions, quota)
+
+
+def repartition_by_buckets_compact(batch: Batch, key_cols: Sequence[int],
+                                   axis_name: str, n_partitions: int,
+                                   assign: Sequence[int],
+                                   quota: int) -> Batch:
+    """Quota-compacted exchange through a bucket indirection: rows hash
+    into ``len(assign)`` buckets and ``assign[bucket]`` names the owning
+    shard. Equal keys always share a bucket, so colocation holds under
+    ANY assignment — which is the point: the host can re-balance hot
+    buckets between batches (adaptive re-splitting of a skewed key
+    space) without touching per-key semantics, Presto's skewed-
+    partition rebalancing reshaped for a static-shape collective."""
+    bucket = hash_partition_ids(batch, key_cols, len(assign))
+    pid = jnp.take(jnp.asarray(np.asarray(assign, dtype=np.int32)),
+                   bucket, axis=0)
+    return repartition_by_pids_compact(batch, pid, axis_name,
+                                       n_partitions, quota)
+
+
+def repartition_by_pids_compact(batch: Batch, pid: jnp.ndarray,
+                                axis_name: str, n_partitions: int,
+                                quota: int) -> Batch:
+    """The shared quota-compacted engine under the hash and bucket
+    exchanges: caller supplies per-row destination ids."""
+    cap = batch.capacity
     spid = jnp.where(batch.row_mask, pid,
                      n_partitions).astype(jnp.int32)   # dead rows last
     idx = jnp.arange(cap, dtype=jnp.int32)

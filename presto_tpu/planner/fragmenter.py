@@ -74,6 +74,79 @@ def fragment_plan(root: PlanNode) -> FragmentedPlan:
     return FragmentedPlan(fr.fragments)
 
 
+# -- mesh stages --------------------------------------------------------------
+# The same exchange-placement pass, read as an SPMD stage recipe: on a
+# device mesh a fragment is not a set of worker tasks but one shard_map
+# program per shard, and the fragment boundaries name the collectives
+# between them (partition -> all_to_all, broadcast -> all_gather,
+# single -> gather/replicated finalize). exec/distributed.py implements
+# the stages inline per operator; this pass is the *selector's* view:
+# whether a plan cuts cleanly into mesh stages (anything the fragmenter
+# cannot place cannot run SPMD) and what the stage DAG looks like, for
+# auto-routing, EXPLAIN surfaces and the profiler.
+
+@dataclasses.dataclass(frozen=True)
+class MeshStage:
+    """One SPMD stage: ``kind`` is the fragment partitioning mapped to
+    its mesh form (``scan-shard`` = data-parallel over splits, ``hash``
+    = hash-partitioned on the owning shard, ``single`` = replicated /
+    gathered finalize), ``exchange`` how its rows leave (``partition``,
+    ``broadcast``, ``single`` or None for the root)."""
+
+    id: int
+    kind: str
+    exchange: Optional[str]
+    keys: Tuple[int, ...]
+    ops: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class MeshPlan:
+    stages: List[MeshStage]
+    supported: bool
+    reason: str = ""
+
+
+_MESH_STAGE_KIND = {"source": "scan-shard", "fixed": "hash",
+                    "single": "single"}
+
+
+def _stage_ops(node: PlanNode) -> Tuple[str, ...]:
+    """Operator kinds inside one fragment, leaf-last, stopping at the
+    RemoteSourceNodes that stand in for upstream stages."""
+    out: List[str] = []
+
+    def walk(n: PlanNode) -> None:
+        if isinstance(n, RemoteSourceNode):
+            return
+        out.append(type(n).__name__.replace("Node", ""))
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    return tuple(out)
+
+
+def plan_mesh_stages(root: PlanNode) -> MeshPlan:
+    """Cut a plan into mesh stages, or say why it cannot be cut. A plan
+    the fragmenter cannot place (an operator with no exchange rule) has
+    no SPMD form and must stay on the single-device path — the mesh
+    auto-router treats ``supported=False`` as a local fallback, never
+    an error."""
+    try:
+        fragmented = fragment_plan(root)
+    except NotImplementedError as e:
+        return MeshPlan([], False, str(e))
+    stages = [
+        MeshStage(f.id, _MESH_STAGE_KIND.get(f.partitioning, "single"),
+                  f.output.kind if f.output is not None else None,
+                  tuple(f.output.keys) if f.output is not None else (),
+                  _stage_ops(f.root))
+        for f in fragmented.fragments
+    ]
+    return MeshPlan(stages, True)
+
+
 class _Fragmenter:
     def __init__(self) -> None:
         self.fragments: List[PlanFragment] = []

@@ -23,6 +23,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Mesh-native execution defaults to AUTO with >1 device (PR 12) — and
+# the 8 virtual devices above would put EVERY LocalRunner test on the
+# SPMD path, paying shard_map compiles across the whole suite. Pin the
+# harness to the single-device path; the mesh suites (test_mesh_default,
+# test_distributed*) opt back in per query via the mesh_execution
+# session property, which overrides this environment default.
+os.environ.setdefault("PRESTO_TPU_MESH_EXECUTION", "off")
+
 # Persistent XLA compile cache shared across test processes/runs: the
 # suite's wall-clock is dominated by kernel compiles (lax.sort at 2^17
 # costs tens of seconds per variant on XLA:CPU), and the same shapes

@@ -21,7 +21,13 @@ are lower-is-better — the gate inverts their ratio automatically.
 ``--kind elastic`` gates the chaos recovery-time axis the same way
 (``ELASTIC_r*.json``, written by ``python tools/chaos_smoke.py
 --elastic-out``): per-scenario recovery milliseconds, all
-lower-is-better.
+lower-is-better. ``--kind multichip`` gates the mesh-scaling axis
+(``MULTICHIP_r*.json``, written by ``MULTICHIP_OUT=path python
+bench.py multichip``): per-query rows/s at each device count plus
+scaling efficiency, all higher-is-better; rounds up to r05 pinned only
+a dry-run exit code (the ``ok`` bool, kept in the summary for
+back-compat) and are not comparable — the gate always discovers the
+LATEST round, so they age out naturally.
 
 Usage:
     python tools/check_bench_regression.py --run bench_out.json
@@ -247,17 +253,25 @@ def main(argv=None) -> int:
                     help="self-consistency mode (no engine run): "
                          "baseline-vs-itself must pass, a degraded "
                          "copy must fail")
-    ap.add_argument("--kind", choices=("bench", "serving", "elastic"),
+    ap.add_argument("--kind",
+                    choices=("bench", "serving", "elastic", "multichip"),
                     default="bench",
                     help="which pinned trajectory to gate: per-query "
                          "BENCH_r*.json (default), the concurrent-"
-                         "throughput SERVING_r*.json, or the chaos "
+                         "throughput SERVING_r*.json, the chaos "
                          "recovery-time ELASTIC_r*.json "
-                         "(tools/chaos_smoke.py --elastic-out)")
+                         "(tools/chaos_smoke.py --elastic-out), or the "
+                         "mesh-scaling MULTICHIP_r*.json "
+                         "(MULTICHIP_OUT=path python bench.py "
+                         "multichip; rows/s and scaling-efficiency "
+                         "metrics are higher-is-better, and the "
+                         "legacy dry-run 'ok' bool rides along "
+                         "untouched)")
     args = ap.parse_args(argv)
 
     prefix = {"serving": "SERVING",
-              "elastic": "ELASTIC"}.get(args.kind, "BENCH")
+              "elastic": "ELASTIC",
+              "multichip": "MULTICHIP"}.get(args.kind, "BENCH")
     baseline_path = args.baseline or latest_bench_file(prefix=prefix)
     if baseline_path is None or not os.path.exists(baseline_path):
         print(json.dumps({"verdict": "error",

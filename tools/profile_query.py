@@ -89,29 +89,56 @@ def _node_rows(plan, stats):
     return rows
 
 
-def profile_query(runner, sql: str, warm_runs: int = 1) -> dict:
+def profile_query(runner, sql: str, warm_runs: int = 1,
+                  mesh: "int | None" = None) -> dict:
     """One profiled execution (after ``warm_runs`` untimed warmups) ->
-    the JSON document. Importable for tests."""
+    the JSON document. Importable for tests. ``mesh`` (device count,
+    0 = all) runs the query on the SPMD mesh path: per-operator device
+    time then also attributes **per shard** (the profiled bracket times
+    the whole mesh dispatch, so one shard's share is time/n on a
+    balanced stage), and the document gains the fragmenter's mesh-stage
+    recipe plus whether the auto-router actually selected the mesh."""
     from presto_tpu.exec.local import execute_plan
     from presto_tpu.exec.stats import StatsCollector
+    from presto_tpu.obs.metrics import REGISTRY
     from presto_tpu.obs.profiler import cost_verdict
 
-    plan = runner.plan(sql)
+    n_mesh = None
     session = runner.session
+    if mesh is not None:
+        import dataclasses as _dc
+
+        from presto_tpu.exec.distributed import mesh_device_count
+        # per-call overlay, never the shared session: a later
+        # profile_query on the same runner must not silently inherit
+        # this call's mesh routing
+        session = _dc.replace(
+            session,
+            properties={**session.properties,
+                        "mesh_execution": "auto",
+                        "mesh_devices": int(mesh)})
+        n_mesh = mesh_device_count(session)
+
+    def selected() -> float:
+        return REGISTRY.value("mesh_path_selected_total")
+
+    plan = runner.plan(sql)
     for _ in range(max(warm_runs, 0)):
         execute_plan(plan, session, runner.rows_per_batch,
                      collect_rows=False)
+    sel0 = selected()
     stats = StatsCollector(count_rows=True)
     t0 = time.perf_counter()
     execute_plan(plan, session, runner.rows_per_batch, stats=stats,
                  collect_rows=False)
     stats.total_wall_s = time.perf_counter() - t0
     verdict = cost_verdict(stats)
-    return {
+    operators = _node_rows(plan, stats)
+    doc = {
         "sql": " ".join(sql.split()),
         "wall_s": round(stats.total_wall_s, 6),
         "backend": _backend(),
-        "operators": _node_rows(plan, stats),
+        "operators": operators,
         "executables": [
             {k: e[k] for k in ("name", "invocations", "device_time_s",
                                "compile_seconds", "flops",
@@ -119,6 +146,24 @@ def profile_query(runner, sql: str, warm_runs: int = 1) -> dict:
             for e in stats.executables_used()],
         "cost_verdict": verdict,
     }
+    if mesh is not None:
+        on_mesh = selected() > sel0
+        if on_mesh and n_mesh:
+            for row in operators:
+                if "device_time_s" in row:
+                    row["device_time_per_shard_s"] = round(
+                        row["device_time_s"] / n_mesh, 6)
+        from presto_tpu.planner.fragmenter import plan_mesh_stages
+        mp = plan_mesh_stages(plan.root)
+        doc["mesh"] = {
+            "n_devices": n_mesh,
+            "selected": on_mesh,
+            "supported": mp.supported,
+            "stages": [{"id": s.id, "kind": s.kind,
+                        "exchange": s.exchange, "keys": list(s.keys),
+                        "ops": list(s.ops)} for s in mp.stages],
+        }
+    return doc
 
 
 def _backend() -> str:
@@ -142,6 +187,14 @@ def main(argv=None) -> int:
     ap.add_argument("--cold", action="store_true",
                     help="profile the FIRST run (includes compile + "
                          "staging) instead of a warmed run")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="profile on an N-device mesh (0 = every "
+                         "visible device): per-operator device time "
+                         "also attributes per shard, and the document "
+                         "gains the mesh-stage recipe. Needs N visible "
+                         "devices (set XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=N "
+                         "for a virtual CPU mesh)")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="also write the JSON here (temp+rename)")
     args = ap.parse_args(argv)
@@ -172,7 +225,8 @@ def main(argv=None) -> int:
                          rows_per_batch=args.rows_per_batch)
 
     doc = profile_query(runner, sql,
-                        warm_runs=0 if args.cold else 1)
+                        warm_runs=0 if args.cold else 1,
+                        mesh=args.mesh)
     doc["sf"] = args.sf
     text = json.dumps(doc, indent=2, default=str)
     print(text)
