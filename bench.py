@@ -979,12 +979,66 @@ def _serving_mix(n: int):
     return out
 
 
+#: the EXECUTE-fleet mix: two prepared shapes, every client binding its
+#: own parameters — the parameter-generic template cache's steady state
+#: (one plan + one warm executable set across ALL bindings; each bound
+#: fingerprint is distinct, so the result cache stays out of the way)
+_SERVING_PREPARES = [
+    ("dash_q", "select count(*), sum(l_extendedprice) from lineitem "
+               "where l_quantity > ?"),
+    ("dash_p", "select o_orderpriority, count(*) from orders "
+               "where o_totalprice > ? group by o_orderpriority "
+               "order by o_orderpriority"),
+]
+
+
+def _execute_fleet_mix(n: int):
+    out = []
+    for i in range(n):
+        if i % 2 == 0:
+            out.append(f"execute dash_q using {1 + i % 47}")
+        else:
+            out.append(f"execute dash_p using {100 * (1 + i % 97)}")
+    return out
+
+
+def _repeated_mix(n: int):
+    """The standing-query mix: the SAME four statements over and over
+    (dashboard refresh) — after the first executions every request is a
+    result-cache hit served from stored host rows."""
+    fixed = [_SERVING_STATEMENTS[j].format(q=10, d=1, p=1000, n=5)
+             for j in range(len(_SERVING_STATEMENTS))]
+    return [fixed[i % len(fixed)] for i in range(n)]
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(p * len(sorted_vals)),
+                           len(sorted_vals) - 1)]
+
+
 def bench_serving(sf: float = 0.01, clients: int = 16,
-                  per_client: int = 8):
-    """Queries/sec + latency percentiles at ``clients`` concurrent
-    protocol clients of mixed repeated statements, plus the cold/warm
-    repeated-statement split (cold pays parse+plan+optimize+compile;
-    warm rides the plan cache onto already-compiled executables)."""
+                  per_client: int = 8, mixes=("mixed", "execute",
+                                              "repeated")):
+    """Queries/sec + latency percentiles (overall AND per resource
+    group) at ``clients`` concurrent protocol clients, across three
+    workload phases:
+
+    - **mixed** (the headline, metric-compatible with SERVING_r01): the
+      dashboard statement mix, now served by the full cache stack
+      (plan cache + plan templates + result cache);
+    - **execute**: the EXECUTE fleet — two prepared statements, every
+      client binding its own parameters; measures the parameter-generic
+      template cache (hit rate = dep-valid template found minus guard
+      fallbacks, over all lookups);
+    - **repeated**: the standing-query mix (identical statements over
+      and over); measures the versioned result cache.
+
+    Plus the cold/warm probe split (cold pays
+    parse+plan+optimize+compile; warm rides the caches).
+    ``SERVING_CLIENTS`` / ``SERVING_QUERIES`` / ``SERVING_MIX`` (comma
+    list of phases) make re-pins reproducible at any scale."""
     import threading
 
     from presto_tpu.client import StatementClient
@@ -996,6 +1050,13 @@ def bench_serving(sf: float = 0.01, clients: int = 16,
     catalogs = CatalogManager()
     catalogs.register("tpch", _shared_tpch(sf))
     runner = LocalRunner(catalogs=catalogs, rows_per_batch=1 << 17)
+    # the serving stack under test: parameter-generic templates +
+    # versioned result cache on top of the PR 8 plan cache. The mesh
+    # auto-router (PR 11) stays at its default — with >1 visible device
+    # cold executions shard over the mesh; the summary records whether
+    # it engaged.
+    runner.session.properties.update({"plan_template_cache": True,
+                                      "result_cache": True})
     srv = PrestoTpuServer(runner, resource_groups={
         "rootGroups": [
             {"name": "serving", "hardConcurrencyLimit": 8,
@@ -1014,88 +1075,168 @@ def bench_serving(sf: float = 0.01, clients: int = 16,
 
         # cold: first-ever execution pays parse+plan+optimize+jit
         # compile; warm (after the traffic phase): fingerprint hit in
-        # the plan cache + warm executables
+        # the caches + warm executables
         c = StatementClient(base, user="bench")
         t0 = time.perf_counter()
         cold_rows = c.execute(probe).rows
         cold_s = time.perf_counter() - t0
 
-        statements = _serving_mix(clients * per_client)
-        # warmup: one pass over the distinct shapes so the timed phase
-        # measures steady-state serving, not first-compile
-        warm_shapes = sorted(set(statements))
-        for s in warm_shapes:
-            c.execute(s)
+        for name, sql in _SERVING_PREPARES:
+            c.execute(f"prepare {name} from {sql}")
+
+        _FAMS = ("plan_cache_", "plan_template_cache_", "result_cache_",
+                 "scan_shared_attach_total", "mesh_path_selected_total")
 
         def snap():
             return {m["name"]: m["value"] for m in REGISTRY.snapshot()
-                    if m["name"].startswith("plan_cache_")}
+                    if m["name"].startswith(_FAMS)}
 
-        before = snap()
-        latencies = []
-        lat_lock = threading.Lock()
-        errors = []
+        def run_phase(statements):
+            """One concurrent phase; returns (overall latencies,
+            per-group latencies, wall seconds, metric deltas)."""
+            # warmup: one pass over the distinct statements so the
+            # timed phase measures steady-state serving, not
+            # first-compile
+            for s in sorted(set(statements)):
+                c.execute(s)
+            before = snap()
+            latencies = []
+            by_group = {"dash": [], "adhoc": []}
+            lat_lock = threading.Lock()
+            errors = []
 
-        def client_loop(ci: int) -> None:
-            user = f"dash-{ci}" if ci % 2 == 0 else f"adhoc-{ci}"
-            cl = StatementClient(base, user=user)
-            try:
-                for qi in range(per_client):
-                    sql = statements[(ci * per_client + qi)
-                                     % len(statements)]
-                    t = time.perf_counter()
-                    cl.execute(sql)
-                    dt = time.perf_counter() - t
-                    with lat_lock:
-                        latencies.append(dt)
-            except Exception as e:   # surfaced in the summary, not lost
-                errors.append(f"client {ci}: {e}")
+            def client_loop(ci: int) -> None:
+                group = "dash" if ci % 2 == 0 else "adhoc"
+                cl = StatementClient(base, user=f"{group}-{ci}")
+                try:
+                    for qi in range(per_client):
+                        sql = statements[(ci * per_client + qi)
+                                         % len(statements)]
+                        t = time.perf_counter()
+                        cl.execute(sql)
+                        dt = time.perf_counter() - t
+                        with lat_lock:
+                            latencies.append(dt)
+                            by_group[group].append(dt)
+                except Exception as e:   # surfaced, not lost
+                    errors.append(f"client {ci}: {e}")
 
-        threads = [threading.Thread(target=client_loop, args=(i,))
-                   for i in range(clients)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall_s = time.perf_counter() - t0
-        after = snap()
-        assert not errors, errors
+            threads = [threading.Thread(target=client_loop, args=(i,))
+                       for i in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_s = time.perf_counter() - t0
+            assert not errors, errors
+            after = snap()
+            delta = {k: after.get(k, 0.0) - before.get(k, 0.0)
+                     for k in after}
+            latencies.sort()
+            for v in by_group.values():
+                v.sort()
+            return latencies, by_group, wall_s, delta
+
+        n = clients * per_client
+        known = ("mixed", "execute", "repeated")
+        bad = [m for m in mixes if m not in known]
+        if bad or not mixes:
+            raise ValueError(
+                f"SERVING_MIX: unknown phase(s) {bad or mixes} — "
+                f"choose from {', '.join(known)}")
+        phases = {}
+        if "mixed" in mixes:
+            phases["mixed"] = run_phase(_serving_mix(n))
+        if "execute" in mixes:
+            phases["execute"] = run_phase(_execute_fleet_mix(n))
+        if "repeated" in mixes:
+            phases["repeated"] = run_phase(_repeated_mix(n))
 
         t0 = time.perf_counter()
         warm_rows = c.execute(probe).rows
         warm_s = time.perf_counter() - t0
         assert warm_rows == cold_rows, "warm re-run changed results"
 
-        latencies.sort()
+        def rate(d, fam, extra_miss=0.0):
+            hits = d.get(f"{fam}_hit_total", 0.0)
+            misses = d.get(f"{fam}_miss_total", 0.0) + extra_miss
+            return hits / max(hits + misses, 1.0)
 
-        def pct(p):
-            return latencies[min(int(p * len(latencies)),
-                                 len(latencies) - 1)]
-        hits = after.get("plan_cache_hit_total", 0.0) \
-            - before.get("plan_cache_hit_total", 0.0)
-        misses = after.get("plan_cache_miss_total", 0.0) \
-            - before.get("plan_cache_miss_total", 0.0)
-        hit_rate = hits / max(hits + misses, 1.0)
-        return {
+        lat, groups, wall_s, delta = phases.get(
+            "mixed", next(iter(phases.values())))
+        qps = round(len(lat) / wall_s, 2)
+        summary = {
             "metric": f"serving_tpch_sf{sf:g}_qps",
-            "value": round(len(latencies) / wall_s, 2),
+            "value": qps,
             "unit": "queries/s",
             "clients": clients,
-            "queries": len(latencies),
-            "p50_ms": round(pct(0.50) * 1e3, 2),
-            "p95_ms": round(pct(0.95) * 1e3, 2),
-            "plan_cache_hit_rate": round(hit_rate, 4),
+            "queries": len(lat),
+            "p50_ms": round(_pct(lat, 0.50) * 1e3, 2),
+            "p95_ms": round(_pct(lat, 0.95) * 1e3, 2),
+            "p99_ms": round(_pct(lat, 0.99) * 1e3, 2),
+            "groups": {
+                g: {"queries": len(v),
+                    "p50_ms": round(_pct(v, 0.50) * 1e3, 2),
+                    "p95_ms": round(_pct(v, 0.95) * 1e3, 2),
+                    "p99_ms": round(_pct(v, 0.99) * 1e3, 2)}
+                for g, v in groups.items()},
+            "plan_cache_hit_rate": round(rate(delta, "plan_cache"), 4),
+            "result_cache_hit_rate": round(
+                rate(delta, "result_cache"), 4),
+            "shared_scan_attaches": int(
+                delta.get("scan_shared_attach_total", 0.0)),
+            "mesh_path_selected": int(
+                delta.get("mesh_path_selected_total", 0.0)),
             "cold_ms": round(cold_s * 1e3, 2),
             "warm_ms": round(warm_s * 1e3, 2),
             "warm_speedup": round(cold_s / warm_s, 2),
             "sub_metrics": [
                 {"metric": f"serving_tpch_sf{sf:g}_p95_latency_ms",
-                 "value": round(pct(0.95) * 1e3, 2), "unit": "ms"},
+                 "value": round(_pct(lat, 0.95) * 1e3, 2), "unit": "ms"},
                 {"metric": f"serving_tpch_sf{sf:g}_warm_speedup",
                  "value": round(cold_s / warm_s, 2), "unit": "x"},
+                {"metric": f"serving_tpch_sf{sf:g}_dash_p99_ms",
+                 "value": round(_pct(groups["dash"], 0.99) * 1e3, 2),
+                 "unit": "ms"},
+                {"metric": f"serving_tpch_sf{sf:g}_adhoc_p99_ms",
+                 "value": round(_pct(groups["adhoc"], 0.99) * 1e3, 2),
+                 "unit": "ms"},
             ],
         }
+        if "execute" in phases:
+            elat, egroups, ewall, edelta = phases["execute"]
+            tpl_hits = edelta.get("plan_template_cache_hit_total", 0.0)
+            tpl_miss = edelta.get("plan_template_cache_miss_total", 0.0)
+            tpl_fb = edelta.get(
+                "plan_template_cache_guard_fallback_total", 0.0)
+            tpl_rate = (tpl_hits - tpl_fb) / max(tpl_hits + tpl_miss,
+                                                 1.0)
+            summary["sub_metrics"] += [
+                {"metric": f"serving_tpch_sf{sf:g}_execute_qps",
+                 "value": round(len(elat) / ewall, 2),
+                 "unit": "queries/s",
+                 "p95_ms": round(_pct(elat, 0.95) * 1e3, 2),
+                 "p99_ms": round(_pct(elat, 0.99) * 1e3, 2)},
+                {"metric": f"serving_tpch_sf{sf:g}_template_hit_rate",
+                 "value": round(tpl_rate, 4), "unit": "ratio",
+                 "guard_fallbacks": int(tpl_fb)},
+            ]
+        if "repeated" in phases:
+            rlat, rgroups, rwall, rdelta = phases["repeated"]
+            summary["sub_metrics"] += [
+                {"metric": f"serving_tpch_sf{sf:g}_repeated_qps",
+                 "value": round(len(rlat) / rwall, 2),
+                 "unit": "queries/s",
+                 "p95_ms": round(_pct(rlat, 0.95) * 1e3, 2),
+                 "p99_ms": round(_pct(rlat, 0.99) * 1e3, 2)},
+                {"metric": f"serving_tpch_sf{sf:g}_result_hit_rate",
+                 "value": round(rate(rdelta, "result_cache"), 4),
+                 "unit": "ratio",
+                 "partials": int(rdelta.get(
+                     "result_cache_partial_total", 0.0))},
+            ]
+        return summary
     finally:
         srv.stop()
 
@@ -1104,9 +1245,18 @@ def main_serving() -> None:
     import sys
     _enable_compile_cache()
     sf = float(os.environ.get("BENCH_SERVING_SF", "0.01"))
-    clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "16"))
-    per_client = int(os.environ.get("BENCH_SERVING_QUERIES", "8"))
-    summary = bench_serving(sf, clients, per_client)
+    # SERVING_CLIENTS/SERVING_QUERIES are the documented knobs;
+    # BENCH_SERVING_* kept for back-compat with r01 runbooks
+    clients = int(os.environ.get(
+        "SERVING_CLIENTS", os.environ.get("BENCH_SERVING_CLIENTS",
+                                          "100")))
+    per_client = int(os.environ.get(
+        "SERVING_QUERIES", os.environ.get("BENCH_SERVING_QUERIES",
+                                          "8")))
+    mixes = tuple(m.strip() for m in os.environ.get(
+        "SERVING_MIX", "mixed,execute,repeated").split(",")
+        if m.strip())
+    summary = bench_serving(sf, clients, per_client, mixes=mixes)
     line = json.dumps(summary)
     print(line, flush=True)
     out_path = os.environ.get("SERVING_OUT")

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -42,10 +43,41 @@ class StatementClient:
         self.timeout = timeout
         self.password = password
         self.session_properties: Dict[str, str] = {}
+        # persistent keep-alive connection (the server speaks
+        # HTTP/1.1): a serving fleet issuing thousands of short
+        # statements must not pay a TCP handshake per request — at 100
+        # concurrent clients the fresh-connection storm overflows
+        # listen backlogs and the SYN retransmits quantize cache-hit
+        # latencies to whole seconds. One connection per client
+        # instance; clients are thread-confined like the reference's.
+        self._conn = None
+        self._conn_netloc: Optional[str] = None
 
     # -- protocol ------------------------------------------------------------
-    def _request(self, url: str, method: str = "GET",
-                 body: Optional[bytes] = None):
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+                self._conn_netloc = None
+
+    def _connection(self, netloc: str):
+        import http.client
+        if self._conn is None or self._conn_netloc != netloc:
+            self.close()
+            self._conn = http.client.HTTPConnection(
+                netloc, timeout=self.timeout)
+            self._conn_netloc = netloc
+        return self._conn
+
+    def _headers(self) -> Dict[str, str]:
+        """Request headers; the static part builds once per client and
+        the session overlay re-renders only when it changed (a serving
+        client issues thousands of identical-header requests)."""
+        cached = getattr(self, "_hdr_cache", None)
+        if cached is not None and cached[0] == self.session_properties:
+            return cached[1]
         headers = {"X-Presto-User": self.user}
         if self.password is not None:
             import base64
@@ -60,17 +92,49 @@ class StatementClient:
             headers["X-Presto-Session"] = ",".join(
                 f"{k}={urllib.parse.quote(str(v))}"
                 for k, v in self.session_properties.items())
-        req = urllib.request.Request(url, data=body, method=method,
-                                     headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            doc = json.loads(resp.read() or b"{}")
-            for header, value in resp.headers.items():
-                if header == "X-Presto-Set-Session" and "=" in value:
-                    k, v = value.split("=", 1)
-                    self.session_properties[k.strip()] = v.strip()
-                elif header == "X-Presto-Clear-Session":
-                    self.session_properties.pop(value.strip(), None)
-            return doc
+        self._hdr_cache = (dict(self.session_properties), headers)
+        return headers
+
+    def _request(self, url: str, method: str = "GET",
+                 body: Optional[bytes] = None):
+        import http.client
+        headers = self._headers()
+        parts = urllib.parse.urlsplit(url)
+        path = parts.path + (f"?{parts.query}" if parts.query else "")
+        resp = data = None
+        for attempt in (0, 1):
+            conn = self._connection(parts.netloc)
+            sent = False
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except (http.client.HTTPException, OSError):
+                # server closed the idle keep-alive (or first use of a
+                # stale connection): reconnect once, then surface. A
+                # non-idempotent request that FAILED AFTER SENDING is
+                # never replayed — the server may have executed it
+                # (POST /v1/statement runs INSERTs); the caller sees
+                # the transport error instead of silent double writes.
+                self.close()
+                if attempt or (sent and method != "GET"):
+                    raise
+        if resp.status >= 400:
+            # urllib-compatible error surface for callers that catch
+            # HTTPError (drain 503s, auth 401s)
+            import io
+            raise urllib.error.HTTPError(url, resp.status, resp.reason,
+                                         resp.headers, io.BytesIO(data))
+        doc = json.loads(data or b"{}")
+        for header, value in resp.headers.items():
+            if header == "X-Presto-Set-Session" and "=" in value:
+                k, v = value.split("=", 1)
+                self.session_properties[k.strip()] = v.strip()
+            elif header == "X-Presto-Clear-Session":
+                self.session_properties.pop(value.strip(), None)
+        return doc
 
     def pages(self, sql: str) -> Iterator[Dict]:
         """Yield raw QueryResults documents until the query drains."""

@@ -133,6 +133,12 @@ _sp("mesh_execution", "varchar", "auto",
 _sp("mesh_devices", "integer", 0,
     "devices in the execution mesh (0 = every visible device); 1 "
     "behaves like mesh_execution=off under auto")
+_sp("plan_template_cache", "boolean", False,
+    "fingerprint the PARAMETERIZED statement shape (literals "
+    "hole-punched) so a fleet of bindings shares one optimized plan + "
+    "one warm executable set; optimizer decisions that consulted a "
+    "literal record equality guards and fall back to per-binding "
+    "fingerprints when a binding flips them (serving/template.py)")
 _sp("plan_cache", "boolean", True,
     "serve repeated statements from the compiled-plan cache "
     "(fingerprinted bound AST; skips parse/plan/optimize)")
@@ -154,6 +160,11 @@ _sp("query_queued_timeout", "duration", None,
     _valid_duration)
 _sp("query_retry_attempts", "integer", 1,
     "whole-query re-runs under retry_policy=QUERY")
+_sp("result_cache", "boolean", False,
+    "serve repeated statements from the versioned result cache "
+    "(serving/resultcache.py): stored host rows when every scanned "
+    "table's data_version matches, changed-split delta recompute + "
+    "distributive merge when a filebase table grew append-only")
 _sp("retry_policy", "varchar", "TASK",
     "fault-tolerance mode: TASK, QUERY or NONE", _valid_retry_policy)
 _sp("role", "varchar", None,
@@ -274,6 +285,9 @@ CONFIG_KEYS: Dict[str, str] = {
                  "SESSION_PROPERTIES at boot)",
     "scan-cache.max-bytes": "process-wide device scan-cache resident "
                             "limit (deliberately not a session prop)",
+    "result-cache.max-bytes": "process-wide result-cache host-row "
+                              "budget (serving/resultcache.py; "
+                              "deliberately not a session prop)",
     "spool.dir": "exchange spool directory (exec/spool.py); point "
                  "every node at shared storage for cross-node replay",
     "spool.max-bytes": "spool disk budget; appends past it fail the "
@@ -412,6 +426,10 @@ class NodeConfig:
         #: (exec/scancache.py); None keeps the built-in default
         raw_sc = props.get("scan-cache.max-bytes")
         self.scan_cache_bytes = int(raw_sc) if raw_sc else None
+        #: process-wide result-cache host-row budget
+        #: (serving/resultcache.py); None keeps the built-in default
+        raw_rc = props.get("result-cache.max-bytes")
+        self.result_cache_bytes = int(raw_rc) if raw_rc else None
         #: exchange-spool backend config (exec/spool.py SPOOL)
         self.spool_dir = props.get("spool.dir")
         raw_sp = props.get("spool.max-bytes")
@@ -463,6 +481,9 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
     if cfg.scan_cache_bytes is not None:
         from .exec.scancache import CACHE
         CACHE.set_limit(cfg.scan_cache_bytes)
+    if cfg.result_cache_bytes is not None:
+        from .serving.resultcache import RESULTS
+        RESULTS.set_limit(cfg.result_cache_bytes)
     if cfg.spool_dir or cfg.spool_max_bytes is not None:
         from .exec.spool import SPOOL
         SPOOL.configure(directory=cfg.spool_dir,

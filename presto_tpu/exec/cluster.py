@@ -1237,12 +1237,43 @@ class ClusterRunner:
             return self._explain_analyze(stmt.statement, sql,
                                          session=session, user=user,
                                          cancel_event=cancel_event)
-        plan = cached_plan(stmt, session, user=user,
-                           secured=secured or self.local.roles.enforce)
+        from ..planner.planner import bool_property
+        sec = secured or self.local.roles.enforce
+        use_template = bool_property(session, "plan_template_cache",
+                                     False)
+        use_results = bool_property(session, "result_cache", False)
+        bindings = bound_key = None
+        if use_template:
+            from ..serving.template import template_plan
+            plan, bindings, bound_key = template_plan(
+                stmt, session, user=user, secured=sec)
+        else:
+            plan = cached_plan(stmt, session, user=user, secured=sec)
         if secured:
             self.local._check_catalog_access(plan, user)
         if self.local.roles.enforce:
             self.local._check_select_privileges(plan, user)
+        if bindings:
+            # remote fragments ship over the codec and trace literals
+            # as constants — materialize this query's bindings (the
+            # coordinator still skipped parse/plan/optimize on the hit)
+            from ..expr.params import bind_plan
+            plan = bind_plan(plan, bindings)
+        rc_token = None
+        if use_results:
+            # the SAME begin/commit contract as LocalRunner: keying,
+            # pre-execution dep/epoch stamps, and the mid-run write
+            # veto must agree across execution modes
+            from ..serving import resultcache as RC
+            from ..serving.plancache import bound_fingerprint
+            if bound_key is None:
+                bound_key = bound_fingerprint(stmt, session, user=user,
+                                              secured=sec)
+            served, rc_token = RC.begin(
+                bound_key, plan, session, self.rows_per_batch,
+                cancel_event=cancel_event)
+            if served is not None:
+                return served
         # init plans (uncorrelated scalar subqueries) run on the
         # coordinator; their values ship inside every task update
         from .local import run_init_plans, _Executor
@@ -1250,10 +1281,14 @@ class ClusterRunner:
         run_init_plans(ex, plan)
         init_values = ex.init_values
         fragmented = fragment_plan(plan.root)
-        return self._run_fragments(fragmented, init_values, sql,
-                                   session=session,
-                                   cancel_event=cancel_event,
-                                   user=user)
+        out = self._run_fragments(fragmented, init_values, sql,
+                                  session=session,
+                                  cancel_event=cancel_event,
+                                  user=user)
+        if rc_token is not None:
+            from ..serving import resultcache as RC
+            RC.commit(rc_token, session, out)
+        return out
 
     def _explain_analyze(self, query_stmt, sql: str, session=None,
                          user: str = "",

@@ -118,15 +118,27 @@ def run_init_plans(ex, plan: LogicalPlan) -> None:
 
 def execute_plan(plan: LogicalPlan, session: Session,
                  rows_per_batch: int = 1 << 17, stats=None,
-                 collect_rows: bool = True, cancel_event=None) -> QueryResult:
+                 collect_rows: bool = True, cancel_event=None,
+                 split_restrict=None) -> QueryResult:
+    from ..expr import params as P
     from ..obs.profiler import profiled
     from .taskexec import GLOBAL as scheduler
     # mesh-native execution (the default with >1 device): the SPMD
     # executor shards this plan over the device mesh whenever the
     # auto-router (exec/distributed.select_mesh) accepts it —
-    # mesh_execution=off pins the single-device path
+    # mesh_execution=off pins the single-device path. Split-restricted
+    # runs (result-cache incremental delta) stay single-device: the
+    # restriction applies at the local scan node.
     from .distributed import DistributedExecutor, select_mesh
-    mesh = select_mesh(session, plan)
+    bindings = getattr(session, "param_bindings", None)
+    mesh = select_mesh(session, plan) if split_restrict is None else None
+    if mesh is not None and bindings:
+        # SPMD shard programs trace expressions inside their own jits
+        # where a Param has no operand channel — materialize this
+        # query's bindings into literals (correctness over executable
+        # sharing; the cached template itself is never mutated)
+        plan = P.bind_plan(plan, bindings)
+        bindings = None
     if mesh is not None:
         ex = DistributedExecutor(session, rows_per_batch, mesh,
                                  stats=stats)
@@ -135,6 +147,7 @@ def execute_plan(plan: LogicalPlan, session: Session,
         ex = _Executor(session, rows_per_batch, stats=stats)
         n_chips = 1
     ex.cancel_event = cancel_event
+    ex.split_restrict = split_restrict
     # admitted queries register under their resource group's scheduler
     # share (serving/groups.py): quanta are allotted per group by
     # schedulingWeight, then per task within the group — and billed
@@ -156,7 +169,11 @@ def execute_plan(plan: LogicalPlan, session: Session,
                   or (stats is not None
                       and getattr(stats, "count_rows", False)))
     try:
-        with profiled(profile_on):
+        # template bindings: ir.Param kernels fetch this query's
+        # literal values from the scope (exchange driver threads copy
+        # their spawn context, so the scope survives the q3-style
+        # background pipelines)
+        with P.bound(bindings), profiled(profile_on):
             run_init_plans(ex, plan)
             root = plan.root
             rows: List[tuple] = []
@@ -325,6 +342,10 @@ class _Executor:
         # set by execute_plan: a threading.Event checked per scan batch
         # so a DELETE-cancel interrupts a query mid-drain
         self.cancel_event = None
+        # result-cache incremental delta: {(catalog, table): predicate
+        # over Split} restricting a scan to the changed splits only
+        # (serving/resultcache.py); None = scan everything
+        self.split_restrict = None
         # device int32 scalars from error-checking kernels; reduced to one
         # host sync by check_errors() after the plan drains
         self.error_flags: List = []
@@ -521,6 +542,12 @@ class _Executor:
         if lifespan is not None:
             # grouped execution: only this bucket's splits this pass
             splits = lifespan
+        restrict = getattr(self, "split_restrict", None)
+        if restrict is not None:
+            pred = restrict.get((node.catalog, node.table.table))
+            if pred is not None:
+                # result-cache delta run: only the changed splits
+                splits = [s for s in splits if pred(s)]
         import time as _time
         t_query0 = _time.perf_counter()
 
@@ -1017,6 +1044,16 @@ class _Executor:
             else:
                 break
         if njoins < 2:
+            return None
+        from ..expr.params import has_params
+        if any(has_params(getattr(n, "predicate", None))
+               or has_params(getattr(n, "exprs", None))
+               for n in nodes):
+            # plan-template parameters: the fused head/tail programs
+            # trace expressions inside their own jits with no operand
+            # channel for runtime bindings — run the generic
+            # per-operator path (compile_filter/compile_projection
+            # carry the bindings there)
             return None
         return self._run_fused_chain(nodes, cur)
 

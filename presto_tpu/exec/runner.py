@@ -260,12 +260,27 @@ class LocalRunner:
             # repeated-statement fast path, step 2: a fingerprint hit in
             # the compiled-plan cache (serving/plancache.py) skips
             # plan_query + optimize entirely — the plan's jitted
-            # executables are already warm in ops/jitcache
-            from ..serving.plancache import cached_plan
+            # executables are already warm in ops/jitcache. Under
+            # plan_template_cache the fingerprint is PARAMETER-GENERIC
+            # (serving/template.py): the statement's literals
+            # hole-punch out of the key and bind at execution as traced
+            # scalars, so an EXECUTE fleet shares one plan + one warm
+            # executable set across bindings.
+            from ..planner.planner import bool_property
+            from ..serving.plancache import bound_fingerprint, cached_plan
+            sec = secured or self.roles.enforce
+            use_template = bool_property(session, "plan_template_cache",
+                                         False)
+            use_results = bool_property(session, "result_cache", False)
+            bindings = bound_key = None
             with TRACER.span("plan"):
-                plan = cached_plan(
-                    stmt, session, user=user,
-                    secured=secured or self.roles.enforce)
+                if use_template:
+                    from ..serving.template import template_plan
+                    plan, bindings, bound_key = template_plan(
+                        stmt, session, user=user, secured=sec)
+                else:
+                    plan = cached_plan(stmt, session, user=user,
+                                       secured=sec)
             if secured:
                 # a cache hit skips planning — where SecuredCatalogs
                 # enforces — so re-check catalog access on the plan's
@@ -273,10 +288,32 @@ class LocalRunner:
                 self._check_catalog_access(plan, user)
             if self.roles.enforce:
                 self._check_select_privileges(plan, user)
+            if bindings is not None:
+                # per-query overlay: the executor opens the binding
+                # scope from this field (never mutate the shared plan)
+                session = _dc.replace(session, param_bindings=bindings)
+            rc_token = None
             try:
-                return execute_plan(plan, session, self.rows_per_batch,
-                                    stats=stats,
-                                    cancel_event=cancel_event)
+                if use_results:
+                    from ..serving import resultcache as RC
+                    if bound_key is None:
+                        bound_key = bound_fingerprint(
+                            stmt, session, user=user, secured=sec)
+                    # deps + epoch stamp BEFORE running: a write
+                    # landing mid-execution vetoes the insert (the
+                    # plan-cache TOCTOU contract)
+                    served, rc_token = RC.begin(
+                        bound_key, plan, session, self.rows_per_batch,
+                        cancel_event=cancel_event, stats=stats)
+                    if served is not None:
+                        return served
+                out = execute_plan(plan, session, self.rows_per_batch,
+                                   stats=stats,
+                                   cancel_event=cancel_event)
+                if rc_token is not None:
+                    from ..serving import resultcache as RC
+                    RC.commit(rc_token, session, out)
+                return out
             finally:
                 if session is not self.session:
                     # the executor stamped its memory stats on the
@@ -321,6 +358,20 @@ class LocalRunner:
                 tid = getattr(sp, "trace_id", None)
                 if TRACER.enabled and tid is not None:
                     trace_spans = TRACER.export(tid)
+                from ..planner.planner import bool_property
+                if bool_property(session, "result_cache", False):
+                    # EXPLAIN ANALYZE always executes (that's the
+                    # point) — report whether a resident entry would
+                    # have served this statement. Same key rule as the
+                    # execution path (bound_fingerprint) or the probe
+                    # would silently probe a key nothing stores under.
+                    from ..serving import resultcache as RC
+                    from ..serving.plancache import bound_fingerprint
+                    key = bound_fingerprint(
+                        stmt.statement, session, user=user,
+                        secured=secured or self.roles.enforce)
+                    stats.result_cache_probe = RC.RESULTS.probe(key)
+                    stats.result_cache_stats = RC.RESULTS.stats()
             if stmt.type == "distributed":
                 if stmt.format != "text":
                     raise ValueError(
@@ -344,6 +395,7 @@ class LocalRunner:
                 if stats is not None:
                     from ..planner.printer import (
                         format_cost_verdict, format_executables_summary,
+                        format_result_cache_summary,
                         format_scan_cache_summary, format_skew_summary,
                     )
                     skew = format_skew_summary(stats)
@@ -352,6 +404,9 @@ class LocalRunner:
                     sc = format_scan_cache_summary(stats)
                     if sc:
                         text += "\n" + sc
+                    rc = format_result_cache_summary(stats)
+                    if rc:
+                        text += "\n" + rc
                     exes = format_executables_summary(stats)
                     if exes:
                         text += "\n" + exes

@@ -120,6 +120,40 @@ class LambdaExpr(Expr):
         return f"lambda({self.n_params})->{self.body!r}"
 
 
+@dataclasses.dataclass(frozen=True, eq=False, repr=False)
+class Param(Expr):
+    """Execution-bound parameter slot (the plan-template analogue of
+    Presto's Parameter after ParameterRewriter — except the value stays
+    a RUNTIME input instead of folding to a constant).
+
+    ``bound`` carries the binding the plan was BUILT with, but equality,
+    hashing and repr deliberately exclude it: two plans differing only
+    in bindings compare equal expression-by-expression, so the compile
+    caches (expr/compiler.ExprCompiler, ops/jitcache) hand every binding
+    the SAME traced executable. At dispatch the kernel reads the live
+    value from the query's binding scope (expr/params.py) as a traced
+    scalar argument."""
+
+    slot: int = 0
+    #: build-time binding (python-domain value). NEVER read at trace
+    #: time — only the planner may consult it, and only through
+    #: expr/params.consult(), which records a reuse guard.
+    bound: Any = None
+
+    def __eq__(self, other):
+        return (type(other) is Param and other.type == self.type
+                and other.slot == self.slot)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __hash__(self):
+        return hash((Param, self.type, self.slot))
+
+    def __repr__(self) -> str:
+        return f"?{self.slot}:{self.type.display()}"
+
+
 @dataclasses.dataclass(frozen=True)
 class SpecialForm(Expr):
     form: Form = Form.AND
@@ -140,6 +174,10 @@ def input_ref(index: int, type: Type) -> InputRef:
 
 def lit(value: Any, type: Type) -> Literal:
     return Literal(type=type, value=value)
+
+
+def param(slot: int, value: Any, type: Type) -> Param:
+    return Param(type=type, slot=slot, bound=value)
 
 
 def call(name: str, type: Type, *args: Expr) -> Call:

@@ -1,0 +1,191 @@
+"""Execution-time bindings for plan-template parameters (ir.Param).
+
+A parameter-generic plan (serving/template.py) carries ``ir.Param``
+nodes where the statement had literals. The three scopes here keep the
+value out of every compile key while still delivering it to the kernel:
+
+- the **binding scope** (:func:`bound`) is set per query around plan
+  execution with the query's slot->value map. It is a contextvar, so
+  the exchange driver threads (which copy their spawn context) and the
+  main drain loop both see it, and two concurrent queries sharing one
+  cached plan keep their own bindings.
+- the **trace scope** (:func:`trace_scope`) is set by the expression
+  compiler INSIDE the jitted function, mapping each slot to the traced
+  scalar the kernel received as an argument. ``eval_expr`` reads it
+  when it meets a Param. Evaluating a Param outside any trace scope is
+  a hard error — a silently-stale build-time value must never leak
+  into results.
+- the **guard scope** (:func:`recording_guards`) is active only while
+  the PLANNER builds a template. An optimizer site that bakes a
+  parameter's value into the plan (scan-pushdown bounds — which seed
+  key-bounds gates and stats downstream) must go through
+  :func:`consult`, which records an equality guard; a later binding
+  that flips the guard makes the template unusable for it and falls
+  back to a per-binding fingerprint (serving/template.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from . import ir
+
+#: per-query slot -> python-domain value
+_BINDINGS: contextvars.ContextVar[Optional[Dict[int, Any]]] = \
+    contextvars.ContextVar("param_bindings", default=None)
+#: per-trace slot -> traced scalar (storage domain)
+_TRACE: contextvars.ContextVar[Optional[Dict[int, Any]]] = \
+    contextvars.ContextVar("param_trace", default=None)
+#: planner-side guard recorder: list of (slot, python value)
+_GUARDS: contextvars.ContextVar[Optional[List[Tuple[int, Any]]]] = \
+    contextvars.ContextVar("param_guards", default=None)
+
+
+@contextlib.contextmanager
+def bound(bindings: Optional[Dict[int, Any]]):
+    """Query-scope binding map; no-op when ``bindings`` is None."""
+    if bindings is None:
+        yield
+        return
+    token = _BINDINGS.set(dict(bindings))
+    try:
+        yield
+    finally:
+        _BINDINGS.reset(token)
+
+
+@contextlib.contextmanager
+def trace_scope(slot_vals: Dict[int, Any]):
+    token = _TRACE.set(slot_vals)
+    try:
+        yield
+    finally:
+        _TRACE.reset(token)
+
+
+@contextlib.contextmanager
+def recording_guards():
+    guards: List[Tuple[int, Any]] = []
+    token = _GUARDS.set(guards)
+    try:
+        yield guards
+    finally:
+        _GUARDS.reset(token)
+
+
+def consult(p: ir.Param) -> Any:
+    """Planner-only read of a Param's build-time value. Records an
+    equality guard when a template build is recording: the produced
+    plan is only reusable for bindings that repeat this value."""
+    guards = _GUARDS.get()
+    if guards is not None:
+        guards.append((p.slot, p.bound))
+    return p.bound
+
+
+def collect_params(exprs: Sequence[object]) -> List[ir.Param]:
+    """Every distinct Param slot in the given IR trees, slot-ordered."""
+    by_slot: Dict[int, ir.Param] = {}
+
+    def walk(e):
+        if isinstance(e, ir.Param):
+            by_slot.setdefault(e.slot, e)
+        for c in getattr(e, "children", lambda: ())():
+            walk(c)
+
+    for e in exprs:
+        if e is not None:
+            walk(e)
+    return [by_slot[s] for s in sorted(by_slot)]
+
+
+def current_args(slots: Sequence[ir.Param]) -> Tuple[Any, ...]:
+    """The live binding for each slot as device scalars in storage
+    domain — the extra jit operands of a parameterized kernel. Values
+    come from the active binding scope; running a parameterized plan
+    without one is a programming error (the template path always binds)."""
+    bindings = _BINDINGS.get()
+    if bindings is None:
+        raise RuntimeError(
+            "parameterized plan executed outside a binding scope "
+            "(serving/template.py must supply Session.param_bindings)")
+    out = []
+    for p in slots:
+        if p.slot not in bindings:
+            raise RuntimeError(f"no binding for parameter slot {p.slot}")
+        storage = p.type.to_storage(bindings[p.slot])
+        out.append(jnp.asarray(storage, dtype=p.type.storage_dtype))
+    return tuple(out)
+
+
+def traced_val(p: ir.Param, n: int):
+    """Val for a Param during kernel tracing: the traced scalar from the
+    active trace scope broadcast to the batch capacity. Never NULL —
+    the parameterizer only hole-punches non-null literals."""
+    from .functions import Val
+    trace = _TRACE.get()
+    if trace is None or p.slot not in trace:
+        raise RuntimeError(
+            f"parameter slot {p.slot} evaluated outside a trace scope "
+            "(kernels over parameterized expressions must pass param "
+            "operands — expr/compiler.ExprCompiler does)")
+    scalar = trace[p.slot]
+    return Val(jnp.broadcast_to(scalar, (n,)),
+               jnp.ones(n, dtype=bool), p.type)
+
+
+def has_params(obj) -> bool:
+    """True when a plan (or any dataclass tree) contains an ir.Param —
+    the gate for paths that must materialize bindings first (remote
+    fragments, mesh SPMD programs, fused join chains)."""
+    import dataclasses as _dc
+    seen = set()
+
+    def walk(n) -> bool:
+        if isinstance(n, ir.Param):
+            return True
+        if id(n) in seen:
+            return False
+        if _dc.is_dataclass(n) and not isinstance(n, type):
+            seen.add(id(n))
+            return any(walk(getattr(n, f.name))
+                       for f in _dc.fields(n))
+        if isinstance(n, (tuple, list)):
+            return any(walk(x) for x in n)
+        return False
+
+    return walk(obj)
+
+
+def bind_plan(plan, bindings: Dict[int, Any]):
+    """Materialize a parameterized plan for substrates that trace
+    values as constants (cluster fragments shipped over the codec, the
+    SPMD mesh executor): every ir.Param becomes an ir.Literal of the
+    query's binding. Returns a structurally-shared rebuild; the cached
+    template is never mutated."""
+    import dataclasses as _dc
+
+    def walk(n):
+        if isinstance(n, ir.Param):
+            return ir.Literal(type=n.type, value=bindings[n.slot])
+        if _dc.is_dataclass(n) and not isinstance(n, type):
+            changes = {}
+            for f in _dc.fields(n):
+                v = getattr(n, f.name)
+                nv = walk(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            return _dc.replace(n, **changes) if changes else n
+        if isinstance(n, tuple):
+            out = tuple(walk(x) for x in n)
+            return out if any(a is not b for a, b in zip(out, n)) else n
+        if isinstance(n, list):
+            out = [walk(x) for x in n]
+            return out if any(a is not b
+                              for a, b in zip(out, n)) else n
+        return n
+
+    return walk(plan)

@@ -119,7 +119,14 @@ def _extract_bounds(pred: ir.Expr,
             e = e.arg
         return e.index if isinstance(e, ir.InputRef) else None
 
-    def lit_of(e: ir.Expr):
+    def lit_of(e: ir.Expr, allow_param: bool = False):
+        """(storage int, param-or-None) for a boundable constant; param
+        is the ir.Param the value came from (plan templates). Params
+        are only consultable for RANGE comparisons: baking a bound from
+        them records a value-equality reuse guard (expr/params.consult)
+        — acceptable for fleet-constant range windows, but an eq bound
+        on the fleet's VARYING slot (user_id = ?) would turn every
+        binding into a guard fallback, so eq never consults."""
         if isinstance(e, ir.Cast):
             e = e.arg
         # only literals whose own domain is integer-like convert safely:
@@ -129,39 +136,64 @@ def _extract_bounds(pred: ir.Expr,
         if (isinstance(e, ir.Literal) and e.value is not None
                 and isinstance(e.type, _BOUNDABLE)):
             try:
-                return int(e.type.to_storage(e.value))
+                return int(e.type.to_storage(e.value)), None
             except (TypeError, ValueError):
-                return None
-        return None
+                return None, None
+        if (allow_param and isinstance(e, ir.Param)
+                and e.bound is not None
+                and isinstance(e.type, _BOUNDABLE)):
+            try:
+                return int(e.type.to_storage(e.bound)), e
+            except (TypeError, ValueError):
+                return None, None
+        return None, None
+
+    def guarded(idx: int, *ps) -> bool:
+        """Record consultation guards for the params feeding a bound —
+        only when the bound will actually attach (boundable column)."""
+        if not isinstance(scan.fields[idx].type, _BOUNDABLE):
+            return False
+        from ..expr import params as _params
+        for p in ps:
+            if p is not None:
+                _params.consult(p)
+        return True
 
     for c in conjuncts(pred):
         if isinstance(c, ir.SpecialForm) and c.form == ir.Form.BETWEEN:
             i = ref_of(c.args[0])
-            lo, hi = lit_of(c.args[1]), lit_of(c.args[2])
-            if i is not None and lo is not None and hi is not None:
+            (lo, plo), (hi, phi) = (lit_of(c.args[1], True),
+                                    lit_of(c.args[2], True))
+            if i is not None and lo is not None and hi is not None \
+                    and guarded(i, plo, phi):
                 note(i, lo, hi)
             continue
         if not isinstance(c, ir.Call) or len(c.args) != 2:
             continue
+        op = c.name
+        range_op = op in ("lt", "le", "gt", "ge")
         a, b = c.args
         ia, ib = ref_of(a), ref_of(b)
-        la, lb = lit_of(a), lit_of(b)
-        op = c.name
+        la, pa = lit_of(a, range_op)
+        lb, pb = lit_of(b, range_op)
         if ia is not None and lb is not None:
-            idx, v = ia, lb
+            idx, v, p = ia, lb, pb
         elif ib is not None and la is not None:
             # flip the comparison: lit OP col == col FLIP(op) lit
-            idx, v = ib, la
+            idx, v, p = ib, la, pa
             op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
                   "eq": "eq"}.get(op, "")
         else:
             continue
         if op == "eq":
-            note(idx, v, v)
+            if guarded(idx, p):
+                note(idx, v, v)
         elif op in ("lt", "le"):
-            note(idx, None, v)
+            if guarded(idx, p):
+                note(idx, None, v)
         elif op in ("gt", "ge"):
-            note(idx, v, None)
+            if guarded(idx, p):
+                note(idx, v, None)
     # unbounded sides stay None: a finite sentinel would be compared
     # against real column statistics and could prune live data
     return tuple((n, lo if lo > -INF else None, hi if hi < INF else None)

@@ -66,6 +66,11 @@ class Session:
     # scheduling weight for the device scheduler and the group memory
     # account for the query pool
     serving: object = None
+    # plan-template bindings {slot: value} set on the per-query overlay
+    # when the plan came from serving/template.py: the executor opens an
+    # expr/params binding scope around the drain so ir.Param kernels
+    # read THIS query's literals as traced scalars
+    param_bindings: Optional[Dict[int, object]] = None
 
 
 def _schema_exists(session: "Session", schema: str) -> bool:

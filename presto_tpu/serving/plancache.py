@@ -37,18 +37,12 @@ Metrics: ``plan_cache_{hit,miss,invalidated,evicted}_total`` — on
 from __future__ import annotations
 
 import hashlib
-import threading
 import weakref
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
 from .._devtools.lockcheck import checked_lock
 from ..obs.metrics import REGISTRY
-
-_HITS = REGISTRY.counter("plan_cache_hit_total")
-_MISSES = REGISTRY.counter("plan_cache_miss_total")
-_INVALIDATED = REGISTRY.counter("plan_cache_invalidated_total")
-_EVICTED = REGISTRY.counter("plan_cache_evicted_total")
 
 DEFAULT_CAPACITY = 256
 
@@ -64,6 +58,35 @@ def _freeze(v):
     return v
 
 
+class IdentMemo:
+    """Identity-keyed LRU for artifacts derived from interned objects
+    (parse_cached returns the SAME AST per repeated text). Entries PIN
+    their key object, so an id() can never be reused while its entry
+    lives; bounded like the statement cache itself. Shared by the
+    canonical-repr memo here and the template parameterization memo
+    (serving/template.py) — one implementation owns the id-reuse pin
+    and cap policy."""
+
+    def __init__(self, cap: int = 512, lock_name: str = "plancache.memo"):
+        self._cap = cap
+        self._entries: "OrderedDict[int, Tuple]" = OrderedDict()
+        self._lock = checked_lock(lock_name)
+
+    def get(self, obj, compute):
+        key = id(obj)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None and hit[0] is obj:
+                self._entries.move_to_end(key)
+                return hit[1]
+        value = compute(obj)
+        with self._lock:
+            self._entries[key] = (obj, value)
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+        return value
+
+
 class _Entry:
     __slots__ = ("plan", "deps")
 
@@ -75,26 +98,44 @@ class _Entry:
 
 class PlanCache:
     """Process-wide LRU of optimized logical plans (the whole-plan
-    sibling of the jit executable cache)."""
+    sibling of the jit executable cache). ``metrics`` names the counter
+    family (the template cache instantiates a second PlanCache under
+    ``plan_template_cache``); ``get`` returns what ``put`` stored — by
+    default the plan itself, or an arbitrary payload (template entries
+    carry plan + guards) whose deps still come from the plan."""
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 metrics: str = "plan_cache",
+                 lock_name: str = "plancache.entries"):
         self.capacity = capacity
         self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
         #: bumped on every connector write notification; plans begun
         #: before a write may not insert after it (see put())
         self._epoch = 0
-        self._lock = checked_lock("plancache.entries")
+        self._lock = checked_lock(lock_name)
+        self._hits = REGISTRY.counter(f"{metrics}_hit_total")
+        self._misses = REGISTRY.counter(f"{metrics}_miss_total")
+        self._invalidated = REGISTRY.counter(f"{metrics}_invalidated_total")
+        self._evicted = REGISTRY.counter(f"{metrics}_evicted_total")
 
     # -- keying ---------------------------------------------------------------
-    @staticmethod
-    def fingerprint(stmt, session, user: str = "") -> bytes:
+    #: statement-repr memo: a serving query fingerprints twice
+    #: (template + bound key) — the O(tree) repr is paid once
+    _repr_memo = IdentMemo(lock_name="plancache.reprs")
+
+    @classmethod
+    def _stmt_repr(cls, stmt) -> bytes:
+        return cls._repr_memo.get(stmt, lambda s: repr(s).encode())
+
+    @classmethod
+    def fingerprint(cls, stmt, session, user: str = "") -> bytes:
         """Canonical statement fingerprint. The AST and its literals are
         frozen dataclasses, so ``repr`` is a stable canonical form; the
         session slice covers everything that can change what ``optimize``
         produces (properties drive optimizer gates, views expand at plan
         time, the user scopes secured-catalog resolution)."""
         h = hashlib.sha256()
-        h.update(repr(stmt).encode())
+        h.update(cls._stmt_repr(stmt))
         h.update(repr((session.catalog, session.schema)).encode())
         # connector identities: two runners mounting same-named catalogs
         # over DIFFERENT connector instances (separate datasets) must
@@ -167,7 +208,7 @@ class PlanCache:
         with self._lock:
             e = self._entries.get(key)
             if e is None:
-                _MISSES.inc()
+                self._misses.inc()
                 return None
             deps = list(e.deps)
         # revalidate OUTSIDE the lock: data_version may touch the
@@ -180,17 +221,17 @@ class PlanCache:
             with self._lock:
                 if self._entries.get(key) is e:
                     del self._entries[key]
-                    _INVALIDATED.inc()
-            _MISSES.inc()
+                    self._invalidated.inc()
+            self._misses.inc()
             return None
         with self._lock:
             if self._entries.get(key) is e:
                 self._entries.move_to_end(key)
-        _HITS.inc()
+        self._hits.inc()
         return e.plan
 
     def put(self, key: bytes, plan, session,
-            epoch: Optional[int] = None) -> bool:
+            epoch: Optional[int] = None, payload=None) -> bool:
         """Insert a freshly-optimized plan. ``epoch`` is the write epoch
         captured BEFORE planning began: any connector write notifying
         during the plan/optimize window bumps the epoch and vetoes the
@@ -198,7 +239,8 @@ class PlanCache:
         otherwise validate a plan whose optimizer-time stats predate
         the write (TOCTOU). External mutations that bypass
         notify_data_change are caught by get()'s per-hit revalidation
-        instead (data_version fingerprints file mtimes)."""
+        instead (data_version fingerprints file mtimes). ``payload``
+        (default: the plan) is what a later get() returns."""
         deps = self._plan_deps(plan, session)
         if deps is None:
             return False
@@ -207,10 +249,11 @@ class PlanCache:
                 return False
             if key in self._entries:
                 return True            # first planner won; identical plan
-            self._entries[key] = _Entry(plan, deps)
+            self._entries[key] = _Entry(
+                payload if payload is not None else plan, deps)
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
-                _EVICTED.inc()
+                self._evicted.inc()
             return True
 
     # -- invalidation ---------------------------------------------------------
@@ -235,7 +278,7 @@ class PlanCache:
             for key in victims:
                 del self._entries[key]
             if victims:
-                _INVALIDATED.inc(len(victims))
+                self._invalidated.inc(len(victims))
 
     def clear(self) -> None:
         with self._lock:
@@ -289,6 +332,16 @@ def parse_cached(sql: str):
         while len(_stmt_entries) > _STMT_CAP:
             _stmt_entries.popitem(last=False)
     return stmt
+
+
+def bound_fingerprint(stmt, session, user: str = "",
+                      secured: bool = False) -> bytes:
+    """THE bound-statement key rule (user folds in only when access
+    control is active) — every consumer (plan cache, template cache's
+    fallback key, result cache, EXPLAIN ANALYZE's probe) must go
+    through here so they can never diverge on what a key covers."""
+    return PlanCache.fingerprint(stmt, session,
+                                 user=user if secured else "")
 
 
 def cached_plan(stmt, session, user: str = "", secured: bool = False):

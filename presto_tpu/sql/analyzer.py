@@ -260,6 +260,23 @@ class ExpressionAnalyzer:
     def _DateLiteral(self, node):
         return ir.lit(node.value, T.DATE)
 
+    # -- slot-marked literals (plan templates, serving/template.py):
+    # -- lowered to runtime-bound parameters instead of baked constants.
+    # -- Types match the plain literal forms exactly, and are value-
+    # -- independent for every parameterizable kind (a DecimalLiteral's
+    # -- inferred precision/scale is part of the template key).
+    def _SlotLongLiteral(self, node):
+        return ir.param(node.slot, node.value, T.BIGINT)
+
+    def _SlotDoubleLiteral(self, node):
+        return ir.param(node.slot, node.value, T.DOUBLE)
+
+    def _SlotDecimalLiteral(self, node):
+        return ir.param(node.slot, node.value, literal_type(node))
+
+    def _SlotDateLiteral(self, node):
+        return ir.param(node.slot, node.value, T.DATE)
+
     def _IntervalLiteral(self, node):
         raise AnalysisError(
             "interval literal only supported in date +/- interval")

@@ -180,6 +180,42 @@ class Parameter(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class TypedParameter(Expression):
+    """Literal hole in a plan-template fingerprint (serving/template.py):
+    position plus the literal's TYPE KIND, never its value — two
+    statements differing only in hole-punched literal values hash to
+    the same template. Never planned; exists only to be hashed."""
+    index: int
+    kind: str                      # bigint | double | date | decimal(p,s)
+
+
+# Slot-marked literals: value-carrying literals the template
+# parameterizer has assigned a binding slot. They subclass their plain
+# forms, so every analysis/validation isinstance check keeps working,
+# but the analyzer lowers them to runtime-bound ir.Param nodes instead
+# of baked constants (see analyzer._Slot*Literal).
+
+@dataclasses.dataclass(frozen=True)
+class SlotLongLiteral(LongLiteral):
+    slot: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotDoubleLiteral(DoubleLiteral):
+    slot: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotDecimalLiteral(DecimalLiteral):
+    slot: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotDateLiteral(DateLiteral):
+    slot: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
 class ArrayLiteral(Expression):
     """ARRAY[e1, e2, ...] (reference sql/tree/ArrayConstructor.java)."""
     items: Tuple[Expression, ...]

@@ -246,7 +246,7 @@ def test_group_memory_via_protocol_query():
         q = srv.create_query(
             "select l_returnflag, sum(l_quantity) from lineitem "
             "group by l_returnflag", {})
-        q._thread.join(timeout=30)
+        q.done.wait(timeout=30)
         assert q.state == "FINISHED"
         root = srv.resource_groups.roots["global"]
         assert root.memory_reserved == 0
@@ -265,13 +265,13 @@ def test_failed_query_releases_admission_slot():
     srv = PrestoTpuServer(LocalRunner(tpch_sf=0.001))
     try:
         q = srv.create_query("select bogus_column from nation", {})
-        q._thread.join(timeout=30)
+        q.done.wait(timeout=30)
         assert q.state == "FAILED"
         info = srv.resource_groups.info()[0]
         assert info["numRunning"] == 0 and info["numQueued"] == 0
         # and the next query is admitted normally
         q2 = srv.create_query("select 1", {})
-        q2._thread.join(timeout=30)
+        q2.done.wait(timeout=30)
         assert q2.state == "FINISHED"
     finally:
         srv.stop()
@@ -300,13 +300,13 @@ def test_query_queued_timeout():
     try:
         q1 = srv.create_query("slow", {})
         q2 = srv.create_query("fast", {"query_queued_timeout": "0.3s"})
-        q2._thread.join(timeout=10)
+        q2.done.wait(timeout=10)
         assert q2.state == "FAILED"
         assert q2.error["errorName"] == "QUERY_QUEUED_TIMEOUT"
         info = srv.resource_groups.info()[0]
         assert info["numQueued"] == 0
         runner.gate.set()
-        q1._thread.join(timeout=10)
+        q1.done.wait(timeout=10)
         assert q1.state == "FINISHED"
         assert info["numRunning"] in (0, 1)  # q1 may still be draining
     finally:
@@ -449,6 +449,11 @@ def test_serving_regression_gate_smoke(capsys):
     assert doc["self_comparison"] == "pass"
     assert doc["degraded_comparison"] == "fail"
     assert any("qps" in m for m in doc["metrics"])
+    # ISSUE 13: the r02+ pins carry the template/result hit-rate keys —
+    # the gate must cover them (a halved hit rate fails the degraded
+    # comparison above)
+    assert any("template_hit_rate" in m for m in doc["metrics"])
+    assert any("result_hit_rate" in m for m in doc["metrics"])
 
 
 def test_serving_gate_latency_metrics_invert():
@@ -498,10 +503,10 @@ def test_cluster_runner_through_admission_and_plan_cache():
                "group by n_regionkey order by n_regionkey")
         h0 = _metric("plan_cache_hit_total")
         q1 = srv.create_query(sql, {}, user="alice")
-        q1._thread.join(timeout=60)
+        q1.done.wait(timeout=60)
         assert q1.state == "FINISHED", q1.error
         q2 = srv.create_query(sql, {}, user="alice")
-        q2._thread.join(timeout=60)
+        q2.done.wait(timeout=60)
         assert q2.state == "FINISHED", q2.error
         # the repeated statement rode the compiled-plan cache on the
         # CLUSTER path
@@ -518,10 +523,10 @@ def test_cluster_runner_through_admission_and_plan_cache():
         # per-query session property overlays reach the cluster
         # session (a bad value fails the statement, a good one binds)
         q3 = srv.create_query(sql, {"retry_policy": "BOGUS"})
-        q3._thread.join(timeout=60)
+        q3.done.wait(timeout=60)
         assert q3.state == "FAILED"
         q4 = srv.create_query(sql, {"retry_policy": "NONE"})
-        q4._thread.join(timeout=60)
+        q4.done.wait(timeout=60)
         assert q4.state == "FINISHED", q4.error
     finally:
         srv.stop()
