@@ -11,11 +11,27 @@ then fails on
 
 - **cycles** in the observed edge graph (a real AB/BA inversion was
   executed, even if the two orders ran on different threads and never
-  deadlocked in this run), and
+  deadlocked in this run),
 - **locks held across a jit dispatch** (``ops/jitcache._TimedEntry``
   calls :func:`note_dispatch` before every cached-executable call; a
   lock held there serializes every other query behind one query's
-  device work — the exact stall the fair scheduler exists to prevent).
+  device work — the exact stall the fair scheduler exists to prevent),
+  and
+- **guarded-field violations**: an attribute declared
+  ``x = guarded_by("lock.name")`` fails FAST (raises
+  :class:`GuardedFieldError` and records a violation) when read or
+  written by a thread not holding that checked lock — the runtime half
+  of the cache-contract checker (tools/analyze/caches.py). The first
+  write is exempt so ``__init__`` can seed the field before the object
+  is published.
+
+The interleaving explorer (``presto_tpu/_devtools/interleave.py``)
+additionally installs a **scheduler hook** here: while an exploration
+is active, threads registered with the active scheduler route their
+``checked_lock`` acquires through it (non-blocking probe + blocked
+bookkeeping) so a thread descheduled while holding a lock can never
+silently deadlock the exploration — the scheduler sees the block and
+reports real deadlocks as findings.
 
 Gating: instrumentation is decided once at import via the
 ``PRESTO_TPU_LOCKCHECK`` env var (``1``/``0``); when unset it is ON
@@ -31,8 +47,9 @@ import sys
 import threading
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["ENABLED", "GRAPH", "LockGraph", "checked_lock",
-           "checked_rlock", "note_dispatch"]
+__all__ = ["ENABLED", "GRAPH", "GuardedFieldError", "LockGraph",
+           "checked_lock", "checked_rlock", "guarded_by",
+           "note_dispatch", "set_scheduler"]
 
 _env = os.environ.get("PRESTO_TPU_LOCKCHECK")
 if _env is None:
@@ -40,6 +57,19 @@ if _env is None:
     ENABLED = "pytest" in sys.modules
 else:
     ENABLED = _env.strip().lower() not in ("0", "false", "off", "")
+
+#: active interleaving scheduler (presto_tpu/_devtools/interleave.py)
+#: or None — consulted per checked-lock acquire/release; only threads
+#: the scheduler registered are routed through it
+_SCHEDULER = None
+
+
+def set_scheduler(sched) -> None:
+    """Install (or, with None, remove) the interleaving scheduler the
+    checked locks report to. Exploration runs are serial, so a plain
+    module global is enough."""
+    global _SCHEDULER
+    _SCHEDULER = sched
 
 
 class LockGraph:
@@ -162,7 +192,16 @@ class _CheckedLock:
         self._graph = graph
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        got = self._inner.acquire(blocking, timeout)
+        sched = _SCHEDULER
+        if sched is not None and blocking and timeout == -1 \
+                and sched.owns_current_thread():
+            # interleaving exploration: the scheduler serializes
+            # registered threads, so a blocking acquire from one must
+            # go through it (non-blocking probe + blocked bookkeeping)
+            # or a descheduled holder would deadlock the exploration
+            got = sched.checked_acquire(self._inner, self.name)
+        else:
+            got = self._inner.acquire(blocking, timeout)
         if got:
             self._graph._acquired(self.name)
         return got
@@ -170,6 +209,9 @@ class _CheckedLock:
     def release(self) -> None:
         self._inner.release()
         self._graph._released(self.name)
+        sched = _SCHEDULER
+        if sched is not None:
+            sched.lock_released(self.name)
 
     def __enter__(self) -> "_CheckedLock":
         self.acquire()
@@ -213,3 +255,99 @@ def note_dispatch(what: str) -> None:
     """Called by ops/jitcache._TimedEntry before each cached-executable
     dispatch; records a violation when any instrumented lock is held."""
     GRAPH.note_dispatch(what)
+
+
+# -- guarded fields -----------------------------------------------------------
+
+class GuardedFieldError(RuntimeError):
+    """A ``guarded_by`` field was touched without its lock held."""
+
+
+class _GuardedField:
+    """Data descriptor enforcing a guarded-by contract on one attribute.
+    Values live in the instance ``__dict__`` under a mangled key (a data
+    descriptor wins the lookup, so the public name stays clean); every
+    read and every write after the first checks the current thread's
+    held-lock stack. ``check=False`` (production) keeps the storage
+    protocol with zero validation."""
+
+    __slots__ = ("lock_name", "lock_attr", "name", "slot", "check",
+                 "_graph")
+
+    def __init__(self, lock_name: Optional[str], lock_attr: Optional[str],
+                 check: bool, graph=None):
+        self.lock_name = lock_name
+        self.lock_attr = lock_attr
+        self.check = check
+        self._graph = graph
+        self.name = "<unbound>"
+        self.slot = "_guarded__<unbound>"
+
+    def __set_name__(self, owner, name: str) -> None:
+        self.name = f"{owner.__name__}.{name}"
+        self.slot = f"_guarded__{name}"
+
+    def _required_name(self, obj) -> Optional[str]:
+        if self.lock_name is not None:
+            return self.lock_name
+        lock = getattr(obj, self.lock_attr, None)
+        # the instance's lock should be a _CheckedLock (the static
+        # cache checker enforces that); a foreign primitive has no
+        # name for the held-stack to carry, so nothing to verify
+        return getattr(lock, "name", None)
+
+    def _validate(self, obj, op: str) -> None:
+        required = self._required_name(obj)
+        if required is None:
+            return
+        graph = self._graph if self._graph is not None else GRAPH
+        if required in graph._stack():
+            return
+        msg = (f"guarded field {self.name} {op} without holding "
+               f"checked lock {required!r} (held: "
+               f"{sorted(set(graph._stack()))})")
+        with graph._mu:
+            graph.violations.append(msg)
+        raise GuardedFieldError(msg)
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        try:
+            value = obj.__dict__[self.slot]
+        except KeyError:
+            raise AttributeError(self.name) from None
+        if self.check:
+            self._validate(obj, "read")
+        return value
+
+    def __set__(self, obj, value) -> None:
+        if self.check and self.slot in obj.__dict__:
+            # first write (``__init__`` seeding, pre-publication) is
+            # exempt; every re-bind afterwards needs the lock
+            self._validate(obj, "write")
+        obj.__dict__[self.slot] = value
+
+    def __delete__(self, obj) -> None:
+        if self.check:
+            self._validate(obj, "delete")
+        obj.__dict__.pop(self.slot, None)
+
+
+def guarded_by(lock_name: Optional[str] = None, *,
+               attr: Optional[str] = None, graph=None) -> _GuardedField:
+    """Class-level annotation: ``_entries = guarded_by("cache.lock")``
+    makes every read/write of ``self._entries`` (after the ``__init__``
+    seed) fail fast unless the named :func:`checked_lock` is held by the
+    current thread. ``guarded_by(attr="_lock")`` resolves the required
+    name from the INSTANCE's lock instead — for classes whose lock name
+    is a constructor parameter (PlanCache serves both the plan and the
+    template cache under different names). Name-granular like the rest
+    of the validator: two instances sharing a lock NAME satisfy each
+    other's guard, which matches how the engine names its locks (one
+    name per subsystem lock). No-op (plain storage) when the validator
+    is disabled."""
+    if (lock_name is None) == (attr is None):
+        raise TypeError("guarded_by takes exactly one of a lock name "
+                        "or attr=")
+    return _GuardedField(lock_name, attr, check=ENABLED, graph=graph)

@@ -314,6 +314,56 @@ CONFIG_KEYS: Dict[str, str] = {
     "plugin.dir": "directory of plugin modules to load",
 }
 
+#: declared environment variables — the same two-way contract as the
+#: other string-keyed registries (tools/analyze/registries.py): every
+#: ``PRESTO_TPU_*`` / ``BENCH_*`` read in the tree must resolve to an
+#: entry here, every entry must have a read site, and the table in
+#: docs/static_analysis.md round-trips both ways. Foreign variables
+#: (XLA_FLAGS, JAX_PLATFORMS) are deliberately NOT declared: they
+#: belong to other projects' registries.
+ENV_VARS: Dict[str, str] = {
+    "PRESTO_TPU_LOCKCHECK": "force the runtime lock-order validator "
+                            "on/off (default: on under pytest only)",
+    "PRESTO_TPU_LOG": "structured JSON-lines log destination "
+                      "(obs/log.py; empty = disabled)",
+    "PRESTO_TPU_TRACE": "enable the span tracer outside explicit "
+                        "--trace-out runs (obs/trace.py)",
+    "PRESTO_TPU_MESH_EXECUTION": "environment default for the "
+                                 "mesh_execution session property "
+                                 "(auto/on/off; tests pin off)",
+    "PRESTO_TPU_FAILPOINTS": "failpoint arming spec applied at import "
+                             "(exec/failpoints.py grammar)",
+    "BENCH_REPIN": "allow bench.py to overwrite pinned proxy seconds",
+    "BENCH_OUT": "write the bench summary JSON here (regression gate "
+                 "input)",
+    "BENCH_BUDGET_S": "wall-clock budget for a bench run (seconds)",
+    "BENCH_SF": "default TPC-H scale factor for bench configs",
+    "BENCH_SF_Q1": "scale-factor override for the q1 config",
+    "BENCH_SF_Q1SQL": "scale-factor override for the q1sql config",
+    "BENCH_SF_Q3": "scale-factor override for the q3 config",
+    "BENCH_SF_Q6": "scale-factor override for the q6 config",
+    "BENCH_SF_DS": "scale-factor override for the TPC-DS configs",
+    "BENCH_SF_ORC": "scale-factor for the ORC device-decode config",
+    "BENCH_ORC": "include the ORC device-decode config in the tuple",
+    "BENCH_SERVING": "run the serving bench axis",
+    "BENCH_SERVING_SF": "serving bench scale factor",
+    "BENCH_SERVING_CLIENTS": "legacy alias of SERVING_CLIENTS",
+    "BENCH_SERVING_QUERIES": "legacy alias of SERVING_QUERIES",
+    "BENCH_MULTICHIP": "run the multichip bench axis",
+    "BENCH_MULTICHIP_DEVICES": "max mesh width for the multichip axis",
+    "BENCH_MULTICHIP_FORCE_CPU": "self-provision a virtual CPU mesh "
+                                 "for the multichip axis (default 1)",
+    "BENCH_MULTICHIP_SF": "multichip bench scale factor",
+    "SERVING_CLIENTS": "serving bench concurrent client count",
+    "SERVING_QUERIES": "serving bench statements per client",
+    "SERVING_MIX": "comma-separated serving bench phases "
+                   "(mixed/execute/repeated)",
+    "SERVING_OUT": "write the serving bench pin JSON here",
+    "MULTICHIP_OUT": "write the multichip bench pin JSON here",
+    "ELASTIC_OUT": "write the chaos recovery-time summary here "
+                   "(tools/chaos_smoke.py)",
+}
+
 
 def parse_properties(path: str) -> Dict[str, str]:
     """key=value lines; '#' comments; whitespace-tolerant (the reference

@@ -74,6 +74,19 @@ SITES: Dict[str, str] = {
     "mesh.repartition": "mesh executor ships one hash-exchange batch "
                         "over ICI (exec/distributed.py); error fails "
                         "the query before the collective dispatches",
+    "plancache.plan": "plan/template cache captured its write epoch "
+                      "and is about to plan+optimize (serving/"
+                      "plancache.py, serving/template.py) — the PR 8 "
+                      "TOCTOU window; the interleaving explorer "
+                      "deschedules here to land a write mid-plan",
+    "resultcache.stamp": "result cache captured its write epoch and "
+                         "is about to stamp plan deps (serving/"
+                         "resultcache.py begin()) — the PR 12 "
+                         "round-2 epoch-before-deps window",
+    "resultcache.partial": "result cache resolved a partial hit and "
+                           "is about to recompute the delta "
+                           "(serving/resultcache.py serve()) — the "
+                           "PR 12 double-apply window",
 }
 
 

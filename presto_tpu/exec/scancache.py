@@ -59,7 +59,7 @@ from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 
-from .._devtools.lockcheck import checked_lock, checked_rlock
+from .._devtools.lockcheck import checked_lock, checked_rlock, guarded_by
 from ..batch import Batch, bucket_capacity
 from ..connectors import spi
 from ..memory import QueryMemoryPool, batch_device_bytes
@@ -131,6 +131,11 @@ class ScanCache:
     duplicate decodes, the "shared work across concurrent consumers of
     the same table" idea from 'Efficient Tabular Data Preprocessing of
     ML Pipelines' (PAPERS.md)."""
+
+    #: guarded-field contracts (lockcheck): entry map and in-flight
+    #: decode table only under the cache lock
+    _entries = guarded_by(attr="_lock")
+    _inflight = guarded_by(attr="_lock")
 
     def __init__(self, limit_bytes: int = DEFAULT_CACHE_BYTES):
         self.pool = QueryMemoryPool(limit_bytes)

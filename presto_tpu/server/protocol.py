@@ -32,6 +32,8 @@ import threading
 import time
 import urllib.parse
 from decimal import Decimal
+
+from .._devtools.lockcheck import checked_lock
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -76,7 +78,7 @@ class _ProducerPool:
         self._cap = cap
         self._threads = 0
         self._idle = 0
-        self._lock = threading.Lock()
+        self._lock = checked_lock("protocol.producers")
 
     def submit(self, fn) -> None:
         self._q.put(fn)
@@ -132,11 +134,11 @@ class _Query:
         self._pages: "queue.Queue" = queue.Queue(maxsize=8)
         self._next_token = 0
         self._last_page: Optional[Tuple[int, Optional[List]]] = None
-        self._page_lock = threading.Lock()
+        self._page_lock = checked_lock("protocol.query.pages")
         # guards state transitions: cancel() and the producer thread race,
         # and FAILED must never become FINISHED (the reference's
         # QueryStateMachine rejects transitions out of terminal states)
-        self._state_lock = threading.Lock()
+        self._state_lock = checked_lock("protocol.query.state")
         self._cancelled = threading.Event()
         #: set when the producer finished (every exit path) — the
         #: pool-era replacement for joining the per-query thread
@@ -702,7 +704,7 @@ class PrestoTpuServer:
         self.queries: Dict[str, _Query] = {}
         self.shutting_down = False
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = checked_lock("protocol.server")
         # admission: the default config keeps one query running at a
         # time (the single shared device); pass a rootGroups/selectors
         # dict for real concurrency tiers

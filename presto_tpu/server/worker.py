@@ -36,6 +36,7 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from .._devtools.lockcheck import checked_lock
 from ..batch import Batch
 from ..connectors.spi import CatalogManager, Split
 from ..exec import local as local_exec
@@ -61,7 +62,7 @@ _EXCHANGE_SPOOL_FALLBACK = REGISTRY.counter(
     "exchange_spool_fallback_total")
 
 _query_handles: Dict[str, list] = {}
-_query_handles_lock = threading.Lock()
+_query_handles_lock = checked_lock("worker.query_handles")
 
 
 def _query_handle(query_id: str, serving: Optional[dict] = None):

@@ -30,6 +30,7 @@ from __future__ import annotations
 import weakref
 from typing import Dict, List, Optional
 
+from .._devtools.lockcheck import checked_lock
 from ..memory import MemoryLimitExceeded
 from ..obs.metrics import REGISTRY
 
@@ -37,12 +38,16 @@ _MEMORY_KILLS = REGISTRY.counter("resource_group_memory_kill_total")
 
 #: every live ResourceGroupManager registers here (construction-time),
 #: so the process-wide system.runtime.resource_groups table can reflect
-#: the servers running in this process without holding them alive
+#: the servers running in this process without holding them alive.
+#: WeakSet mutation is not atomic (add races GC-driven discard); two
+#: servers booting concurrently must not lose a registration.
 _MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+_managers_lock = checked_lock("serving.managers")
 
 
 def register_manager(manager) -> None:
-    _MANAGERS.add(manager)
+    with _managers_lock:
+        _MANAGERS.add(manager)
 
 
 class QueryServingContext:
@@ -126,7 +131,9 @@ def group_snapshot() -> List[Dict]:
     total_device = sum(s["device_seconds"] for s in shares.values()) \
         or 0.0
     out: List[Dict] = []
-    for mgr in list(_MANAGERS):
+    with _managers_lock:
+        managers = list(_MANAGERS)
+    for mgr in managers:
         for info in mgr.info():
             stack = [info]
             while stack:

@@ -41,7 +41,8 @@ import weakref
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
-from .._devtools.lockcheck import checked_lock
+from .._devtools.lockcheck import checked_lock, guarded_by
+from ..exec.failpoints import FAILPOINTS
 from ..obs.metrics import REGISTRY
 
 DEFAULT_CAPACITY = 256
@@ -66,6 +67,10 @@ class IdentMemo:
     canonical-repr memo here and the template parameterization memo
     (serving/template.py) — one implementation owns the id-reuse pin
     and cap policy."""
+
+    #: guarded-field contract (lockcheck): the memo map may only be
+    #: touched under this instance's lock
+    _entries = guarded_by(attr="_lock")
 
     def __init__(self, cap: int = 512, lock_name: str = "plancache.memo"):
         self._cap = cap
@@ -103,6 +108,13 @@ class PlanCache:
     ``plan_template_cache``); ``get`` returns what ``put`` stored — by
     default the plan itself, or an arbitrary payload (template entries
     carry plan + guards) whose deps still come from the plan."""
+
+    #: guarded-field contracts (lockcheck): the entry map and the write
+    #: epoch may only be touched under this instance's lock — the
+    #: attr= form resolves the required lock NAME per instance, since
+    #: the template cache instantiates this class under its own name
+    _entries = guarded_by(attr="_lock")
+    _epoch = guarded_by(attr="_lock")
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY,
                  metrics: str = "plan_cache",
@@ -360,6 +372,10 @@ def cached_plan(stmt, session, user: str = "", secured: bool = False):
     if plan is not None:
         return plan
     epoch = PLANS.epoch()      # before planning: a mid-plan write vetoes
+    # the PR 8 TOCTOU window: the interleaving explorer deschedules
+    # here (tests/test_interleave.py) to land a connector write
+    # mid-plan and assert the epoch veto holds
+    FAILPOINTS.hit("plancache.plan", key=key.hex()[:12])
     plan = optimize(plan_query(stmt, session), session)
     PLANS.put(key, plan, session, epoch=epoch)
     return plan

@@ -58,7 +58,8 @@ import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
-from .._devtools.lockcheck import checked_lock
+from .._devtools.lockcheck import checked_lock, guarded_by
+from ..exec.failpoints import FAILPOINTS
 from ..memory import QueryMemoryPool
 from ..obs.metrics import REGISTRY
 from .plancache import PlanCache, _freeze
@@ -142,6 +143,11 @@ class PartialHit:
 class ResultCache:
     """Process-wide LRU of final (and designated-subplan) query results
     keyed by bound-statement fingerprint + connector data versions."""
+
+    #: guarded-field contracts (lockcheck): entry map and write epoch
+    #: only under the cache lock
+    _entries = guarded_by(attr="_lock")
+    _epoch = guarded_by(attr="_lock")
 
     def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES):
         self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
@@ -598,6 +604,7 @@ def begin(key: bytes, plan, session, rows_per_batch: int,
     # post-write epoch and the next lookup would double-apply the
     # "new" files its rows already contain
     epoch = RESULTS.epoch()
+    FAILPOINTS.hit("resultcache.stamp", key=key.hex()[:12])
     deps = plan_deps(plan, session)
     return None, (key, plan, epoch, deps, rows_per_batch, cancel_event)
 
@@ -640,6 +647,10 @@ def serve(key: bytes, session, rows_per_batch: int,
                 stats.result_cache = "miss"
             return None
         _PARTIAL.inc()
+        # the PR 12 double-apply window: a second partial hit racing
+        # this delta recompute must merge against ITS OWN lookup-time
+        # snapshot and lose the update() re-stamp race
+        FAILPOINTS.hit("resultcache.partial", key=ph.key.hex()[:12])
         # merge against the LOOKUP-TIME snapshot: a concurrent partial
         # may re-stamp the live entry mid-flight, and merging into its
         # result would apply this delta twice

@@ -42,6 +42,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
+from ..exec.failpoints import FAILPOINTS
 from ..obs.metrics import REGISTRY
 from ..sql import ast as A
 from .plancache import PlanCache, bound_fingerprint, cached_plan
@@ -195,6 +196,7 @@ def template_plan(stmt, session, user: str = "", secured: bool = False):
     # executes the parameterized plan itself (same kernels later hits
     # will dispatch), bound to its own literals.
     epoch = TEMPLATES.epoch()
+    FAILPOINTS.hit("plancache.plan", key=tkey.hex()[:12])
     with P.recording_guards() as guards:
         plan = optimize(plan_query(marked_stmt, session), session)
     payload = Template(plan=plan,

@@ -1,0 +1,97 @@
+"""Red fixture for the cache-contract checker (tools/analyze/caches.py).
+
+A miniature cache module committing every protocol sin the checker
+exists to catch — tests/test_analyze.py asserts each rule fires on this
+file (with a synthetic CacheSpec) and NEVER on the live tree.
+"""
+import threading
+from collections import OrderedDict
+
+
+class BadCache:
+    """Violates: plain lock, no version in key, no insert-time version
+    recheck, no epoch veto, unbounded residency."""
+
+    def __init__(self):
+        self._entries = OrderedDict()
+        self._epoch = 0
+        # cache-plain-lock: invisible to the runtime lock validator
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def key(conn, catalog, table, columns, version, rows_per_batch=0):
+        # cache-key-missing-version: `version` is a parameter but never
+        # folded into the key — a connector write leaves stale entries
+        # reachable under the same key
+        return (id(conn), catalog, table, tuple(columns))
+
+    def get(self, key):
+        with self._lock:
+            return self._entries.get(key)
+
+    def put(self, key, value, epoch=None):
+        # cache-missing-version-recheck: no data_version re-read under
+        # the lock; cache-missing-epoch-veto: `epoch` accepted and
+        # ignored; cache-unbounded: no pool reserve, no popitem cap
+        with self._lock:
+            self._entries[key] = value
+            return True
+
+
+def deps_of(plan, session):
+    """Dep builder that stamps nothing (cache-missing-deps): entries
+    record no data_version, so hits can never notice a write."""
+    return [(None, "catalog", "table", 0)]
+
+
+def cached_value(cache, stmt, session):
+    # cache-epoch-after-deps: deps are snapshotted (build_plan) BEFORE
+    # the write epoch is captured — a write landing between the two
+    # stamps pre-write deps on a post-write epoch
+    plan = build_plan(stmt, session)
+    deps = deps_of(plan, session)
+    epoch = cache.epoch()
+    cache.put(("k",), (plan, deps), epoch=epoch)
+    return plan
+
+
+def build_plan(stmt, session):
+    return ("plan", stmt)
+
+
+# cache-missing-invalidation-hook: no spi.on_data_change registration
+# anywhere in this module — connector writes are never seen eagerly.
+
+
+def notify_data_change(conn, table):
+    pass
+
+
+class BadConnector:
+    """connector-write-no-notify: versioned (defines data_version) but
+    append/drop_table mutate without notifying; create_table is the
+    clean negative (reaches notify through a helper chain)."""
+
+    def __init__(self):
+        self._version = 0
+        self.tables = {}
+
+    def data_version(self, table):
+        return self._version
+
+    def _bump(self, table):
+        self._version += 1
+        self._note(table)
+
+    def _note(self, table):
+        notify_data_change(self, table)
+
+    def create_table(self, name, schema):
+        self.tables[name] = []
+        self._bump(name)                 # OK: two-hop helper chain
+
+    def append(self, name, batch):
+        self.tables[name].append(batch)  # BAD: silent write
+
+    def drop_table(self, name):
+        del self.tables[name]            # BAD: silent write

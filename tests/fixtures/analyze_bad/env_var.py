@@ -1,0 +1,14 @@
+"""Red fixture: undeclared environment-variable reads (registries
+checker, env-var rules)."""
+import os
+
+# unknown-env-var: enforced prefixes, never declared in config.ENV_VARS
+KNOB = os.environ.get("PRESTO_TPU_NOT_A_REAL_KNOB", "0")
+TYPO = os.getenv("BENCH_TYPO_KNOB")
+FORCED = os.environ["PRESTO_TPU_ALSO_UNDECLARED"]
+os.environ.setdefault("BENCH_SETDEFAULT_UNDECLARED", "1")
+
+# clean negatives: a declared engine var, and a foreign var outside
+# the enforced prefixes
+DECLARED = os.environ.get("PRESTO_TPU_LOCKCHECK")
+FOREIGN = os.environ.get("SOME_OTHER_PROJECTS_VAR")

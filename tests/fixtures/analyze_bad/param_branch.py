@@ -1,0 +1,43 @@
+"""Red fixture: expr/params misuse inside jitted code (tracing checker).
+
+The parameter-generic plan cache (serving/template.py) keeps literal
+values OUT of compile keys; a kernel that reads a Param's build-time
+value (``.bound``) or branches on its dispatch-scope traced value
+un-does that — one binding's value bakes into (or specializes) the
+executable every other binding shares.
+"""
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.expr.params import consult, traced_val
+
+SOME_PARAM = object()           # stands in for a captured ir.Param
+
+
+@jax.jit
+def bakes_build_time_value(xs):
+    # param-bound-read: .bound is the value the TEMPLATE was built
+    # against, not this query's binding
+    return xs + SOME_PARAM.bound
+
+
+@jax.jit
+def consults_under_trace(xs):
+    # param-bound-read: consult() is planner-only (records guards)
+    return xs * consult(SOME_PARAM)
+
+
+@jax.jit
+def branches_on_dispatch_value(xs):
+    v = traced_val(SOME_PARAM, 4)
+    if v.data > 0:              # tracer-branch: traced_val is traced
+        return xs
+    return -xs
+
+
+@jax.jit
+def dispatch_scope_used_correctly(xs):
+    # clean negative: the live binding flows as a traced operand into
+    # data-parallel ops — no host decision, no build-time read
+    v = traced_val(SOME_PARAM, 4)
+    return jnp.where(v.data > 0, xs, -xs)

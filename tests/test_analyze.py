@@ -25,7 +25,8 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "analyze_bad")
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-from tools.analyze import CHECKERS, locks, registries, run, tracing  # noqa: E402
+from tools.analyze import (CHECKERS, caches, locks, registries, run,  # noqa: E402
+                           run_changed, tracing)
 from tools.analyze.base import Finding, apply_baseline, load_baseline  # noqa: E402
 
 
@@ -51,7 +52,17 @@ def test_cli_main_exits_zero():
 
 
 def test_every_checker_family_registered():
-    assert set(CHECKERS) == {"tracing", "locks", "registries"}
+    assert set(CHECKERS) == {"tracing", "locks", "registries", "caches"}
+
+
+def test_analyzer_full_scan_stays_fast():
+    # the analyzer polices the tree from inside tier-1; its own cost is
+    # budgeted (tools/check_tier1_time.py --analyzer-budget polices the
+    # module totals, this pins the core scan itself)
+    import time
+    t0 = time.monotonic()
+    run(root=REPO)
+    assert time.monotonic() - t0 < 30.0
 
 
 # -- red fixtures: tracing ---------------------------------------------------
@@ -147,6 +158,198 @@ def test_registries_metric_rules_still_fire():
             """))
         fs = registries.metric_findings([td], REPO, doc_path=None)
     assert _rules(fs) == {"bad-metric-name", "metric-type-conflict"}
+
+
+# -- red fixtures: tracing/params (ISSUE 15 satellite) -----------------------
+
+def test_tracing_catches_param_bound_read():
+    fs = tracing.check_paths([_fixture("param_branch.py")], REPO)
+    bound = [f for f in fs if f.rule == "param-bound-read"]
+    assert {f.symbol.split(".")[-1] for f in bound} == {"bound",
+                                                       "consult"}
+    # traced_val results are tainted: branching on one is a
+    # tracer-branch even though no jit parameter is involved
+    assert any(f.rule == "tracer-branch"
+               and f.symbol.startswith("branches_on_dispatch_value")
+               for f in fs)
+
+
+def test_tracing_dispatch_scope_use_is_clean():
+    fs = tracing.check_paths([_fixture("param_branch.py")], REPO)
+    assert not [f for f in fs
+                if f.symbol.startswith("dispatch_scope_used_correctly")
+                and f.rule != "raw-jit"]
+
+
+# -- red fixtures: caches (ISSUE 15 tentpole) --------------------------------
+
+_BAD_CACHE_SPEC = caches.CacheSpec(
+    name="badcache",
+    module="tests/fixtures/analyze_bad/cache_contract.py",
+    cache_class="BadCache",
+    versions="key",
+    key_fn="key",
+    key_version_param="version",
+    version_recheck_in=("put",),
+    epoch_veto_in=("put",),
+    orchestrations={"cached_value": ("build_plan",)},
+    invalidation_hook=True,
+    bounded_in=("put",),
+)
+
+_BAD_DEPS_SPEC = caches.CacheSpec(
+    name="baddeps",
+    module="tests/fixtures/analyze_bad/cache_contract.py",
+    cache_class=None,
+    lock_attrs=("_lock",),
+    versions="deps",
+    deps_fns=("deps_of",),
+    revalidate_fns=("deps_of",),
+    invalidation_hook=False,
+)
+
+
+def test_caches_catches_every_contract_violation():
+    fs = caches.check_specs([_BAD_CACHE_SPEC, _BAD_DEPS_SPEC], REPO)
+    assert _rules(fs) == {
+        "cache-plain-lock", "cache-key-missing-version",
+        "cache-missing-version-recheck", "cache-missing-epoch-veto",
+        "cache-epoch-after-deps", "cache-missing-invalidation-hook",
+        "cache-unbounded", "cache-missing-deps"}
+
+
+def test_caches_catches_silent_connector_writes():
+    fs = caches.connector_findings(
+        REPO, scan_paths=[_fixture("cache_contract.py")])
+    bad = {f.symbol for f in fs
+           if f.rule == "connector-write-no-notify"}
+    # create_table reaches notify through a two-hop helper chain and
+    # must NOT be flagged; the silent writes must
+    assert bad == {"BadConnector.append", "BadConnector.drop_table"}
+
+
+def test_caches_undeclared_cache_rule_fires():
+    # with an empty registry, every live cache-shaped class is flagged
+    fs = caches._undeclared_findings(REPO, specs=())
+    names = {f.symbol for f in fs if f.rule == "undeclared-cache"}
+    assert {"ScanCache", "PlanCache", "ResultCache",
+            "IdentMemo"} <= names
+    # and with the real registry, none are
+    assert caches._undeclared_findings(REPO, caches.SPECS) == []
+
+
+def test_caches_live_tree_contracts_hold():
+    assert caches.check(REPO) == []
+
+
+# -- red fixtures: env-var registry (ISSUE 15 satellite) ---------------------
+
+def test_registries_catches_undeclared_env_vars():
+    fs = registries.env_var_findings(
+        REPO, scan_paths=[_fixture("env_var.py")],
+        doc_path="/nonexistent", two_way=False)
+    assert {f.symbol for f in fs} == {
+        "PRESTO_TPU_NOT_A_REAL_KNOB", "BENCH_TYPO_KNOB",
+        "PRESTO_TPU_ALSO_UNDECLARED", "BENCH_SETDEFAULT_UNDECLARED"}
+    assert _rules(fs) == {"unknown-env-var"}
+
+
+def test_registries_env_vars_round_trip_on_live_tree():
+    assert registries.env_var_findings(REPO) == []
+
+
+def test_registries_env_var_doc_drift_detected(tmp_path):
+    doc = tmp_path / "doc.md"
+    doc.write_text("## Environment-variable registry\n\n"
+                   "| variable | description |\n|---|---|\n"
+                   "| `PRESTO_TPU_LOCKCHECK` | real |\n"
+                   "| `PRESTO_TPU_IMAGINARY` | drifted |\n")
+    fs = registries.env_var_findings(REPO, doc_path=str(doc))
+    drift = {f.symbol for f in fs if f.rule == "env-var-doc-drift"}
+    assert "PRESTO_TPU_IMAGINARY" in drift        # documented, unknown
+    assert "PRESTO_TPU_LOG" in drift              # declared, undocumented
+
+
+# -- CLI modes (ISSUE 15 satellite) ------------------------------------------
+
+def test_cli_json_format_shape():
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+    from tools.analyze.__main__ import main
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["--format", "json"])
+    doc = _json.loads(buf.getvalue())
+    assert rc == 0 and doc["ok"] is True
+    assert doc["mode"] == "full"
+    assert doc["findings"] == [] and doc["stale_suppressions"] == []
+
+
+def test_run_changed_scopes_to_given_files():
+    # a changed file in the tracing scope is scanned; stale detection
+    # is skipped by contract
+    findings, _sup, stale = run_changed(
+        ["presto_tpu/exec/fused.py", "presto_tpu/serving/plancache.py"],
+        root=REPO)
+    assert findings == [] and stale == []
+
+
+def test_run_changed_inherited_spec_alone_is_clean():
+    # the templates spec delegates lock/dep/veto clauses to plancache;
+    # a delta containing ONLY template.py must not re-check them
+    # against template.py (regression: false cache-plain-lock)
+    findings, _sup, _st = run_changed(
+        ["presto_tpu/serving/template.py"], root=REPO)
+    assert findings == []
+
+
+def test_run_changed_config_keys_scoped_to_their_files():
+    # scancache reads scan_threads/scan_prefetch_depth off a session
+    # OPTIONS dict via props.get — not config keys; the fast path must
+    # not widen config_key_findings past its full-scan file set
+    findings, _sup, _st = run_changed(
+        ["presto_tpu/exec/scancache.py"], root=REPO)
+    assert findings == []
+
+
+def test_run_changed_runs_undeclared_cache_sweep():
+    # the sweep accepts explicit paths (the fast-mode wiring) and still
+    # catches a cache-shaped class missing from the registry
+    fs = caches._undeclared_findings(
+        REPO, specs=(), scan_paths=[_fixture("cache_contract.py")])
+    assert {f.symbol for f in fs
+            if f.rule == "undeclared-cache"} == {"BadCache"}
+
+
+def test_run_changed_falls_back_on_global_inputs():
+    # touching a declaring input (config.py) escalates to the full
+    # two-way scan — which is green on the live tree
+    findings, _sup, stale = run_changed(
+        ["presto_tpu/config.py"], root=REPO)
+    assert findings == [] and stale == []
+
+
+def test_check_tier1_time_analyzer_budget(tmp_path):
+    import subprocess
+    log = tmp_path / "t1.log"
+    log.write_text(
+        "12.00s call  tests/test_analyze.py::test_x\n"
+        "9.00s call   tests/test_interleave.py::test_y\n"
+        "1.00s call   tests/test_sql.py::test_z\n")
+    ok = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_tier1_time.py"),
+         str(log), "--analyzer-budget", "30"],
+        capture_output=True, text=True)
+    assert ok.returncode == 0 and "ANALYZER" in ok.stdout
+    over = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_tier1_time.py"),
+         str(log), "--analyzer-budget", "15"],
+        capture_output=True, text=True)
+    assert over.returncode == 1
+    assert "ANALYZER OVER BUDGET" in over.stderr
 
 
 # -- baseline machinery ------------------------------------------------------

@@ -626,3 +626,16 @@ def test_concurrent_stress_parity_and_fairness():
         assert lockcheck.GRAPH.check() == [], lockcheck.GRAPH.check()
     finally:
         srv.stop()
+
+
+def test_serving_suite_lock_graph_clean():
+    """End-of-suite assertion (ISSUE 15): the serving plane's locks —
+    plan cache, producer pool, query page/state, resource-group
+    manager/memory, group registry — are `checked_lock`s, so every
+    edge this module's admission/scheduling/batching stress recorded is
+    in the process graph; it must hold no cycle, no jit dispatch under
+    a lock, and no guarded-field violation. Defined last: pytest runs
+    in definition order."""
+    from presto_tpu._devtools import lockcheck
+    assert lockcheck.ENABLED
+    assert lockcheck.GRAPH.check() == [], lockcheck.GRAPH.check()

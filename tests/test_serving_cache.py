@@ -463,3 +463,15 @@ def test_cluster_template_and_result_cache_parity():
     finally:
         for w in workers:
             w.stop()
+
+
+def test_serving_cache_suite_lock_graph_clean():
+    """End-of-suite assertion (ISSUE 15): the template/result cache
+    locks are `checked_lock`s, so everything this module exercised —
+    template builds, result-cache hits/partials, cluster parity —
+    recorded real acquisition edges; the observed graph must hold no
+    cycle, no jit dispatch under a lock, and no guarded-field
+    violation. Defined last: pytest runs in definition order."""
+    from presto_tpu._devtools import lockcheck
+    assert lockcheck.ENABLED
+    assert lockcheck.GRAPH.check() == [], lockcheck.GRAPH.check()

@@ -43,14 +43,23 @@ from .base import (Finding, add_parents, ancestors, dotted,
 CHECKER = "locks"
 
 #: the threaded subsystems (ISSUE 7 tentpole scope) + exec/runner.py,
-#: whose _state_lock the cluster plane acquires
+#: whose _state_lock the cluster plane acquires, + the serving caches
+#: (ISSUE 15: they postdated the original scope and were invisible to
+#: the static graph). serving/resultcache.py and server/protocol.py
+#: stay runtime-validated only: the module-level self-locking RESULTS
+#: object and the deliberately-daemon producer pool trip the crude
+#: lexical rules here, while their checked locks feed the runtime
+#: graph regardless.
 SCOPE = ("presto_tpu/exec/scancache.py",
          "presto_tpu/exec/local_exchange.py",
          "presto_tpu/exec/taskexec.py",
          "presto_tpu/exec/cluster.py",
          "presto_tpu/exec/runner.py",
          "presto_tpu/obs/metrics.py",
-         "presto_tpu/obs/history.py")
+         "presto_tpu/obs/history.py",
+         "presto_tpu/serving/plancache.py",
+         "presto_tpu/serving/template.py",
+         "presto_tpu/serving/groups.py")
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock",
                "checked_lock", "checked_rlock"}

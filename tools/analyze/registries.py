@@ -22,6 +22,14 @@ round-trip against the code:
   read off parsed ``*.properties`` dicts in config.py / plugin.py /
   connectors must be declared (``session.*``-style prefixes
   supported).
+- **environment variables** (``presto_tpu/config.py`` ENV_VARS): every
+  ``os.environ.get/[...]/setdefault`` / ``os.getenv`` read of a
+  ``PRESTO_TPU_*`` or ``BENCH_*`` name anywhere in the engine, tools,
+  or bench must resolve to a declared entry; declared entries must be
+  read somewhere; and the table in docs/static_analysis.md round-trips
+  two-way like the metric families. An undeclared env knob is the
+  worst registry typo: it "works" on the machine that exports it and
+  silently does nothing anywhere else.
 
 All checks are AST/regex static — no engine import.
 """
@@ -313,8 +321,13 @@ def session_prop_uses(paths: Sequence[str], root: str
 def session_prop_findings(root: str,
                           scan_paths: Optional[Sequence[str]] = None,
                           config_path: Optional[str] = None,
-                          doc_path: Optional[str] = None
+                          doc_path: Optional[str] = None,
+                          two_way: bool = True
                           ) -> List[Finding]:
+    """``two_way=False`` (the --changed fast path) checks only the
+    use->declaration direction: a partial scan can prove an unknown
+    read, but would falsely report every unscanned declaration as
+    unused and every doc row as drift."""
     config_path = config_path or os.path.join(root, CONFIG_PY)
     declared = declared_session_props(config_path)
     paths = (list(scan_paths) if scan_paths is not None
@@ -330,6 +343,8 @@ def session_prop_findings(root: str,
                 f"session property {name!r} is read here but never "
                 f"declared in config.SESSION_PROPERTIES — the read "
                 f"can only ever see its hardcoded default"))
+    if not two_way:
+        return out
     cfg_rel = rel(config_path, root)
     for name, line in sorted(declared.items()):
         if name not in used_names:
@@ -414,7 +429,8 @@ def failpoint_hits(paths: Sequence[str], root: str
 def failpoint_findings(root: str,
                        scan_paths: Optional[Sequence[str]] = None,
                        failpoints_path: Optional[str] = None,
-                       doc_path: Optional[str] = None
+                       doc_path: Optional[str] = None,
+                       two_way: bool = True
                        ) -> List[Finding]:
     failpoints_path = failpoints_path \
         or os.path.join(root, FAILPOINTS_PY)
@@ -432,6 +448,8 @@ def failpoint_findings(root: str,
                 f"FAILPOINTS.hit({name!r}) names a site missing from "
                 f"failpoints.SITES — configure() would reject arming "
                 f"it, so it can never fire"))
+    if not two_way:
+        return out
     fp_rel = rel(failpoints_path, root)
     for name, line in sorted(declared.items()):
         if name not in hit_names:
@@ -506,6 +524,101 @@ def config_key_findings(root: str,
     return out
 
 
+# -- environment variables ---------------------------------------------------
+
+#: reads of names with these prefixes must resolve to an ENV_VARS entry
+ENV_ENFORCED_PREFIXES = ("PRESTO_TPU_", "BENCH_")
+
+#: where env vars are read (the production surface; tests may export
+#: whatever their harness needs)
+ENV_SCAN = ("presto_tpu", "tools", "bench.py", "__graft_entry__.py")
+
+
+def declared_env_vars(config_path: str) -> Dict[str, int]:
+    """ENV_VARS = {"NAME": "doc"} -> lineno."""
+    return _module_dict_keys(config_path, "ENV_VARS")
+
+
+def env_var_reads(paths: Sequence[str], root: str
+                  ) -> List[Tuple[str, str, int]]:
+    """[(name, rpath, lineno)] for ``os.environ.get("X")`` /
+    ``os.environ["X"]`` / ``os.environ.setdefault("X", ...)`` /
+    ``os.getenv("X")`` literal sites."""
+    out: List[Tuple[str, str, int]] = []
+    for path in paths:
+        tree = parse_file(path)
+        if tree is None:
+            continue
+        rpath = rel(path, root)
+        for node in ast.walk(tree):
+            name = None
+            if isinstance(node, ast.Call) and node.args:
+                fname = dotted(node.func) or ""
+                if fname in ("os.getenv", "getenv"):
+                    name = str_const(node.args[0])
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("get", "setdefault", "pop") \
+                        and (dotted(node.func.value) or "") \
+                        .endswith("environ"):
+                    name = str_const(node.args[0])
+            elif isinstance(node, ast.Subscript) \
+                    and (dotted(node.value) or "").endswith("environ"):
+                name = str_const(node.slice)
+            if name:
+                out.append((name, rpath, node.lineno))
+    return out
+
+
+def env_var_findings(root: str,
+                     scan_paths: Optional[Sequence[str]] = None,
+                     config_path: Optional[str] = None,
+                     doc_path: Optional[str] = None,
+                     two_way: bool = True) -> List[Finding]:
+    config_path = config_path or os.path.join(root, CONFIG_PY)
+    declared = declared_env_vars(config_path)
+    paths = (list(scan_paths) if scan_paths is not None
+             else sorted(set(walk_py(root, ENV_SCAN))))
+    reads = env_var_reads(paths, root)
+    out: List[Finding] = []
+    read_names: Set[str] = set()
+    for name, rpath, line in reads:
+        read_names.add(name)
+        if name.startswith(ENV_ENFORCED_PREFIXES) \
+                and name not in declared:
+            out.append(Finding(
+                CHECKER, "unknown-env-var", rpath, line, name,
+                f"environment variable {name!r} is read here but not "
+                f"declared in config.ENV_VARS — an exported knob "
+                f"nobody can discover, or a typo that silently reads "
+                f"nothing"))
+    if not two_way:
+        return out
+    cfg_rel = rel(config_path, root)
+    for name, line in sorted(declared.items()):
+        if name not in read_names:
+            out.append(Finding(
+                CHECKER, "unused-env-var", cfg_rel, line, name,
+                f"environment variable {name!r} is declared but no "
+                f"code reads it — exporting it does nothing"))
+
+    doc = doc_path if doc_path is not None \
+        else os.path.join(root, ANALYSIS_DOC)
+    if os.path.isfile(doc):
+        doc_rel = rel(doc, root)
+        documented = doc_table_tokens(doc, "## Environment-variable")
+        for name in sorted(set(declared) - documented):
+            out.append(Finding(
+                CHECKER, "env-var-doc-drift", doc_rel, 1, name,
+                f"declared environment variable {name!r} missing from "
+                f"the table in {doc_rel}"))
+        for name in sorted(documented - set(declared)):
+            out.append(Finding(
+                CHECKER, "env-var-doc-drift", doc_rel, 1, name,
+                f"{doc_rel} documents unknown environment variable "
+                f"{name!r}"))
+    return out
+
+
 # -- entry point -------------------------------------------------------------
 
 def check(root: str) -> List[Finding]:
@@ -516,4 +629,5 @@ def check(root: str) -> List[Finding]:
     out.extend(session_prop_findings(root))
     out.extend(failpoint_findings(root))
     out.extend(config_key_findings(root))
+    out.extend(env_var_findings(root))
     return out

@@ -24,6 +24,17 @@ until the shapes change:
   Async dispatch makes an unbracketed sync a stall nobody can see in
   the trace viewer; the engine's rule since PR 1 is that every
   deliberate device round-trip is a span.
+- ``param-bound-read`` — reading ``ir.Param.bound`` (or calling
+  ``expr/params.consult``) inside a jitted body. ``.bound`` is the
+  BUILD-time literal the template was planned against; under trace it
+  bakes that one binding's value into the shared executable, so every
+  later binding silently reuses it (the exact staleness the
+  parameter-generic plan cache exists to avoid). Dispatch-scope reads
+  are the trace-safe channel: ``params.traced_val``/``current_args``
+  deliver the LIVE binding as a traced operand — their results are
+  tainted like any traced value, so host-branching on them still trips
+  ``tracer-branch``; ``consult`` is planner-only (it records template
+  reuse guards and must never run under trace).
 
 Taint model (deliberately intraprocedural): the parameters of a jitted
 function are traced; names assigned from traced expressions become
@@ -64,6 +75,10 @@ _CONCRETIZING_CASTS = {"bool", "int", "float"}
 
 #: nondeterministic call prefixes (host-evaluated at trace time)
 _NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+
+#: expr/params.py dispatch-scope reads: their RESULT is a traced value
+#: (the live binding as a jit operand), so taint flows through them
+_PARAM_TRACED_CALLS = {"traced_val", "current_args"}
 
 
 def _is_jit_call(node: ast.Call) -> bool:
@@ -187,6 +202,10 @@ class _TaintWalk:
             if name in ("len", "isinstance", "type", "getattr",
                         "hasattr"):
                 return False
+            if name and name.split(".")[-1] in _PARAM_TRACED_CALLS:
+                # params.traced_val/current_args deliver the live
+                # binding as a traced operand regardless of arg taint
+                return True
             # conservative: a call over traced args returns traced
             return any(self._expr_tainted(a) for a in node.args)
         if isinstance(node, (ast.Tuple, ast.List)):
@@ -265,6 +284,28 @@ class _TaintWalk:
                          f"{name}() inside jitted function {symbol!r} "
                          f"runs once at trace time and freezes into "
                          f"the executable", name)
+                elif name and name.split(".")[-1] == "consult":
+                    emit("param-bound-read", node,
+                         f"params.consult() inside jitted function "
+                         f"{symbol!r} — consult is planner-only (it "
+                         f"records template reuse guards); kernels "
+                         f"must take the binding as a traced operand "
+                         f"via traced_val/current_args", "consult")
+            elif isinstance(node, ast.Attribute) \
+                    and node.attr == "bound" \
+                    and isinstance(node.ctx, ast.Load) \
+                    and not (isinstance(getattr(node, "parent", None),
+                                        ast.Call)
+                             and node.parent.func is node):
+                # `.bound` VALUE read (a `.bound(...)` method call is
+                # the params.bound binding scope, a different thing)
+                emit("param-bound-read", node,
+                     f".bound read inside jitted function {symbol!r} "
+                     f"bakes the BUILD-time binding into the shared "
+                     f"executable — every later binding of this "
+                     f"template would silently reuse it; read the "
+                     f"live value via traced_val/current_args",
+                     "bound")
         return out
 
 

@@ -39,6 +39,14 @@ def main(argv=None) -> int:
                     help="factor for unattributed overhead (default 1.3)")
     ap.add_argument("--top", type=int, default=0,
                     help="print only the N slowest modules")
+    ap.add_argument("--analyzer-budget", type=float, default=90.0,
+                    help="cap (seconds) for the static-analysis plane's "
+                         "own tier-1 cost — the analyzer modules "
+                         "(default 90)")
+    ap.add_argument("--analyzer-modules", default=
+                    "test_analyze,test_interleave",
+                    help="comma-separated modules charged against "
+                         "--analyzer-budget")
     args = ap.parse_args(argv)
 
     per_module: dict = defaultdict(float)
@@ -64,11 +72,26 @@ def main(argv=None) -> int:
     print(f"{'TOTAL':<{width}}  {total:8.1f}s  (projected "
           f"~{projected:.0f}s with x{args.safety} overhead; "
           f"budget {args.budget:.0f}s)")
+    rc = 0
+    # the verification plane polices the tree, so it gets its own leash:
+    # a checker or interleaving suite that quietly grows past its
+    # budget is stealing wall-clock from the tests it exists to protect
+    analyzer_mods = [m.strip() for m in args.analyzer_modules.split(",")
+                     if m.strip()]
+    analyzer_s = sum(per_module.get(m, 0.0) for m in analyzer_mods)
+    print(f"{'ANALYZER':<{width}}  {analyzer_s:8.1f}s  "
+          f"({'+'.join(analyzer_mods)}; budget "
+          f"{args.analyzer_budget:.0f}s)")
+    if analyzer_s > args.analyzer_budget:
+        print(f"ANALYZER OVER BUDGET ({analyzer_s:.1f}s > "
+              f"{args.analyzer_budget:.0f}s): trim the checker scope "
+              f"or the interleaving schedule caps", file=sys.stderr)
+        rc = 1
     if projected > args.budget:
         print(f"OVER BUDGET: mark the slowest modules @pytest.mark.slow "
               f"or split them", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":
