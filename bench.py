@@ -1441,9 +1441,21 @@ def main_multichip() -> None:
             rps = total_rows / secs
             metric = (f"multichip_{catalog}_sf{sf:g}_{name}"
                       f"_n{n}_rows_per_sec")
-            results.append({"metric": metric, "value": round(rps),
-                            "unit": "rows/s", "devices": n,
-                            "wall_s": round(secs, 4)})
+            rec = {"metric": metric, "value": round(rps),
+                   "unit": "rows/s", "devices": n,
+                   "wall_s": round(secs, 4)}
+            if n > 1:
+                # flight-recorder attribution for the timed run
+                # (obs/flight.py): the pin carries WHERE the wall went
+                # — tools/mesh_report.py diffs pins bucket-by-bucket
+                # and check_bench_regression enforces bucket budgets,
+                # so a re-pin must prove overhead moved, not just
+                # rows/s
+                from presto_tpu.obs.flight import FLIGHTS
+                fl = FLIGHTS.last()
+                if fl is not None and fl.attribution is not None:
+                    rec["attribution"] = fl.attribution
+            results.append(rec)
             if n == 1:
                 base_rps = rps
             elif base_rps:

@@ -133,6 +133,12 @@ _sp("mesh_execution", "varchar", "auto",
 _sp("mesh_devices", "integer", 0,
     "devices in the execution mesh (0 = every visible device); 1 "
     "behaves like mesh_execution=off under auto")
+_sp("mesh_flight", "boolean", True,
+    "mesh flight recorder (obs/flight.py): record every exchange "
+    "round of a mesh-path query (dispatch, staging, control sync, "
+    "repartition, stall) for the post-query wall-clock attribution "
+    "surfaced in EXPLAIN ANALYZE, system.runtime.mesh_rounds and the "
+    "mesh_attr_* metric families; off skips recording entirely")
 _sp("plan_template_cache", "boolean", False,
     "fingerprint the PARAMETERIZED statement shape (literals "
     "hole-punched) so a fleet of bindings shares one optimized plan + "
@@ -331,6 +337,9 @@ ENV_VARS: Dict[str, str] = {
     "PRESTO_TPU_MESH_EXECUTION": "environment default for the "
                                  "mesh_execution session property "
                                  "(auto/on/off; tests pin off)",
+    "PRESTO_TPU_MESH_FLIGHT": "environment default for the "
+                              "mesh_flight session property "
+                              "(on/off; default on)",
     "PRESTO_TPU_FAILPOINTS": "failpoint arming spec applied at import "
                              "(exec/failpoints.py grammar)",
     "BENCH_REPIN": "allow bench.py to overwrite pinned proxy seconds",

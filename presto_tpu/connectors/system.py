@@ -84,7 +84,18 @@ _SCHEMAS: Dict[str, List] = {
         ("elapsed_ms", T.DOUBLE), ("cpu_ms", T.DOUBLE),
         ("device_sync_ms", T.DOUBLE), ("planning_ms", T.DOUBLE),
         ("peak_memory_bytes", T.BIGINT), ("rows", T.BIGINT),
-        ("mode", V), ("plan_summary", V), ("retries", T.BIGINT)],
+        ("mode", V), ("plan_summary", V), ("retries", T.BIGINT),
+        ("mesh_rounds", T.BIGINT), ("mesh_dominant_bucket", V),
+        ("mesh_overhead_ms", T.DOUBLE), ("mesh_buckets", V)],
+    # mesh flight recorder (obs/flight.py): one row per exchange round
+    # of the most recent mesh-path queries — the SQL-queryable form of
+    # the EXPLAIN ANALYZE "Mesh rounds" section (same row shape:
+    # flight.ROUND_COLUMNS)
+    "mesh_rounds": [
+        ("query_id", V), ("round", T.BIGINT), ("stage", T.BIGINT),
+        ("kind", V), ("bucket", V), ("t_start", T.DOUBLE),
+        ("wall_s", T.DOUBLE), ("rows", T.BIGINT), ("bytes", T.BIGINT),
+        ("loads", V), ("blocking", T.BOOLEAN)],
     "operator_stats": [
         ("query_id", V), ("operator", V), ("rows", T.BIGINT),
         ("batches", T.BIGINT), ("wall_ms", T.DOUBLE),
@@ -281,8 +292,15 @@ class SystemConnector(Connector):
                      int(r.get("peak_memory_bytes") or 0),
                      int(r.get("rows") or 0),
                      r.get("mode", ""), r.get("plan_summary", ""),
-                     int(r.get("retries") or 0))
+                     int(r.get("retries") or 0),
+                     int(r.get("mesh_rounds") or 0),
+                     r.get("mesh_dominant_bucket"),
+                     float(r.get("mesh_overhead_ms") or 0.0),
+                     r.get("mesh_buckets"))
                     for r in HISTORY.snapshot()]
+        if table == "mesh_rounds":
+            from ..obs.flight import FLIGHTS
+            return FLIGHTS.rows()
         if table == "operator_stats":
             from ..obs.history import HISTORY
             out = []

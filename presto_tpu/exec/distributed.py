@@ -26,8 +26,10 @@ globally-sharded batch with equal per-shard capacity.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -45,6 +47,7 @@ from .. import types as T
 from ..batch import Batch, Column, Schema, bucket_capacity, concat_batches
 from ..expr import ir
 from ..expr.compiler import compile_filter, compile_projection
+from ..obs import flight as _flight
 from ..obs.metrics import REGISTRY
 from ..obs.trace import TRACER
 from ..ops.aggregation import AggSpec, global_aggregate, grouped_aggregate
@@ -77,6 +80,51 @@ _MESH_RESPLITS = REGISTRY.counter("mesh_repartition_resplit_total")
 _MESH_CACHE: Dict[int, jax.sharding.Mesh] = {}
 
 
+class _FlightDispatch:
+    """Wraps an ``_smap`` executable so every host-side dispatch lands
+    as one flight-recorder round (obs/flight.py). One contextvar load
+    on the no-flight path; call semantics are untouched."""
+
+    __slots__ = ("entry", "kind")
+
+    def __init__(self, entry, kind: str):
+        self.entry = entry
+        self.kind = kind
+
+    def __call__(self, *args):
+        fl = _flight.current_flight()
+        if fl is None:
+            return self.entry(*args)
+        t0 = time.perf_counter()
+        out = self.entry(*args)
+        fl.record(self.kind, wall=time.perf_counter() - t0)
+        return out
+
+
+def _batch_row_bytes(batch: Batch) -> int:
+    """Rough per-row wire width (column storage + validity + mask) —
+    sizes the flight recorder's bytes-moved estimate for an exchange
+    round without touching device data."""
+    return sum(c.data.dtype.itemsize + 1 for c in batch.columns) + 1
+
+
+@contextlib.contextmanager
+def _sync_record(what: str, kind: str = "sync"):
+    """A ``device-sync`` trace span that ALSO records the host-blocking
+    interval as a flight round (the control_sync/staging/drain buckets
+    of the mesh attribution). The executable dispatched inside one of
+    these intervals must be built with ``flight_kind=None`` so its wall
+    isn't counted twice."""
+    fl = _flight.current_flight()
+    t0 = time.perf_counter() if fl is not None else 0.0
+    try:
+        with TRACER.span("device-sync", what=what):
+            yield
+    finally:
+        if fl is not None:
+            fl.record(kind, wall=time.perf_counter() - t0)
+
+
 def mesh_mode(session) -> str:
     """Resolved ``mesh_execution`` mode: the session property when set,
     else the ``PRESTO_TPU_MESH_EXECUTION`` environment default, else
@@ -86,6 +134,19 @@ def mesh_mode(session) -> str:
     if v is None:
         v = os.environ.get("PRESTO_TPU_MESH_EXECUTION", "auto")
     return str(v).lower()
+
+
+def mesh_flight_on(session) -> bool:
+    """Resolved ``mesh_flight`` switch: the session property when set,
+    else the ``PRESTO_TPU_MESH_FLIGHT`` environment default, else on —
+    the recorder is cheap enough (asserted <1% in tests) to fly every
+    mesh query."""
+    v = session.properties.get("mesh_flight")
+    if v is None:
+        return os.environ.get(
+            "PRESTO_TPU_MESH_FLIGHT", "on").lower() \
+            not in ("off", "0", "false")
+    return bool(v)
 
 
 def mesh_device_count(session) -> int:
@@ -239,6 +300,7 @@ class _PartitionMap:
         re-assign."""
         if not self.adaptive:
             return
+        t0 = time.perf_counter()
         self._totals += counts.sum(axis=0, dtype=np.int64)
         if self.changes >= self.MAX_CHANGES:
             return
@@ -259,6 +321,11 @@ class _PartitionMap:
         self.epoch += 1
         self.changes += 1
         _MESH_RESPLITS.inc()
+        fl = _flight.current_flight()
+        if fl is not None:
+            fl.record("resplit", wall=time.perf_counter() - t0,
+                      rows=int(self._totals.sum()),
+                      loads=[int(x) for x in new_loads])
 
     def _greedy(self) -> Tuple[int, ...]:
         """LPT: heaviest bucket first onto the least-loaded shard."""
@@ -287,7 +354,8 @@ class _Repartitioner:
         self.keys = tuple(key_cols)
         self.map = pmap
         self._counts_fn = ex._smap(
-            lambda b: partition_counts(b, self.keys, pmap.buckets), 1)
+            lambda b: partition_counts(b, self.keys, pmap.buckets), 1,
+            flight_kind=None)
         self._fns: Dict[Tuple, object] = {}
         self._last_counts: Optional[np.ndarray] = None
 
@@ -296,12 +364,14 @@ class _Repartitioner:
         return self.map.epoch
 
     def _counts(self, batch: Batch) -> np.ndarray:
-        with TRACER.span("device-sync", what="exchange-quota"):
+        with _sync_record("exchange-quota"):
             raw = np.asarray(jax.device_get(self._counts_fn(batch)))
         return raw.reshape(self.ex.n, self.map.buckets)
 
     def _ship(self, batch: Batch, counts: np.ndarray) -> Batch:
         from .failpoints import FAILPOINTS
+        fl = _flight.current_flight()
+        t0 = time.perf_counter()
         FAILPOINTS.hit("mesh.repartition")
         assign = self.map.assign
         quota = bucket_capacity(
@@ -313,9 +383,21 @@ class _Repartitioner:
             fn = self._fns[key] = self.ex._smap(
                 lambda b, _a=assign, _q=quota:
                 repartition_by_buckets_compact(
-                    b, self.keys, self.ex.axis, self.ex.n, _a, _q), 1)
+                    b, self.keys, self.ex.axis, self.ex.n, _a, _q), 1,
+                flight_kind=None)
         REGISTRY.counter("exchange_repartitions_total").inc()
-        return fn(batch)
+        out = fn(batch)
+        if fl is not None:
+            # per-dest row loads under the CURRENT assignment: the
+            # round's straggler signal for the critical path
+            loads = np.zeros(self.ex.n, dtype=np.int64)
+            np.add.at(loads, np.asarray(assign),
+                      counts.sum(axis=0, dtype=np.int64))
+            rows = int(loads.sum())
+            fl.record("repartition", wall=time.perf_counter() - t0,
+                      rows=rows, nbytes=rows * _batch_row_bytes(batch),
+                      loads=[int(x) for x in loads])
+        return out
 
     def __call__(self, batch: Batch) -> Batch:
         counts = self._counts(batch)
@@ -365,7 +447,8 @@ class DistributedExecutor(_Executor):
         return Batch(batch.schema, cols, put(batch.row_mask))
 
     def _smap(self, fn, n_in: int, replicated_in: Sequence[int] = (),
-              n_out: int = 1, replicated_out: bool = False):
+              n_out: int = 1, replicated_out: bool = False,
+              flight_kind: Optional[str] = "dispatch"):
         in_specs = tuple(
             P() if i in replicated_in else P(self.axis)
             for i in range(n_in))
@@ -392,18 +475,25 @@ class DistributedExecutor(_Executor):
         code = getattr(fn, "__code__", None)
         site = ((code.co_filename, code.co_firstlineno)
                 if code is not None else id(fn))
-        return _TimedEntry(
+        entry = _TimedEntry(
             f"smap:{label.split('.<locals>.')[-1]}",
             jax.jit(shard_map(
                 fn, mesh=self.mesh, in_specs=in_specs,
                 out_specs=out_specs, **{_SHARD_MAP_CHECK_KW: False})),
             (site, in_specs, out_specs))
+        # flight recorder: each dispatch is one round record (kind
+        # "dispatch" -> dispatch_overhead; "repartition" for exchange
+        # fns; None when the caller brackets the call in _sync_record)
+        if flight_kind is None:
+            return entry
+        return _FlightDispatch(entry, flight_kind)
 
     def _shard_live_max(self, batch: Batch) -> int:
         """Max live rows on any shard (host sync) — sizes compactions."""
         per = self._smap(
-            lambda b: jnp.sum(b.row_mask, keepdims=True).astype(jnp.int64), 1)
-        with TRACER.span("device-sync", what="shard-live-max"):
+            lambda b: jnp.sum(b.row_mask, keepdims=True).astype(jnp.int64), 1,
+            flight_kind=None)
+        with _sync_record("shard-live-max"):
             counts = np.asarray(jax.device_get(per(batch)))
         return int(counts.max()) if counts.size else 0
 
@@ -475,6 +565,9 @@ class DistributedExecutor(_Executor):
             streams.append(iter(()))
         done = [False] * self.n
         while not all(done):
+            fl = _flight.current_flight()
+            t0 = _time.perf_counter()
+            s0 = fl.kind_wall("stall") if fl is not None else 0.0
             parts: List[Optional[Batch]] = []
             for i, st in enumerate(streams):
                 if done[i]:
@@ -487,6 +580,13 @@ class DistributedExecutor(_Executor):
                     parts.append(None)
             if all(p is None for p in parts):
                 break
+            if fl is not None:
+                # host scan work feeding the mesh: the pull wall minus
+                # the prefetch stalls recorded INSIDE the pulls (those
+                # already landed in the stall bucket)
+                dt = (_time.perf_counter() - t0
+                      - (fl.kind_wall("stall") - s0))
+                fl.record("staging", wall=max(dt, 0.0))
             yield self._assemble(parts, _plan_schema(node))
 
     def _assemble_resident(self, parts: List[Optional[Batch]],
@@ -582,6 +682,8 @@ class DistributedExecutor(_Executor):
         device_get: staging deliberately rounds through the host to
         stack per-shard chunks — one device-sync span brackets the whole round so the stall is observable)."""
         ncols = len(schema)
+        fl = _flight.current_flight()
+        t0 = time.perf_counter()
         with TRACER.span("device-sync", what="scan-stage"):
             for p in parts:
                 if p is None:
@@ -619,6 +721,13 @@ class DistributedExecutor(_Executor):
                 if cap - m.shape[0]:
                     m = np.pad(m, (0, cap - m.shape[0]))
                 masks.append(m)
+        if fl is not None:
+            loads = [int(m.sum()) for m in masks]
+            nbytes = (sum(a.nbytes for lst in datas for a in lst)
+                      + sum(a.nbytes for lst in valids for a in lst)
+                      + sum(m.nbytes for m in masks))
+            fl.record("staging", wall=time.perf_counter() - t0,
+                      rows=sum(loads), nbytes=nbytes, loads=loads)
 
     def _ValuesNode(self, node: ValuesNode) -> Iterator[Batch]:
         for b in super()._ValuesNode(node):
@@ -946,8 +1055,9 @@ class DistributedExecutor(_Executor):
             from ..ops.join import max_multiplicity
             mult_fn = self._smap(
                 lambda pr: max_multiplicity(pr)[None].astype(jnp.int64),
-                1, replicated_in=(0,) if replicated else ())
-            with TRACER.span("device-sync", what="join-multiplicity"):
+                1, replicated_in=(0,) if replicated else (),
+                flight_kind=None)
+            with _sync_record("join-multiplicity"):
                 bound = int(np.asarray(
                     jax.device_get(mult_fn(prepared))).max())
             if bound <= self.SKEW_MATCH_LIMIT:
@@ -961,7 +1071,8 @@ class DistributedExecutor(_Executor):
                     return match_count_max(p, b, lkeys, rkeys,
                                            prepared=pr)[None]
                 count_fn = self._smap(local_count, 3,
-                                      replicated_in=rep_in2)
+                                      replicated_in=rep_in2,
+                                      flight_kind=None)
 
         repart_probe = (None if replicated
                         else self._repartitioner(lkeys, pmap))
@@ -989,7 +1100,7 @@ class DistributedExecutor(_Executor):
             if maxk_static is not None:
                 maxk = maxk_static
             elif count_fn is not None:
-                with TRACER.span("device-sync", what="join-match-count"):
+                with _sync_record("join-match-count"):
                     maxk = bucket_capacity(
                         max(int(np.asarray(jax.device_get(
                             count_fn(probe, build_side,
@@ -1115,21 +1226,21 @@ class DistributedExecutor(_Executor):
         mult_fn = self._smap(
             lambda f: max_multiplicity(
                 build_sorted(f, fkeys))[None].astype(jnp.int64), 1,
-            replicated_in=(0,))
-        with TRACER.span("device-sync", what="semi-multiplicity"):
+            replicated_in=(0,), flight_kind=None)
+        with _sync_record("semi-multiplicity"):
             bound = int(np.asarray(
                 jax.device_get(mult_fn(build_rep))).max())
         res_maxk = (bucket_capacity(max(bound, 1), minimum=1)
                     if bound <= self.SKEW_MATCH_LIMIT else None)
         count_fn = (None if res_maxk is not None else self._smap(
             lambda p, f: match_count_max(p, f, skeys, fkeys)[None], 2,
-            replicated_in=(1,)))
+            replicated_in=(1,), flight_kind=None))
         fns: Dict[int, object] = {}
         for b in self.run(node.source):
             if res_maxk is not None:
                 maxk = res_maxk
             else:
-                with TRACER.span("device-sync", what="semi-match-count"):
+                with _sync_record("semi-match-count"):
                     maxk = bucket_capacity(
                         max(int(np.asarray(jax.device_get(
                             count_fn(b, build_rep))).max()), 1),
@@ -1414,6 +1525,12 @@ class DistributedRunner:
         create_time = _time.time()
         error: Optional[str] = None
         rows = None
+        flight = None
+        fl_token = None
+        if mesh_flight_on(session):
+            flight = _flight.FlightRecorder(
+                qid, int(self.mesh.devices.size))
+            fl_token = _flight.CURRENT_FLIGHT.set(flight)
         try:
             with TRACER.span("query", query_id=qid, user=user,
                              mode="spmd", shards=self.mesh.devices.size):
@@ -1430,7 +1547,7 @@ class DistributedRunner:
                     ex._check_cancel()
                     batches.append(b)
                 ex.check_errors()
-                with TRACER.span("device-sync", what="result-gather"):
+                with _sync_record("result-gather", kind="drain"):
                     rows = [r for b in batches for r in b.to_pylist()]
             return QueryResult(names=[f.name for f in root.fields],
                                types=[f.type for f in root.fields],
@@ -1439,12 +1556,7 @@ class DistributedRunner:
             error = str(e)
             raise
         finally:
-            # the SPMD path has no EventListenerManager; feed the
-            # persistent query history directly so
-            # system.runtime.completed_queries covers all three
-            # executors (with the caller's user for audit attribution,
-            # like the cluster path)
-            HISTORY.add({
+            record = {
                 "query_id": qid, "query": sql.strip(), "user": user,
                 "state": "FAILED" if error is not None else "FINISHED",
                 "error": error, "create_time": create_time,
@@ -1452,4 +1564,14 @@ class DistributedRunner:
                     (_time.perf_counter() - t0) * 1e3, 3),
                 "rows": None if rows is None else len(rows),
                 "mode": "spmd",
-            })
+            }
+            if flight is not None:
+                _flight.CURRENT_FLIGHT.reset(fl_token)
+                attr = flight.finish(_time.perf_counter() - t0)
+                record.update(_flight.history_fields(attr))
+            # the SPMD path has no EventListenerManager; feed the
+            # persistent query history directly so
+            # system.runtime.completed_queries covers all three
+            # executors (with the caller's user for audit attribution,
+            # like the cluster path)
+            HISTORY.add(record)

@@ -120,8 +120,12 @@ def execute_plan(plan: LogicalPlan, session: Session,
                  rows_per_batch: int = 1 << 17, stats=None,
                  collect_rows: bool = True, cancel_event=None,
                  split_restrict=None) -> QueryResult:
+    import time as _time
+
     from ..expr import params as P
+    from ..obs import flight as _flight
     from ..obs.profiler import profiled
+    from ..obs.trace import current_span_ids
     from .taskexec import GLOBAL as scheduler
     # mesh-native execution (the default with >1 device): the SPMD
     # executor shards this plan over the device mesh whenever the
@@ -129,7 +133,9 @@ def execute_plan(plan: LogicalPlan, session: Session,
     # mesh_execution=off pins the single-device path. Split-restricted
     # runs (result-cache incremental delta) stay single-device: the
     # restriction applies at the local scan node.
-    from .distributed import DistributedExecutor, select_mesh
+    from .distributed import (
+        DistributedExecutor, mesh_flight_on, select_mesh,
+    )
     bindings = getattr(session, "param_bindings", None)
     mesh = select_mesh(session, plan) if split_restrict is None else None
     if mesh is not None and bindings:
@@ -168,6 +174,17 @@ def execute_plan(plan: LogicalPlan, session: Session,
     profile_on = (bool_property(session, "profile", False)
                   or (stats is not None
                       and getattr(stats, "count_rows", False)))
+    # mesh flight recorder (obs/flight.py): every mesh-path execution
+    # records its exchange rounds for the post-query wall-clock
+    # attribution, unless mesh_flight=off
+    flight = None
+    fl_token = None
+    if mesh is not None and mesh_flight_on(session):
+        qid = (str(current_span_ids().get("query_id") or "")
+               or f"mesh_{_flight.next_seq():06d}")
+        flight = _flight.FlightRecorder(qid, int(mesh.devices.size))
+        fl_token = _flight.CURRENT_FLIGHT.set(flight)
+    t_flight0 = _time.perf_counter()
     try:
         # template bindings: ir.Param kernels fetch this query's
         # literal values from the scope (exchange driver threads copy
@@ -204,11 +221,22 @@ def execute_plan(plan: LogicalPlan, session: Session,
                 it.close()
             ex.check_errors()
             if collect_rows:
-                rows = [r for b in out_batches for r in b.to_pylist()]
+                if flight is not None:
+                    with flight.timed("drain"):
+                        rows = [r for b in out_batches
+                                for r in b.to_pylist()]
+                else:
+                    rows = [r for b in out_batches
+                            for r in b.to_pylist()]
             return QueryResult(names=[f.name for f in root.fields],
                                types=[f.type for f in root.fields],
                                rows=rows)
     finally:
+        if flight is not None:
+            _flight.CURRENT_FLIGHT.reset(fl_token)
+            flight.finish(_time.perf_counter() - t_flight0)
+            if stats is not None:
+                stats.mesh_flight = flight
         if handle is not None:
             handle.close()
 

@@ -217,7 +217,7 @@ class LocalRunner:
                     planning_ms += dur
                 elif s.get("name") == "device-sync":
                     device_sync_ms += dur
-        return {
+        record = {
             "query_id": entry.query_id, "query": entry.query,
             "user": user, "state": entry.state, "error": entry.error,
             "error_code": error_code, "create_time": entry.create_time,
@@ -231,6 +231,19 @@ class LocalRunner:
             "plan_summary": " -> ".join(by_kind),
             "operators": operators,
         }
+        # mesh-path queries carry the flight recorder's attribution
+        # summary (obs/flight.py) into the persistent history
+        fl = getattr(stats, "mesh_flight", None)
+        if fl is not None:
+            from ..obs.flight import history_fields
+            # re-stamp the runner's query id (the tracer-off fallback
+            # was a synthetic mesh_* id) so mesh_rounds joins against
+            # completed_queries
+            fl.query_id = entry.query_id
+            if fl.attribution is not None:
+                fl.attribution["query_id"] = entry.query_id
+            record.update(history_fields(fl.attribution))
+        return record
 
     def plan(self, sql: str, optimized: bool = True) -> LogicalPlan:
         stmt = parse_statement(sql)
@@ -395,12 +408,15 @@ class LocalRunner:
                 if stats is not None:
                     from ..planner.printer import (
                         format_cost_verdict, format_executables_summary,
-                        format_result_cache_summary,
+                        format_mesh_rounds, format_result_cache_summary,
                         format_scan_cache_summary, format_skew_summary,
                     )
                     skew = format_skew_summary(stats)
                     if skew:
                         text += "\n" + skew
+                    mesh_sec = format_mesh_rounds(stats)
+                    if mesh_sec:
+                        text += "\n" + mesh_sec
                     sc = format_scan_cache_summary(stats)
                     if sc:
                         text += "\n" + sc

@@ -63,6 +63,7 @@ from .._devtools.lockcheck import checked_lock, checked_rlock, guarded_by
 from ..batch import Batch, bucket_capacity
 from ..connectors import spi
 from ..memory import QueryMemoryPool, batch_device_bytes
+from ..obs import flight as _flight
 from ..obs.metrics import REGISTRY
 from .failpoints import FAILPOINTS
 
@@ -456,6 +457,9 @@ def scan_splits(conn, catalog: str, columns: Sequence[str],
         taskexec.GLOBAL.note_stall(dt)
         if stats is not None:
             stats.prefetch_stall_s += dt
+        mfl = _flight.current_flight()
+        if mfl is not None:
+            mfl.record("stall", wall=dt)
         return done and fl.batches is not None
 
     def split_batches(i: int, split) -> Iterator[Batch]:
@@ -626,6 +630,9 @@ def scan_splits(conn, catalog: str, columns: Sequence[str],
                     taskexec.GLOBAL.note_stall(dt)
                     if stats is not None:
                         stats.prefetch_stall_s += dt
+                    mfl = _flight.current_flight()
+                    if mfl is not None:
+                        mfl.record("stall", wall=dt)
                 if item is DONE:
                     break
                 if isinstance(item, BaseException):

@@ -37,6 +37,7 @@ from .metrics import (  # noqa: F401
     NODES, REGISTRY, TASKS, attach_event_listeners,
 )
 from .exposition import parse_exposition, render_exposition  # noqa: F401
+from .flight import FLIGHTS, FlightRecorder, current_flight  # noqa: F401
 from .history import HISTORY, attach_history  # noqa: F401
 from .log import LOG  # noqa: F401
 from .profiler import EXECUTABLES, profiled, sample_hbm  # noqa: F401

@@ -28,12 +28,16 @@ import threading
 from collections import deque
 from typing import Dict, List, Optional
 
-#: flattened columns of system.runtime.completed_queries, in order
+#: flattened columns of system.runtime.completed_queries, in order.
+#: The mesh_* tail is the flight recorder's attribution summary
+#: (obs/flight.history_fields) — NULL/zero for queries that never ran
+#: on the mesh path.
 RECORD_COLUMNS = (
     "query_id", "state", "user", "query", "error", "error_code",
     "create_time", "elapsed_ms", "cpu_ms", "device_sync_ms",
     "planning_ms", "peak_memory_bytes", "rows", "mode", "plan_summary",
-    "retries")
+    "retries", "mesh_rounds", "mesh_dominant_bucket",
+    "mesh_overhead_ms", "mesh_buckets")
 
 
 class QueryHistory:

@@ -492,7 +492,11 @@ def write_merged_trace(path: str, spans: List[Dict],
     """Merge the span tracer's export with whatever device trace(s)
     ``jax.profiler`` wrote under ``profile_dir`` and write one
     Perfetto-loadable JSON file. Missing/unreadable device traces
-    degrade to a host-only trace — the file always lands."""
+    degrade to a host-only trace — the file always lands. Mesh-path
+    queries additionally contribute a "mesh rounds" track (one lane
+    per attribution bucket) from the flight recorder, timestamped on
+    the same epoch-anchored clock as the host spans."""
+    from .flight import FLIGHTS, chrome_events
     from .trace import chrome_trace
     host = chrome_trace(spans)
     device_events: List[Dict] = []
@@ -501,6 +505,8 @@ def write_merged_trace(path: str, spans: List[Dict],
             device_events.extend(load_trace_events(p))
         except Exception:
             continue
+    for fl in FLIGHTS.snapshot():
+        device_events.extend(chrome_events(fl))
     merged = merge_chrome_traces(host, device_events)
     with open(path, "w") as f:
         json.dump(merged, f)

@@ -222,6 +222,62 @@ def format_result_cache_summary(stats) -> str:
             f"{totals['resident_bytes'] / 1048576.0:,.1f} MiB")
 
 
+#: per-round table cap in the EXPLAIN ANALYZE mesh section (the full
+#: timeline stays queryable via system.runtime.mesh_rounds)
+_MESH_ROUND_ROWS = 48
+
+
+def format_mesh_rounds(stats) -> str:
+    """Mesh-rounds section appended to EXPLAIN ANALYZE on mesh-path
+    queries: the flight recorder's wall-clock attribution (bucket
+    seconds + share of wall), the per-shard critical path, and the
+    per-round table — rendered from the SAME row shape as
+    ``system.runtime.mesh_rounds`` (obs/flight.round_rows), so the two
+    surfaces cannot drift. Closes with the dominant-bucket verdict the
+    exchange-overhaul work tunes against. Empty when the query never
+    flew (single-device path or ``mesh_flight=off``)."""
+    fl = getattr(stats, "mesh_flight", None)
+    if fl is None or fl.attribution is None:
+        return ""
+    from ..obs.flight import BUCKETS, round_rows
+    a = fl.attribution
+    wall = max(a["wall_s"], 1e-9)
+    lines = [
+        f"Mesh rounds: {a['rounds']} rounds on {a['n_devices']} "
+        f"device{'s' if a['n_devices'] != 1 else ''}, wall "
+        f"{a['wall_s'] * 1e3:,.1f}ms, {a['reconciled_pct']:.1f}% "
+        f"attributed"]
+    for b in BUCKETS:
+        s = a["buckets"][b]
+        if s:
+            lines.append(f"  {b:<18} {s * 1e3:>10,.1f}ms "
+                         f"{s / wall * 100.0:5.1f}%")
+    cp = a["critical_path"]
+    if cp["per_shard_s"]:
+        lines.append(f"  critical path: shard {cp['slowest_shard']} "
+                     f"({max(cp['per_shard_s']) * 1e3:,.1f}ms)")
+    rows = round_rows(fl.query_id, fl.records())
+    if rows:
+        lines.append("  round stage kind         bucket             "
+                     "wall_ms       rows      bytes loads")
+        for r in rows[:_MESH_ROUND_ROWS]:
+            (_qid, rnd, stage, kind, bucket, _t, wall_s, nrows,
+             nbytes, loads, _blocking) = r
+            lines.append(
+                f"  {rnd:>5} {stage:>5} {kind:<12} {bucket:<18} "
+                f"{wall_s * 1e3:>7,.1f} {nrows:>10} {nbytes:>10} "
+                f"{loads}")
+        if len(rows) > _MESH_ROUND_ROWS:
+            lines.append(
+                f"  ... {len(rows) - _MESH_ROUND_ROWS} more rounds "
+                f"(system.runtime.mesh_rounds has the full timeline)")
+    lines.append(
+        f"Mesh verdict: {a['dominant_bucket']} dominates "
+        f"({a['buckets'][a['dominant_bucket']] / wall * 100.0:.0f}% "
+        f"of wall)")
+    return "\n".join(lines)
+
+
 def format_retry_summary(info) -> str:
     """Fault-tolerance section appended to cluster EXPLAIN ANALYZE:
     task retries, speculative attempts, and the per-event detail the

@@ -339,6 +339,11 @@ def test_registry_evicts_coldest_and_readmits():
 
 
 def test_merge_survives_missing_device_trace(tmp_path):
+    # mesh flights from earlier suites would legitimately add their
+    # "mesh rounds" track to the merge — drain the process-global log
+    # so the missing-device-trace contract is what's measured
+    from presto_tpu.obs.flight import FLIGHTS
+    FLIGHTS.clear()
     out = tmp_path / "merged.json"
     write_merged_trace(str(out), [], str(tmp_path / "nowhere"))
     with open(out) as f:

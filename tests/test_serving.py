@@ -286,12 +286,14 @@ def test_query_queued_timeout():
     class SlowRunner:
         def __init__(self):
             self.gate = threading.Event()
+            self.started = threading.Event()
             from presto_tpu.exec.local import QueryResult
             self._result = QueryResult(["x"], [], [(1,)])
 
         def execute(self, sql, properties=None, user="",
                     cancel_event=None):
             if sql == "slow":
+                self.started.set()
                 self.gate.wait(20)
             return self._result
 
@@ -299,6 +301,10 @@ def test_query_queued_timeout():
     srv = PrestoTpuServer(runner=runner)   # serial default group
     try:
         q1 = srv.create_query("slow", {})
+        # producers run on a shared pool: without this rendezvous q2
+        # can win the serial slot before q1 is admitted (and FINISH
+        # instead of timing out) — wait until q1 actually holds it
+        assert runner.started.wait(10)
         q2 = srv.create_query("fast", {"query_queued_timeout": "0.3s"})
         q2.done.wait(timeout=10)
         assert q2.state == "FAILED"

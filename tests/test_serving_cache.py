@@ -384,14 +384,24 @@ def test_result_cache_eviction_under_limit(file_runner):
               "lineitem where l_orderkey < 200")
     old_limit = RESULTS.pool.limit
     try:
-        RESULTS.set_limit(8 << 10)
+        # order-robust: the cache is process-global, so size the limit
+        # from a MEASURED entry footprint instead of a fixed byte count
+        # (a fixed 8 KiB fails in isolation where 5 small entries fit,
+        # and put() silently rejects any entry larger than the limit)
+        RESULTS.clear()
+        r.execute("select count(*) from memory.ev where k > 0",
+                  properties=RPROPS)
+        size0 = RESULTS.pool.reserved
+        assert size0 > 0
+        limit = int(size0 * 2.5)        # room for 2 entries, never 3
+        RESULTS.set_limit(limit)
         e0 = _metric("result_cache_evicted_total")
-        for lo in (0, 50, 100, 150):
+        for lo in (50, 100, 150):
             r.execute(f"select count(*) from memory.ev where k > {lo}",
                       properties=RPROPS)
-        assert RESULTS.pool.reserved <= 8 << 10
-        assert _metric("result_cache_evicted_total") > e0 \
-            or len(RESULTS) <= 4
+        assert RESULTS.pool.reserved <= limit
+        assert _metric("result_cache_evicted_total") > e0
+        assert len(RESULTS) <= 2
     finally:
         RESULTS.set_limit(old_limit)
 

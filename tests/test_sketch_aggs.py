@@ -200,7 +200,11 @@ def test_distributed_percentile(runner, dist):
         == [(a, float(b)) for a, b in want]
 
 
+@pytest.mark.slow
 def test_distributed_global_percentile(runner, dist):
+    # global (ungrouped) sketch merge across shards; the grouped
+    # distributed path stays tier-1 via test_distributed_percentile —
+    # this single-row parity check costs ~45s of compile, slow lane
     want = runner.execute(
         "select approx_percentile(l_quantity, 0.9) from lineitem").rows
     got = dist.execute(

@@ -322,8 +322,11 @@ def _inside_timed_entry(node: ast.AST) -> bool:
 
 def _inside_sync_span(node: ast.AST) -> bool:
     """Lexically under ``with TRACER.span("device-sync"|"jit-compile",
-    ...)`` or any ``with`` whose context manager comes from the
-    profiler (obs.profiler brackets its own syncs)."""
+    ...)``, under exec/distributed's ``_sync_record(...)`` (a wrapper
+    that opens that exact span AND feeds the mesh flight recorder's
+    control_sync bucket — the bracketing contract holds by
+    construction), or any ``with`` whose context manager comes from
+    the profiler (obs.profiler brackets its own syncs)."""
     for anc in ancestors(node):
         if not isinstance(anc, ast.With):
             continue
@@ -332,6 +335,8 @@ def _inside_sync_span(node: ast.AST) -> bool:
             if not isinstance(ctx, ast.Call):
                 continue
             name = dotted(ctx.func) or ""
+            if name.split(".")[-1] == "_sync_record":
+                return True
             if name.endswith(".span") and ctx.args:
                 s = str_const(ctx.args[0])
                 if s and (s.startswith("device-sync")

@@ -27,7 +27,13 @@ bench.py multichip``): per-query rows/s at each device count plus
 scaling efficiency, all higher-is-better; rounds up to r05 pinned only
 a dry-run exit code (the ``ok`` bool, kept in the summary for
 back-compat) and are not comparable — the gate always discovers the
-LATEST round, so they age out naturally.
+LATEST round, so they age out naturally. Multichip rounds from r07 on
+also carry the flight recorder's per-query ``attribution`` block
+(obs/flight.py); the gate schema-validates every block and enforces
+the per-bucket overhead budgets declared in ``tools/mesh_report.py``,
+so an exchange change that blows the control-sync or repartition
+budget fails even when rows/s noise hides it. Pins without attribution
+(r06 and older) pass the attribution gate vacuously.
 
 Usage:
     python tools/check_bench_regression.py --run bench_out.json
@@ -202,6 +208,19 @@ def compare(baseline: Dict[str, Dict], run: Dict[str, Dict],
             "new": new, "failed": failed}
 
 
+def _attribution_gate(flat: Dict[str, Dict]) -> Dict:
+    """Schema + per-bucket budget verdict for a multichip summary's
+    flight-recorder attribution blocks. The budgets (and the
+    validator) live in tools/mesh_report.py so the diff tool and this
+    gate can never disagree about them."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        from mesh_report import validate_attribution
+    finally:
+        sys.path.pop(0)
+    return validate_attribution(flat)
+
+
 def smoke(baseline_path: str) -> Dict:
     """Self-consistency: the pinned round must pass against itself,
     and a halved copy must fail. Proves discovery, parsing, tolerance
@@ -302,6 +321,20 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(json.dumps({"verdict": "error", "error": str(e)}))
         return 2
+
+    if args.kind == "multichip":
+        # attribution gate: in smoke mode the pinned round itself must
+        # satisfy schema + budgets (so a bad re-pin cannot be
+        # committed); in run mode the candidate must
+        target = baseline_path if args.smoke else args.run
+        try:
+            attr = _attribution_gate(load_summary(target))
+        except (OSError, ValueError) as e:
+            attr = {"blocks": 0, "ok": False, "violations": [
+                {"metric": "*", "kind": "io", "detail": str(e)}]}
+        verdict["attribution"] = attr
+        if not attr["ok"]:
+            verdict["verdict"] = "fail"
 
     text = json.dumps(verdict, indent=2)
     print(text)

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Bucket-level diff of two MULTICHIP pins + per-bucket overhead budgets.
+
+The MULTICHIP pins (``MULTICHIP_OUT=path python bench.py multichip``)
+carry, on every n>1 rows/s record, the flight recorder's ``attribution``
+block (obs/flight.py): wall-clock seconds per bucket
+(``device_compute`` / ``dispatch_overhead`` / ``host_staging`` /
+``control_sync`` / ``repartition`` / ``stall``), the dominant bucket,
+the reconciled fraction, and the per-shard critical path. This tool is
+how a mesh perf PR proves its claim: diff the NEW pin against the OLD
+one bucket-by-bucket, so "q1sql n4 got 1.3x faster" decomposes into
+"repartition dropped 800ms, dispatch unchanged" instead of a bare
+rows/s delta.
+
+It also owns the per-bucket **overhead budgets** — the declared maximum
+share of query wall each overhead bucket may consume on the pinned
+multichip axis. ``check_bench_regression --kind multichip`` imports
+:func:`validate_attribution` so the budgets gate every re-pin: an
+exchange refactor that silently doubles control-sync wall fails the
+gate even if rows/s noise hides it.
+
+Usage:
+    python tools/mesh_report.py MULTICHIP_r06.json MULTICHIP_r07.json
+    python tools/mesh_report.py OLD NEW --json report.json
+
+Pins from rounds before the flight recorder (r06 and older) carry no
+attribution blocks: the diff for those metrics is reported as
+``no attribution`` and the budgets pass vacuously — the tool never
+fails on history it cannot see.
+
+Exit 0 when the NEW pin's attribution passes schema + budgets (or has
+none), 1 on violations, 2 on usage/IO errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: attribution bucket names, display order. Kept as a literal so the
+#: gate can run without importing the engine; tests/test_mesh_flight.py
+#: asserts this matches presto_tpu.obs.flight.BUCKETS.
+BUCKETS = ("device_compute", "dispatch_overhead", "host_staging",
+           "control_sync", "repartition", "stall")
+
+#: per-bucket budget: max share of query wall (percent) an overhead
+#: bucket may consume on the pinned multichip axis. ``device_compute``
+#: is the useful work — never budgeted. ``dispatch_overhead`` on the
+#: forced-CPU pin CONTAINS the device compute (CPU "devices" execute
+#: synchronously inside the dispatch call, see obs/flight.py), so its
+#: budget is deliberately near-total; the buckets with teeth are the
+#: pure host overheads the item-1 exchange overhaul targets.
+BUCKET_BUDGET_PCT: Dict[str, float] = {
+    "dispatch_overhead": 95.0,
+    "host_staging": 80.0,
+    "control_sync": 60.0,
+    "repartition": 85.0,
+    "stall": 60.0,
+}
+
+#: schema of one attribution block (obs/flight.FlightRecorder.finish)
+_REQUIRED = ("query_id", "n_devices", "wall_s", "rounds", "buckets",
+             "dominant_bucket", "reconciled_pct", "overhead_s",
+             "critical_path")
+
+
+def load_pin(path: str) -> Dict[str, Dict]:
+    """{metric: record} from a MULTICHIP pin: a committed ``_r*``
+    wrapper (use its ``parsed``) or a bare ``MULTICHIP_OUT`` summary."""
+    with open(path) as f:
+        doc = json.loads(f.read().strip())
+    if isinstance(doc, dict) and "parsed" in doc:
+        doc = doc["parsed"]
+    out: Dict[str, Dict] = {}
+    if not isinstance(doc, dict) or "metric" not in doc:
+        raise ValueError(f"{path}: not a MULTICHIP summary")
+    out[doc["metric"]] = {k: v for k, v in doc.items()
+                          if k != "sub_metrics"}
+    for sub in doc.get("sub_metrics") or ():
+        if isinstance(sub, dict) and "metric" in sub:
+            out[sub["metric"]] = sub
+    return out
+
+
+def _check_block(metric: str, attr: object,
+                 violations: List[Dict]) -> None:
+    """Schema + budget checks for ONE attribution block; appends any
+    violations (each ``{"metric", "kind", "detail"}``)."""
+
+    def bad(kind: str, detail: str) -> None:
+        violations.append({"metric": metric, "kind": kind,
+                           "detail": detail})
+
+    if not isinstance(attr, dict):
+        return bad("schema", "attribution is not an object")
+    missing = [k for k in _REQUIRED if k not in attr]
+    if missing:
+        return bad("schema", f"missing keys: {', '.join(missing)}")
+    buckets = attr["buckets"]
+    if not isinstance(buckets, dict) or \
+            sorted(buckets) != sorted(BUCKETS):
+        return bad("schema", "buckets must carry exactly "
+                             f"{'/'.join(BUCKETS)}")
+    for b, s in buckets.items():
+        if not isinstance(s, (int, float)) or s < 0:
+            return bad("schema", f"bucket {b} is not a "
+                                 "non-negative number")
+    if attr["dominant_bucket"] not in BUCKETS:
+        bad("schema", f"dominant_bucket {attr['dominant_bucket']!r} "
+                      "is not a bucket")
+    wall = float(attr["wall_s"] or 0.0)
+    if wall <= 0:
+        return bad("schema", "wall_s must be positive")
+    cp = attr["critical_path"]
+    if not isinstance(cp, dict) or \
+            not isinstance(cp.get("per_shard_s"), list) or \
+            len(cp["per_shard_s"]) != int(attr["n_devices"]):
+        bad("schema", "critical_path.per_shard_s must list one entry "
+                      "per device")
+    for b, budget in BUCKET_BUDGET_PCT.items():
+        share = float(buckets.get(b, 0.0)) / wall * 100.0
+        if share > budget:
+            bad("budget", f"{b} at {share:.1f}% of wall exceeds the "
+                          f"{budget:g}% budget")
+
+
+def validate_attribution(flat: Dict[str, Dict]) -> Dict:
+    """Schema-validate + budget-check every attribution block in a
+    flattened pin. Pins without any block pass vacuously (pre-r07
+    history). Returns ``{"blocks", "violations", "ok"}``."""
+    violations: List[Dict] = []
+    blocks = 0
+    for metric in sorted(flat):
+        attr = flat[metric].get("attribution")
+        if attr is None:
+            continue
+        blocks += 1
+        _check_block(metric, attr, violations)
+    return {"blocks": blocks, "violations": violations,
+            "ok": not violations}
+
+
+def diff_pins(old: Dict[str, Dict], new: Dict[str, Dict]) -> List[Dict]:
+    """Per-metric bucket deltas for metrics carrying attribution on
+    either side. ``delta_s`` is new minus old (negative = the bucket
+    got cheaper); sides without attribution diff as None."""
+    rows: List[Dict] = []
+    for metric in sorted(set(old) | set(new)):
+        a_old = (old.get(metric) or {}).get("attribution")
+        a_new = (new.get(metric) or {}).get("attribution")
+        if a_old is None and a_new is None:
+            continue
+        row = {"metric": metric,
+               "old_wall_s": a_old and a_old.get("wall_s"),
+               "new_wall_s": a_new and a_new.get("wall_s"),
+               "buckets": {}}
+        for b in BUCKETS:
+            o = a_old and float(a_old["buckets"].get(b, 0.0))
+            n = a_new and float(a_new["buckets"].get(b, 0.0))
+            row["buckets"][b] = {
+                "old_s": o, "new_s": n,
+                "delta_s": (round(n - o, 6)
+                            if o is not None and n is not None
+                            else None)}
+        if a_new is not None:
+            row["new_dominant"] = a_new.get("dominant_bucket")
+            row["new_reconciled_pct"] = a_new.get("reconciled_pct")
+        rows.append(row)
+    return rows
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v * 1e3:9.1f}"
+
+
+def format_report(rows: List[Dict], verdict: Dict,
+                  old_path: str, new_path: str) -> str:
+    """Human-readable bucket-delta tables, one per metric."""
+    out = [f"mesh report: {os.path.basename(old_path)} -> "
+           f"{os.path.basename(new_path)}"]
+    if not rows:
+        out.append("  no attribution blocks on either side "
+                   "(pre-flight-recorder pins)")
+    for row in rows:
+        wall = (f"wall {_fmt_s(row['old_wall_s']).strip()}ms -> "
+                f"{_fmt_s(row['new_wall_s']).strip()}ms")
+        out.append(f"\n{row['metric']}  ({wall})")
+        out.append(f"  {'bucket':<18} {'old_ms':>9} {'new_ms':>9} "
+                   f"{'delta_ms':>9}")
+        for b in BUCKETS:
+            d = row["buckets"][b]
+            delta = ("-" if d["delta_s"] is None
+                     else f"{d['delta_s'] * 1e3:+9.1f}")
+            out.append(f"  {b:<18} {_fmt_s(d['old_s'])} "
+                       f"{_fmt_s(d['new_s'])} {delta:>9}")
+        if "new_dominant" in row:
+            out.append(f"  dominant: {row['new_dominant']}, "
+                       f"{row['new_reconciled_pct']}% of wall "
+                       "attributed")
+    out.append(f"\nbudgets ({verdict['blocks']} attribution "
+               f"block{'s' if verdict['blocks'] != 1 else ''}): "
+               + ("PASS" if verdict["ok"] else "FAIL"))
+    for v in verdict["violations"]:
+        out.append(f"  {v['metric']}: [{v['kind']}] {v['detail']}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two MULTICHIP pins bucket-by-bucket and "
+                    "enforce per-bucket overhead budgets on the new "
+                    "one")
+    ap.add_argument("old", help="baseline pin (e.g. MULTICHIP_r06.json)")
+    ap.add_argument("new", help="candidate pin (e.g. MULTICHIP_r07.json "
+                                "or a fresh MULTICHIP_OUT file)")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="also write the machine-readable report here")
+    args = ap.parse_args(argv)
+
+    try:
+        old = load_pin(args.old)
+        new = load_pin(args.new)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"verdict": "error", "error": str(e)}))
+        return 2
+
+    rows = diff_pins(old, new)
+    verdict = validate_attribution(new)
+    print(format_report(rows, verdict, args.old, args.new))
+    if args.json:
+        doc = {"old": args.old, "new": args.new, "diff": rows,
+               "budgets": verdict,
+               "verdict": "pass" if verdict["ok"] else "fail"}
+        with open(args.json, "w") as f:
+            f.write(json.dumps(doc, indent=2) + "\n")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,6 +163,12 @@ def profile_query(runner, sql: str, warm_runs: int = 1,
                         "exchange": s.exchange, "keys": list(s.keys),
                         "ops": list(s.ops)} for s in mp.stages],
         }
+        # flight recorder attribution (obs/flight.py): one command
+        # yields both views of a mesh query — per-operator device time
+        # above, per-round wall-clock buckets here
+        fl = getattr(stats, "mesh_flight", None)
+        if fl is not None and fl.attribution is not None:
+            doc["mesh"]["attribution"] = fl.attribution
     return doc
 
 
