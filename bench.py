@@ -1427,8 +1427,10 @@ def main_multichip() -> None:
                   f"at {time.perf_counter() - t_start:.0f}s",
                   file=sys.stderr, flush=True)
             sel0 = selected()
+            disp0 = REGISTRY.value("mesh_dispatches_total")
             got, secs = _time(
                 lambda: runner.execute(sql, properties=props).rows)
+            dispatches = REGISTRY.value("mesh_dispatches_total") - disp0
             if n > 1:
                 assert selected() > sel0, \
                     f"{name} n={n}: mesh path was not selected"
@@ -1445,6 +1447,10 @@ def main_multichip() -> None:
                    "unit": "rows/s", "devices": n,
                    "wall_s": round(secs, 4)}
             if n > 1:
+                # host dispatches the timed run cost: the fused
+                # exchange's ">= 3x fewer dispatches" evidence rides
+                # the pin next to the wall-clock it bought
+                rec["dispatches"] = int(dispatches)
                 # flight-recorder attribution for the timed run
                 # (obs/flight.py): the pin carries WHERE the wall went
                 # — tools/mesh_report.py diffs pins bucket-by-bucket

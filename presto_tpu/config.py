@@ -133,6 +133,17 @@ _sp("mesh_execution", "varchar", "auto",
 _sp("mesh_devices", "integer", 0,
     "devices in the execution mesh (0 = every visible device); 1 "
     "behaves like mesh_execution=off under auto")
+_sp("mesh_fused_exchange", "boolean", True,
+    "fused SPMD exchange (exec/distributed.py): compute + bucket-count "
+    "+ ship collapse into one shard_map program per round, "
+    "stats-bounded aggregation stages batch multiple rounds into a "
+    "single lax.fori_loop dispatch with donated shard buffers, and "
+    "control scalars are fetched once per stage; off is the escape "
+    "hatch back to the per-round host control plane")
+_sp("mesh_fused_loop_rounds", "integer", 32,
+    "cap on chunks one fused lax.fori_loop dispatch may stack "
+    "(bounds resident memory: the stacked wave holds every chunk of "
+    "the wave on device at once); minimum 1")
 _sp("mesh_flight", "boolean", True,
     "mesh flight recorder (obs/flight.py): record every exchange "
     "round of a mesh-path query (dispatch, staging, control sync, "

@@ -95,7 +95,7 @@ _SCHEMAS: Dict[str, List] = {
         ("query_id", V), ("round", T.BIGINT), ("stage", T.BIGINT),
         ("kind", V), ("bucket", V), ("t_start", T.DOUBLE),
         ("wall_s", T.DOUBLE), ("rows", T.BIGINT), ("bytes", T.BIGINT),
-        ("loads", V), ("blocking", T.BOOLEAN)],
+        ("loads", V), ("blocking", T.BOOLEAN), ("rounds", T.BIGINT)],
     "operator_stats": [
         ("query_id", V), ("operator", V), ("rows", T.BIGINT),
         ("batches", T.BIGINT), ("wall_ms", T.DOUBLE),
@@ -168,6 +168,11 @@ class _RowsPageSource(PageSource):
 
     def batches(self) -> Iterator[Batch]:
         idx = [self.schema.names.index(c) for c in self.columns]
+        if not idx:
+            # count(*) prunes every column; the batch must still carry
+            # the row count or the aggregate sees an empty table
+            yield Batch.from_arrays(Schema([]), [], num_rows=len(self.rows))
+            return
         data = {
             self.schema.names[i]: (self.schema.types[i],
                                    [r[i] for r in self.rows])

@@ -28,8 +28,11 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import itertools
 import os
+import threading
 import time
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -74,30 +77,64 @@ _MESH_SELECTED = REGISTRY.counter("mesh_path_selected_total")
 #: _PartitionMap performed mid-query (StageMonitor's skew verdict
 #: turned into action)
 _MESH_RESPLITS = REGISTRY.counter("mesh_repartition_resplit_total")
+#: host dispatches onto the mesh: one count per ``_smap`` program
+#: invocation (the dotted tail labels the issuing stage kind). The
+#: fused-exchange win is this counter's per-query delta shrinking ~3x+,
+#: not just wall attribution — the MULTICHIP bench records the ratio
+_MESH_DISPATCHES = REGISTRY.counter("mesh_dispatches_total")
 
 #: cached 1-D meshes per device count (Mesh construction is cheap, but
 #: a stable object keeps sharding identity stable across queries)
 _MESH_CACHE: Dict[int, jax.sharding.Mesh] = {}
 
+#: cross-query shard_map program cache: (call site, closure value
+#: signature, specs, donate, mesh) -> _TimedEntry. A fresh executor per
+#: query used to rebuild every jax.jit(shard_map(...)) object, so even
+#: a WARM query paid a full re-trace per program — the last head of the
+#: dispatch tax after the fused exchange removed the per-round one.
+#: ops/jitcache.program_signature proves a closure only captures
+#: value-stable state (plan nodes, schemas, key tuples, quotas); any
+#: program it cannot prove keeps compile-per-query behavior. Bounded
+#: LRU: assignment tuples from adaptive re-splits would otherwise grow
+#: the cache without limit on a long-lived server.
+_PROGRAM_CACHE: "OrderedDict[tuple, object]" = OrderedDict()
+_PROGRAM_CACHE_CAP = 512
+_PROGRAM_CACHE_LOCK = threading.Lock()
+_PROGRAM_HITS = REGISTRY.counter("mesh_program_cache_hit_total")
+_PROGRAM_MISSES = REGISTRY.counter("mesh_program_cache_miss_total")
+
 
 class _FlightDispatch:
-    """Wraps an ``_smap`` executable so every host-side dispatch lands
-    as one flight-recorder round (obs/flight.py). One contextvar load
-    on the no-flight path; call semantics are untouched."""
+    """Wraps an ``_smap`` executable so every host-side dispatch counts
+    in ``mesh_dispatches_total`` (dotted tail = issuing stage kind) and
+    — when a flight recorder is active and ``kind`` is not None — lands
+    as one flight round (obs/flight.py). Call semantics are
+    untouched."""
 
-    __slots__ = ("entry", "kind")
+    __slots__ = ("entry", "kind", "rounds", "_stage_counter")
 
-    def __init__(self, entry, kind: str):
+    def __init__(self, entry, kind: Optional[str], stage: str = "misc",
+                 rounds: int = 1):
         self.entry = entry
         self.kind = kind
+        #: device exchange rounds one dispatch covers: a fused
+        #: ``lax.fori_loop`` program amortizes R rounds behind a single
+        #: host touch, and the flight record says so instead of
+        #: undercounting the loop
+        self.rounds = max(int(rounds), 1)
+        self._stage_counter = REGISTRY.counter(
+            f"mesh_dispatches_total.{stage}")
 
     def __call__(self, *args):
+        _MESH_DISPATCHES.inc()
+        self._stage_counter.inc()
         fl = _flight.current_flight()
-        if fl is None:
+        if fl is None or self.kind is None:
             return self.entry(*args)
         t0 = time.perf_counter()
         out = self.entry(*args)
-        fl.record(self.kind, wall=time.perf_counter() - t0)
+        fl.record(self.kind, wall=time.perf_counter() - t0,
+                  rounds=self.rounds)
         return out
 
 
@@ -123,6 +160,25 @@ def _sync_record(what: str, kind: str = "sync"):
     finally:
         if fl is not None:
             fl.record(kind, wall=time.perf_counter() - t0)
+
+
+def _drain_inputs(*values) -> None:
+    """Wait out the device arrays feeding a control-scalar fetch,
+    recorded as a ``drain`` flight round (device_compute bucket). On an
+    async backend the blocking wall at a ``_sync_record`` site is
+    dominated by upstream compute still in flight — without this
+    bracket that compute smears into ``control_sync`` exactly when the
+    fused exchange shrinks the real control plane, and the bucket
+    budgets gate on a lie. After the drain, the sync bracket times only
+    the control round trip itself."""
+    fl = _flight.current_flight()
+    t0 = time.perf_counter() if fl is not None else 0.0
+    try:
+        with TRACER.span("device-sync", what="input-drain"):
+            jax.block_until_ready([v for v in values if v is not None])
+    finally:
+        if fl is not None:
+            fl.record("drain", wall=time.perf_counter() - t0)
 
 
 def mesh_mode(session) -> str:
@@ -339,34 +395,147 @@ class _PartitionMap:
         return tuple(out)
 
 
+#: deferred skew checks in the fused exchange: device-side bucket
+#: counts are fetched and folded into the _PartitionMap once per this
+#: many rounds (minus the in-flight newest — see observe_pending), so
+#: the host control plane touches the device once per stage-ish instead
+#: of once per round and re-splits become a rarer loop-exit path
+_FUSED_OBSERVE_EVERY = 4
+
+#: per-shard slot ceiling for the fused aggregation carry — a grouping
+#: only rides the multi-round fori_loop when its dense key domain proves
+#: the state fits this many slots on every round (the PR 2/PR 10
+#: stats-bounded-capacity contract applied to loop-invariant shapes)
+_FUSED_STATE_SLOTS = 1 << 15
+#: gathered-state ceiling (global rows) under which the fused finisher
+#: replaces the hash-exchange + final pair with ONE all-gather + final
+#: dispatch, masking all but shard 0 (the _global_agg pattern)
+_FUSED_GATHER_SLOTS = 1 << 17
+
+
 class _Repartitioner:
-    """Quota-compacted bucket-hash exchange driver: one cheap collective
-    reads per-(src, bucket) live counts, the host sizes the static quota
-    and (through the shared _PartitionMap) may re-balance hot buckets,
-    and the exchange ships exactly quota slots per peer (wire cost ~C
-    instead of the masked all_to_all's n*C; reference
-    operator/PartitionedOutputOperator.java PagePartitioner). Jitted
-    exchanges are cached per (assignment, quota bucket)."""
+    """Quota-compacted bucket-hash exchange driver, two control planes:
+
+    - **fused** (default, ``mesh_fused_exchange``): bucket-count + ship
+      run as ONE collective program per round (exchange.
+      repartition_fused) under a capacity-safe static quota, so a round
+      is a single dispatch with no quota readback. Per-bucket counts
+      ride along as a device-resident second output; the host folds
+      them into the shared _PartitionMap only at deferred observe
+      points (builds force one; probe loops check every
+      _FUSED_OBSERVE_EVERY rounds, lagging one round so the fetch never
+      blocks on an in-flight dispatch) — control scalars once per
+      stage, re-splits preserved as a rarer loop-exit-and-rebuild path.
+    - **classic** (escape hatch / tight-wire callers): one cheap
+      collective reads per-(src, bucket) live counts, the host sizes
+      the static quota and may re-balance hot buckets, and the exchange
+      ships exactly quota slots per peer (wire cost ~C instead of the
+      masked all_to_all's n*C; reference operator/
+      PartitionedOutputOperator.java PagePartitioner).
+
+    Jitted exchanges are cached per (assignment, quota bucket)."""
 
     def __init__(self, ex: "DistributedExecutor",
-                 key_cols: Sequence[int], pmap: _PartitionMap):
+                 key_cols: Sequence[int], pmap: _PartitionMap,
+                 fused: Optional[bool] = None):
         self.ex = ex
         self.keys = tuple(key_cols)
         self.map = pmap
-        self._counts_fn = ex._smap(
-            lambda b: partition_counts(b, self.keys, pmap.buckets), 1,
-            flight_kind=None)
+        self.fused = (ex.fused_exchange if fused is None else bool(fused))
+        self._counts_fn = None
         self._fns: Dict[Tuple, object] = {}
+        self._fused_fns: Dict[Tuple, object] = {}
         self._last_counts: Optional[np.ndarray] = None
+        #: device-resident [n*buckets] count vectors awaiting observe
+        self._pending: List[object] = []
+        self._rounds_since_observe = 0
 
     @property
     def epoch(self) -> int:
         return self.map.epoch
 
     def _counts(self, batch: Batch) -> np.ndarray:
+        if self._counts_fn is None:
+            self._counts_fn = self.ex._smap(
+                lambda b, _k=self.keys, _bk=self.map.buckets:
+                partition_counts(b, _k, _bk), 1,
+                flight_kind=None, stage="exchange")
+        _drain_inputs(batch)
         with _sync_record("exchange-quota"):
             raw = np.asarray(jax.device_get(self._counts_fn(batch)))
         return raw.reshape(self.ex.n, self.map.buckets)
+
+    # -- fused control plane --------------------------------------------------
+    def fused_quota(self, batch: Batch) -> int:
+        """Capacity-safe static quota: any per-(src, dst) live count is
+        bounded by the source shard's lane count, so this quota can
+        never drop a row and needs no counts readback."""
+        return bucket_capacity(max(batch.capacity // self.ex.n, 1))
+
+    def note_counts(self, counts, rows_hint: int = 0) -> None:
+        """Queue one fused round's device-side bucket counts for a
+        deferred skew check (and keep the exchange-round metrics
+        continuous with the classic plane)."""
+        REGISTRY.counter("exchange_repartitions_total").inc()
+        if not self.map.adaptive:
+            return
+        self._pending.append(counts)
+        self._rounds_since_observe += 1
+        if self._rounds_since_observe >= _FUSED_OBSERVE_EVERY:
+            # pipelined check: leave the newest round's counts pending
+            # so the device_get only touches rounds that already
+            # retired — the fetch never stalls on in-flight compute
+            self.observe_pending(keep_newest=len(self._pending) > 1)
+
+    def observe_pending(self, keep_newest: bool = False) -> None:
+        """Fetch queued device counts ONCE and fold them into the
+        shared _PartitionMap — the per-stage control-scalar sync of the
+        fused plane (builds call this; probe loops hit it every
+        _FUSED_OBSERVE_EVERY rounds)."""
+        take = self._pending[:-1] if keep_newest else self._pending
+        if not take:
+            return
+        self._pending = self._pending[-1:] if keep_newest else []
+        self._rounds_since_observe = len(self._pending)
+        total = np.zeros((self.ex.n, self.map.buckets), dtype=np.int64)
+        _drain_inputs(*take)
+        with _sync_record("exchange-skew-check"):
+            for c in take:
+                total += np.asarray(jax.device_get(c)).reshape(
+                    self.ex.n, self.map.buckets)
+        self._last_counts = total
+        self.map.observe(total)
+
+    def _fused_ship(self, batch: Batch,
+                    record_counts: bool = True) -> Batch:
+        from .failpoints import FAILPOINTS
+        fl = _flight.current_flight()
+        t0 = time.perf_counter()
+        FAILPOINTS.hit("mesh.repartition")
+        assign = self.map.assign
+        quota = self.fused_quota(batch)
+        key = (assign, quota)
+        fn = self._fused_fns.get(key)
+        if fn is None:
+            from ..parallel.exchange import repartition_fused
+            fn = self._fused_fns[key] = self.ex._smap(
+                lambda b, _k=self.keys, _ax=self.ex.axis,
+                _n=self.ex.n, _a=assign, _q=quota: repartition_fused(
+                    b, _k, _ax, _n, _a, _q), 1,
+                n_out=2, flight_kind=None, stage="exchange")
+        out, counts = fn(batch)
+        if record_counts:
+            self.note_counts(counts)
+        else:
+            # replay rounds still SHIP (the exchange-round ledger stays
+            # whole) — they just don't fold counts in twice
+            REGISTRY.counter("exchange_repartitions_total").inc()
+        if fl is not None:
+            # one record per fused exchange round; the failpoint rides
+            # inside the timed span exactly like the classic _ship (row
+            # loads stay device-resident — that's the point)
+            fl.record("repartition", wall=time.perf_counter() - t0)
+        return out
 
     def _ship(self, batch: Batch, counts: np.ndarray) -> Batch:
         from .failpoints import FAILPOINTS
@@ -381,10 +550,11 @@ class _Repartitioner:
         if fn is None:
             from ..parallel.exchange import repartition_by_buckets_compact
             fn = self._fns[key] = self.ex._smap(
-                lambda b, _a=assign, _q=quota:
+                lambda b, _k=self.keys, _ax=self.ex.axis,
+                _n=self.ex.n, _a=assign, _q=quota:
                 repartition_by_buckets_compact(
-                    b, self.keys, self.ex.axis, self.ex.n, _a, _q), 1,
-                flight_kind=None)
+                    b, _k, _ax, _n, _a, _q), 1,
+                flight_kind=None, stage="exchange")
         REGISTRY.counter("exchange_repartitions_total").inc()
         out = fn(batch)
         if fl is not None:
@@ -400,6 +570,8 @@ class _Repartitioner:
         return out
 
     def __call__(self, batch: Batch) -> Batch:
+        if self.fused:
+            return self._fused_ship(batch)
         counts = self._counts(batch)
         self._last_counts = counts
         self.map.observe(counts)
@@ -409,6 +581,8 @@ class _Repartitioner:
         """Re-ship a batch this exchange already observed (the join's
         build side after a probe-driven re-split) under the CURRENT
         assignment, without folding its counts in twice."""
+        if self.fused:
+            return self._fused_ship(batch, record_counts=False)
         counts = (self._last_counts if self._last_counts is not None
                   else self._counts(batch))
         return self._ship(batch, counts)
@@ -437,6 +611,20 @@ class DistributedExecutor(_Executor):
         #: memoized all-gather identity (see _replicate_device): one
         #: trace per executor, not one per broadcast build side
         self._replicate_jit = None
+        #: fused SPMD exchange (default on): counts + ship collapse
+        #: into one collective program per round, stats-bounded stages
+        #: loop multiple rounds inside one dispatch, and control
+        #: scalars are fetched once per stage. mesh_fused_exchange=off
+        #: is the escape hatch back to the per-round host control plane
+        self.fused_exchange = bool_property(session, "mesh_fused_exchange",
+                                            True)
+        #: cap on chunks one fused lax.fori_loop dispatch may stack
+        #: (bounds resident memory: the stacked wave holds every chunk)
+        try:
+            self.fused_loop_rounds = max(int(
+                session.properties.get("mesh_fused_loop_rounds", 32)), 1)
+        except (TypeError, ValueError):
+            self.fused_loop_rounds = 32
 
     # -- sharding helpers ----------------------------------------------------
     def _shard_rows(self, batch: Batch) -> Batch:
@@ -447,18 +635,27 @@ class DistributedExecutor(_Executor):
         return Batch(batch.schema, cols, put(batch.row_mask))
 
     def _smap(self, fn, n_in: int, replicated_in: Sequence[int] = (),
-              n_out: int = 1, replicated_out: bool = False,
-              flight_kind: Optional[str] = "dispatch"):
+              n_out: int = 1, replicated_out=False,
+              flight_kind: Optional[str] = "dispatch",
+              stage: str = "misc", donate: Sequence[int] = (),
+              rounds: int = 1):
         in_specs = tuple(
             P() if i in replicated_in else P(self.axis)
             for i in range(n_in))
         # replicated_out: every shard computes the identical value (e.g.
         # preparing a replicated build side), so the output stays P() —
         # specs are PREFIX pytrees, so one spec covers a whole prepared
-        # tuple of arrays
-        one = P() if replicated_out else P(self.axis)
-        out_specs = (one if n_out == 1
-                     else tuple(one for _ in range(n_out)))
+        # tuple of arrays. True replicates every output; a sequence
+        # names the replicated output POSITIONS (a fused program can
+        # ship a sharded batch plus a replicated control scalar)
+        if isinstance(replicated_out, bool):
+            rep_out = (set(range(n_out)) if replicated_out else set())
+        else:
+            rep_out = set(replicated_out)
+        out_specs = ((P() if 0 in rep_out else P(self.axis))
+                     if n_out == 1
+                     else tuple(P() if i in rep_out else P(self.axis)
+                                for i in range(n_out)))
         # registered entry, not a raw jax.jit: every shard_map program
         # is an executable like any jitcache kernel — compiles and
         # (profiled) device time land in obs.profiler.EXECUTABLES
@@ -469,30 +666,57 @@ class DistributedExecutor(_Executor):
         # operators' compiles/FLOPs into one executables row), while
         # re-builds of the same program share one record instead of
         # churning the registry query after query
-        from ..ops.jitcache import _TimedEntry
+        from ..ops.jitcache import _TimedEntry, program_signature
         label = getattr(fn, "__qualname__", None) \
             or getattr(fn, "__name__", "fn")
         code = getattr(fn, "__code__", None)
         site = ((code.co_filename, code.co_firstlineno)
                 if code is not None else id(fn))
-        entry = _TimedEntry(
-            f"smap:{label.split('.<locals>.')[-1]}",
-            jax.jit(shard_map(
-                fn, mesh=self.mesh, in_specs=in_specs,
-                out_specs=out_specs, **{_SHARD_MAP_CHECK_KW: False})),
-            (site, in_specs, out_specs))
+        donate = tuple(donate)
+        # cross-query reuse: when the closure's captured state is
+        # provably value-stable, the SAME jitted program serves every
+        # query with this shape — warm queries skip the re-trace that
+        # used to dominate their dispatch wall (jax.jit's own trace
+        # cache keys on the function OBJECT, so rebuilding the object
+        # per query forfeited it)
+        sig = program_signature(fn)
+        cache_key = None
+        entry = None
+        if sig is not None:
+            cache_key = (site, sig, in_specs, out_specs, donate,
+                         self.axis, tuple(self.mesh.devices.flat))
+            with _PROGRAM_CACHE_LOCK:
+                entry = _PROGRAM_CACHE.get(cache_key)
+                if entry is not None:
+                    _PROGRAM_CACHE.move_to_end(cache_key)
+            (_PROGRAM_HITS if entry is not None
+             else _PROGRAM_MISSES).inc()
+        if entry is None:
+            entry = _TimedEntry(
+                f"smap:{label.split('.<locals>.')[-1]}",
+                jax.jit(shard_map(
+                    fn, mesh=self.mesh, in_specs=in_specs,
+                    out_specs=out_specs, **{_SHARD_MAP_CHECK_KW: False}),
+                    donate_argnums=donate),
+                (site, in_specs, out_specs, donate), donate=donate)
+            if cache_key is not None:
+                with _PROGRAM_CACHE_LOCK:
+                    _PROGRAM_CACHE[cache_key] = entry
+                    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_CAP:
+                        _PROGRAM_CACHE.popitem(last=False)
         # flight recorder: each dispatch is one round record (kind
         # "dispatch" -> dispatch_overhead; "repartition" for exchange
-        # fns; None when the caller brackets the call in _sync_record)
-        if flight_kind is None:
-            return entry
-        return _FlightDispatch(entry, flight_kind)
+        # fns; None when the caller brackets the call in _sync_record —
+        # every variant still counts in mesh_dispatches_total)
+        return _FlightDispatch(entry, flight_kind, stage=stage,
+                               rounds=rounds)
 
     def _shard_live_max(self, batch: Batch) -> int:
         """Max live rows on any shard (host sync) — sizes compactions."""
         per = self._smap(
             lambda b: jnp.sum(b.row_mask, keepdims=True).astype(jnp.int64), 1,
             flight_kind=None)
+        _drain_inputs(batch)
         with _sync_record("shard-live-max"):
             counts = np.asarray(jax.device_get(per(batch)))
         return int(counts.max()) if counts.size else 0
@@ -506,21 +730,26 @@ class DistributedExecutor(_Executor):
         fn = self._replicate_jit
         if fn is None:
             from ..ops.jitcache import _TimedEntry
-            fn = self._replicate_jit = _TimedEntry(
+            fn = self._replicate_jit = _FlightDispatch(_TimedEntry(
                 "replicate_device",
-                jax.jit(lambda b: b, out_shardings=self._replicated))
+                jax.jit(lambda b: b, out_shardings=self._replicated)),
+                "dispatch", stage="exchange")
         return fn(batch)
 
     def _repartitioner(self, key_cols: Sequence[int],
                        pmap: Optional[_PartitionMap] = None,
-                       adaptive: bool = True) -> _Repartitioner:
+                       adaptive: bool = True,
+                       fused: Optional[bool] = None) -> _Repartitioner:
         """An adaptive quota-compacted hash exchange (see
         :class:`_Repartitioner`). Pass one shared ``pmap`` for every
         exchange whose outputs must colocate (both sides of a
-        partitioned join); single-shot exchanges get their own map."""
+        partitioned join); single-shot exchanges get their own map.
+        ``fused=False`` forces the classic counts-then-ship plane (a
+        caller shipping a huge batch once may prefer the tight quota
+        over saving one sync)."""
         if pmap is None:
             pmap = _PartitionMap(self.n, adaptive=adaptive)
-        return _Repartitioner(self, key_cols, pmap)
+        return _Repartitioner(self, key_cols, pmap, fused=fused)
 
     # -- scan: split placement ------------------------------------------------
     def _TableScanNode(self, node: TableScanNode) -> Iterator[Batch]:
@@ -767,15 +996,15 @@ class DistributedExecutor(_Executor):
                     _plan_schema(node.child),
                     [[] for _ in node.child.fields], num_rows=0))
             if group:
-                b = self._repartitioner(group)(b)
+                b = self._repartitioner(group, fused=False)(b)
                 fn = self._smap(
                     lambda x: grouped_aggregate(x, group, aggs,
-                                                mode="single"), 1)
+                                                mode="single"), 1, stage="agg")
                 yield fn(b)
             else:
                 fn = self._smap(
                     lambda x: global_aggregate(
-                        _gathered(x, self.axis), aggs, mode="single"), 1)
+                        _gathered(x, self.axis), aggs, mode="single"), 1, stage="agg")
                 yield _keep_first_shard(fn(b), self.n)
             return
         if not group:
@@ -794,11 +1023,24 @@ class DistributedExecutor(_Executor):
         partial_fn = self._smap(
             lambda b: grouped_aggregate(b, group, aggs, mode="partial",
                                         key_bounds=kb,
-                                        allow_dense=allow_dense), 1)
+                                        allow_dense=allow_dense), 1, stage="agg")
         merge_fn = None
 
         state: Optional[Batch] = None
-        for chunk in self.run(node.child):
+        fused_state = False
+        src: Iterator[Batch] = iter(self.run(node.child))
+        if self.fused_exchange and allow_dense and step != "final":
+            # fused control plane: drain chunks through multi-round
+            # lax.fori_loop wave programs (one dispatch per wave, donated
+            # carry, zero mid-stage syncs). Falls back to the classic
+            # per-chunk loop below for whatever the drain did not take
+            # (gate failed, or the wave signature changed mid-stream).
+            state, src = self._fused_agg_drain(src, group, aggs, kb)
+            fused_state = state is not None
+        merges = 0
+        next_check = 1
+        check_every = 1
+        for chunk in src:
             if kb is not None and allow_dense and step != "final":
                 # sharded batches reduce to one replicated scalar; the
                 # flag joins the query's single end-of-run error sync.
@@ -819,14 +1061,27 @@ class DistributedExecutor(_Executor):
                         lambda a, b: grouped_aggregate(
                             concat_batches([a, b]), key_idx, aggs,
                             mode="merge", key_bounds=kb,
-                            allow_dense=allow_dense), 2)
+                            allow_dense=allow_dense), 2, stage="agg")
                 merged = merge_fn(state, partial)
-                live = self._shard_live_max(merged)
-                cap = bucket_capacity(max(live, 1))
-                if cap * self.n < merged.capacity:
-                    compact_fn = self._smap(
-                        lambda b, _cap=cap: b.compact(_cap, check=False), 1)
-                    merged = compact_fn(merged)
+                merges += 1
+                # compaction sizing is an optimization, never a
+                # correctness gate (skipping a check only retains a
+                # larger capacity for longer), so the live-max host
+                # sync runs on a doubling cadence — first merge, back
+                # off while nothing compacts, snap back when one does
+                # (the local executor's adaptive sparse-check idiom)
+                if merges >= next_check:
+                    live = self._shard_live_max(merged)
+                    cap = bucket_capacity(max(live, 1))
+                    if cap * self.n < merged.capacity:
+                        compact_fn = self._smap(
+                            lambda b, _cap=cap: b.compact(_cap, check=False),
+                            1, stage="agg")
+                        merged = compact_fn(merged)
+                        check_every = 1
+                    else:
+                        check_every = min(check_every * 2, 8)
+                    next_check = merges + check_every
                 state = merged
         if state is None:
             if node.default_gids and step in ("single", "final"):
@@ -840,12 +1095,25 @@ class DistributedExecutor(_Executor):
             # the hash exchange that co-locates groups
             yield state
             return
-        state = self._repartitioner(key_idx)(state)
-        final_fn = self._smap(
-            lambda b: grouped_aggregate(b, key_idx, aggs, mode="final",
-                                        key_bounds=kb,
-                                        allow_dense=allow_dense), 1)
-        out = final_fn(state)
+        if fused_state and state.capacity <= _FUSED_GATHER_SLOTS:
+            # fused finisher: the carry's proven capacity is small
+            # enough to all-gather, so the final runs replicated in ONE
+            # dispatch — no exchange round at all. Output identical on
+            # every shard; mask all but shard 0 (the _global_agg form)
+            final_fn = self._smap(
+                lambda b, _ax=self.axis: grouped_aggregate(
+                    _gathered(b, _ax), key_idx, aggs, mode="final",
+                    key_bounds=kb, allow_dense=allow_dense), 1,
+                stage="agg")
+            out = _keep_first_shard(final_fn(state), self.n)
+        else:
+            state = self._repartitioner(key_idx, fused=False)(state)
+            final_fn = self._smap(
+                lambda b: grouped_aggregate(b, key_idx, aggs, mode="final",
+                                            key_bounds=kb,
+                                            allow_dense=allow_dense), 1,
+                stage="agg")
+            out = final_fn(state)
         if node.default_gids and step in ("single", "final") \
                 and out.host_count() == 0:
             from .local import _default_grouping_batch
@@ -853,14 +1121,103 @@ class DistributedExecutor(_Executor):
             return
         yield out
 
+    @staticmethod
+    def _wave_sig(b: Batch):
+        """Trace signature a fused wave must hold constant: chunks are
+        tree-stacked into ONE program, so capacity, schema and every
+        column's dictionary object must match the wave's first chunk."""
+        return (b.capacity, b.schema,
+                tuple(id(c.dictionary) for c in b.columns))
+
+    def _fused_agg_drain(self, src: Iterator[Batch], group: List[int],
+                         aggs: List[AggSpec], kb):
+        """Drain grouped-aggregation input through fused multi-round wave
+        programs (tentpole tier A).
+
+        Each wave stacks up to ``mesh_fused_loop_rounds`` chunks into ONE
+        shard_map program whose body is a ``lax.fori_loop`` of
+        partial-aggregate + state-merge at a STATIC state capacity proven
+        from the dense key domain (dictionary vocab / bool / stats
+        bounds — the PR 2/PR 10 machinery). The host dispatches once per
+        wave instead of 3-4 times (+ a liveness sync) per chunk; the
+        previous wave's carry is DONATED into the next wave's program so
+        round-carried state stops churning buffers. Bounds violations
+        fold into a replicated scalar that joins the query's single
+        end-of-run error sync.
+
+        Returns ``(state, leftover)``: the fused carry (None when the
+        gate rejected the stream) and an iterator of chunks the caller's
+        classic loop must still process."""
+        from ..ops.aggregation import (dense_group_plan, has_drain_agg,
+                                       _wide_state_aggs)
+        first = next(src, None)
+        if first is None:
+            return None, iter(())
+        if has_drain_agg(aggs) or _wide_state_aggs(aggs):
+            # drain/wide states don't take the dense path in-program;
+            # without it no static carry capacity can be proven
+            return None, itertools.chain([first], src)
+        kb_list = list(kb) if kb else None
+        plan = dense_group_plan(first, group, _FUSED_STATE_SLOTS, kb_list)
+        if plan is None:
+            return None, itertools.chain([first], src)
+        cap_out = bucket_capacity(plan.K + 1)
+        key_idx = list(range(len(group)))
+        sig0 = self._wave_sig(first)
+        wave_fns: Dict[Tuple[int, bool], object] = {}
+
+        def run_wave(carry: Optional[Batch],
+                     chunks: List[Batch]) -> Batch:
+            rounds = 1 << max(len(chunks) - 1, 0).bit_length()
+            if rounds > len(chunks):
+                # pad to a power of two so wave programs stay few: dead
+                # copies of the last chunk (mask off -> overflow slot)
+                dead = Batch(chunks[-1].schema, chunks[-1].columns,
+                             jnp.zeros_like(chunks[-1].row_mask))
+                chunks = chunks + [dead] * (rounds - len(chunks))
+            has_carry = carry is not None
+            fn = wave_fns.get((rounds, has_carry))
+            if fn is None:
+                fn = wave_fns[(rounds, has_carry)] = self._smap(
+                    _fused_agg_wave_fn(group, key_idx, aggs, kb,
+                                       cap_out, has_carry, self.axis),
+                    rounds + (1 if has_carry else 0),
+                    n_out=2, replicated_out=(1,), stage="agg",
+                    donate=(0,) if has_carry else (),
+                    rounds=rounds)
+            out, viol = fn(*([carry] if has_carry else []), *chunks)
+            if kb is not None:
+                self.error_flags.append(viol)
+            return out
+
+        state: Optional[Batch] = None
+        pending = [first]
+        leftover: Optional[Batch] = None
+        for chunk in src:
+            if self._wave_sig(chunk) != sig0:
+                # signature drifted (dictionary / capacity change): hand
+                # the rest back to the classic per-chunk plane, which
+                # merges into the fused carry via concat-remap
+                leftover = chunk
+                break
+            pending.append(chunk)
+            if len(pending) >= self.fused_loop_rounds:
+                state = run_wave(state, pending)
+                pending = []
+        if pending:
+            state = run_wave(state, pending)
+        if leftover is not None:
+            return state, itertools.chain([leftover], src)
+        return state, iter(())
+
     def _global_agg(self, node: AggregationNode,
                     aggs: List[AggSpec]) -> Batch:
         step = node.step
         partial_fn = self._smap(
-            lambda b: global_aggregate(b, aggs, mode="partial"), 1)
+            lambda b: global_aggregate(b, aggs, mode="partial"), 1, stage="agg")
         merge_fn = self._smap(
             lambda a, b: global_aggregate(
-                concat_batches([a, b]), aggs, mode="merge"), 2)
+                concat_batches([a, b]), aggs, mode="merge"), 2, stage="agg")
         state: Optional[Batch] = None
         for chunk in self.run(node.child):
             partial = (chunk if step == "final" else partial_fn(chunk))
@@ -875,7 +1232,7 @@ class DistributedExecutor(_Executor):
         # gather every shard's state and finalize replicated
         final_fn = self._smap(
             lambda b: global_aggregate(
-                _gathered(b, self.axis), aggs, mode="final"), 1)
+                _gathered(b, self.axis), aggs, mode="final"), 1, stage="agg")
         out = final_fn(state)
         # output is identical on every shard; mask all but shard 0
         return _keep_first_shard(out, self.n)
@@ -921,7 +1278,17 @@ class DistributedExecutor(_Executor):
             # unmatched-build masks cannot survive rows moving shards.
             pmap = _PartitionMap(self.n, adaptive=not track_full)
             repart_build = self._repartitioner(rkeys, pmap)
+            e0 = pmap.epoch
             build_side = repart_build(build)
+            # fused plane: fold the build round's counts NOW (one sync,
+            # before any probe ships) so a skewed build re-balances the
+            # shared map before the probe stream commits to it. The
+            # fused ship ran BEFORE its counts were seen, so a verdict
+            # from its own round means the build itself sits under the
+            # stale assignment — re-ship it once
+            repart_build.observe_pending()
+            if pmap.epoch != e0:
+                build_side = repart_build.replay(build)
 
         # prepare the build ONCE per shard (the LookupSource role, same
         # contract as exec/local.py): every probe program takes the
@@ -953,7 +1320,7 @@ class DistributedExecutor(_Executor):
                 return prepare_build(b, rkeys)
         prep_in = (0,) if replicated else ()
         prep_smap = self._smap(prep_local, 1, replicated_in=prep_in,
-                               replicated_out=replicated)
+                               replicated_out=replicated, stage="join")
         prepared = prep_smap(build_side)
         _note_join_strategy(
             self.stats, node,
@@ -1056,7 +1423,8 @@ class DistributedExecutor(_Executor):
             mult_fn = self._smap(
                 lambda pr: max_multiplicity(pr)[None].astype(jnp.int64),
                 1, replicated_in=(0,) if replicated else (),
-                flight_kind=None)
+                flight_kind=None, stage="join")
+            _drain_inputs(prepared)
             with _sync_record("join-multiplicity"):
                 bound = int(np.asarray(
                     jax.device_get(mult_fn(prepared))).max())
@@ -1072,7 +1440,7 @@ class DistributedExecutor(_Executor):
                                            prepared=pr)[None]
                 count_fn = self._smap(local_count, 3,
                                       replicated_in=rep_in2,
-                                      flight_kind=None)
+                                      flight_kind=None, stage="join")
 
         repart_probe = (None if replicated
                         else self._repartitioner(lkeys, pmap))
@@ -1080,11 +1448,58 @@ class DistributedExecutor(_Executor):
         match_fn = (self._smap(
             lambda p, b, pr: build_match_mask(p, b, lkeys, rkeys,
                                               prepared=pr), 3,
-            replicated_in=rep_in2)
+            replicated_in=rep_in2, stage="join")
             if track_full else None)
         build_matched = None
         built_epoch = pmap.epoch if pmap is not None else 0
+        # fused probe plane (tentpole tier B): when the match bound is
+        # static (no per-batch count sync) and no outer/residual bookkeeping
+        # rides along, the key exchange FUSES into the probe program —
+        # repartition collectives and probe compute are one dispatch, with
+        # the round's bucket counts as a device-resident second output that
+        # the deferred skew check folds in without blocking the stream
+        fuse_probe = (repart_probe is not None and repart_probe.fused
+                      and count_fn is None and not residual_outer
+                      and not track_full)
+        fused_probe_fns: Dict[Tuple, object] = {}
         for probe in self.run(node.left):
+            if fuse_probe:
+                if pmap.epoch != built_epoch:
+                    # deferred skew verdict landed: loop-exit-and-rebuild —
+                    # re-ship the retained build under the new assignment
+                    # before the next fused round commits to it
+                    build_side = repart_build.replay(build)
+                    prepared = prep_smap(build_side)
+                    built_epoch = pmap.epoch
+                from .failpoints import FAILPOINTS
+                fl = _flight.current_flight()
+                t0 = time.perf_counter()
+                FAILPOINTS.hit("mesh.repartition")
+                maxk = maxk_static if maxk_static is not None else 1
+                key = (pmap.assign, repart_probe.fused_quota(probe), maxk)
+                fn = fused_probe_fns.get(key)
+                if fn is None:
+                    from ..parallel.exchange import repartition_fused
+                    _a, _q, _k = key
+                    _ax, _n = self.axis, self.n
+
+                    def fused_probe(p, b, pr, _a=_a, _q=_q, _k=_k):
+                        shipped, counts = repartition_fused(
+                            p, lkeys, _ax, _n, _a, _q)
+                        return local_probe(shipped, b, pr, _k), counts
+                    fn = fused_probe_fns[key] = self._smap(
+                        fused_probe, 3, replicated_in=rep_in2, n_out=2,
+                        flight_kind=None, stage="join")
+                out, counts = fn(probe, build_side, prepared)
+                repart_probe.note_counts(counts)
+                if fl is not None:
+                    # exchange + probe are ONE program now: the round
+                    # record is a repartition record whose wall covers
+                    # the whole fused dispatch
+                    fl.record("repartition",
+                              wall=time.perf_counter() - t0)
+                yield out
+                continue
             if repart_probe is not None:
                 probe = repart_probe(probe)
                 if pmap.epoch != built_epoch:
@@ -1100,6 +1515,7 @@ class DistributedExecutor(_Executor):
             if maxk_static is not None:
                 maxk = maxk_static
             elif count_fn is not None:
+                _drain_inputs(probe, build_side, prepared)
                 with _sync_record("join-match-count"):
                     maxk = bucket_capacity(
                         max(int(np.asarray(jax.device_get(
@@ -1112,12 +1528,12 @@ class DistributedExecutor(_Executor):
                     fn = join_fns[maxk] = self._smap(
                         lambda p, b, pr, _k=maxk: local_probe_outer(
                             p, b, pr, _k),
-                        3, replicated_in=rep_in2)
+                        3, replicated_in=rep_in2, stage="join")
                 else:
                     fn = join_fns[maxk] = self._smap(
                         lambda p, b, pr, _k=maxk: local_probe(
                             p, b, pr, _k), 3,
-                        replicated_in=rep_in2)
+                        replicated_in=rep_in2, stage="join")
             if residual_outer:
                 out, m = fn(probe, build_side, prepared)
                 if track_full:
@@ -1130,6 +1546,11 @@ class DistributedExecutor(_Executor):
                 build_matched = (m if build_matched is None
                                  else build_matched | m)
             yield fn(probe, build_side, prepared)
+        if repart_probe is not None:
+            # per-stage control-scalar fetch: any still-pending fused
+            # round counts fold into the shared map exactly once here,
+            # so skew stats never silently drop at stage end
+            repart_probe.observe_pending()
         if track_full:
             left_fields = node.left.fields
 
@@ -1146,7 +1567,7 @@ class DistributedExecutor(_Executor):
 
             if build_matched is None:
                 build_matched = jnp.zeros_like(build_side.row_mask)
-            yield self._smap(local_tail, 2)(build_side, build_matched)
+            yield self._smap(local_tail, 2, stage="join")(build_side, build_matched)
 
     def _SemiJoinNode(self, node: SemiJoinNode) -> Iterator[Batch]:
         build = self._drain(node.filtering)
@@ -1175,7 +1596,14 @@ class DistributedExecutor(_Executor):
             # per shard under any re-balanced assignment
             pmap = _PartitionMap(self.n)
             repart_build = self._repartitioner(fkeys, pmap)
+            e0 = pmap.epoch
             build_rep = repart_build(build)
+            # fold the build round's counts before the source stream
+            # commits to the shared assignment; re-ship once if the
+            # build's own round triggered the re-split (see _JoinNode)
+            repart_build.observe_pending()
+            if pmap.epoch != e0:
+                build_rep = repart_build.replay(build)
             repart_src = self._repartitioner(skeys, pmap)
         else:
             build_rep = self._replicate_device(build)
@@ -1193,7 +1621,7 @@ class DistributedExecutor(_Executor):
             prep_smap = self._smap(lambda f: prepare_build(f, fkeys), 1,
                                    replicated_in=(0,) if not partitioned
                                    else (),
-                                   replicated_out=not partitioned)
+                                   replicated_out=not partitioned, stage="semi")
             prep = prep_smap(build_rep)
 
             def local(b: Batch, flt: Batch, pr) -> Batch:
@@ -1204,9 +1632,43 @@ class DistributedExecutor(_Executor):
 
             fn = self._smap(local, 3,
                             replicated_in=(1, 2) if not partitioned
-                            else ())
+                            else (), stage="semi")
             built_epoch = pmap.epoch if pmap is not None else 0
+            # fused source plane: key exchange + membership probe as ONE
+            # dispatch per round, bucket counts deferred (see _JoinNode)
+            fuse_src = repart_src is not None and repart_src.fused
+            fused_fns: Dict[Tuple, object] = {}
             for b in self.run(node.source):
+                if fuse_src:
+                    if pmap.epoch != built_epoch:
+                        build_rep = repart_build.replay(build)
+                        prep = prep_smap(build_rep)
+                        built_epoch = pmap.epoch
+                    from .failpoints import FAILPOINTS
+                    fl = _flight.current_flight()
+                    t0 = time.perf_counter()
+                    FAILPOINTS.hit("mesh.repartition")
+                    key = (pmap.assign, repart_src.fused_quota(b))
+                    f2 = fused_fns.get(key)
+                    if f2 is None:
+                        from ..parallel.exchange import repartition_fused
+                        _a, _q = key
+                        _ax, _n = self.axis, self.n
+
+                        def fused_semi(p, flt, pr, _a=_a, _q=_q):
+                            shipped, counts = repartition_fused(
+                                p, skeys, _ax, _n, _a, _q)
+                            return local(shipped, flt, pr), counts
+                        f2 = fused_fns[key] = self._smap(
+                            fused_semi, 3, n_out=2, flight_kind=None,
+                            stage="semi")
+                    out, counts = f2(b, build_rep, prep)
+                    repart_src.note_counts(counts)
+                    if fl is not None:
+                        fl.record("repartition",
+                                  wall=time.perf_counter() - t0)
+                    yield out
+                    continue
                 if repart_src is not None:
                     b = repart_src(b)
                     if pmap.epoch != built_epoch:
@@ -1216,6 +1678,8 @@ class DistributedExecutor(_Executor):
                         prep = prep_smap(build_rep)
                         built_epoch = pmap.epoch
                 yield fn(b, build_rep, prep)
+            if repart_src is not None:
+                repart_src.observe_pending()
             return
 
         # mark-join (EXISTS with residual): shard-local against the
@@ -1226,7 +1690,8 @@ class DistributedExecutor(_Executor):
         mult_fn = self._smap(
             lambda f: max_multiplicity(
                 build_sorted(f, fkeys))[None].astype(jnp.int64), 1,
-            replicated_in=(0,), flight_kind=None)
+            replicated_in=(0,), flight_kind=None, stage="semi")
+        _drain_inputs(build_rep)
         with _sync_record("semi-multiplicity"):
             bound = int(np.asarray(
                 jax.device_get(mult_fn(build_rep))).max())
@@ -1234,12 +1699,13 @@ class DistributedExecutor(_Executor):
                     if bound <= self.SKEW_MATCH_LIMIT else None)
         count_fn = (None if res_maxk is not None else self._smap(
             lambda p, f: match_count_max(p, f, skeys, fkeys)[None], 2,
-            replicated_in=(1,), flight_kind=None))
+            replicated_in=(1,), flight_kind=None, stage="semi"))
         fns: Dict[int, object] = {}
         for b in self.run(node.source):
             if res_maxk is not None:
                 maxk = res_maxk
             else:
+                _drain_inputs(b, build_rep)
                 with _sync_record("semi-match-count"):
                     maxk = bucket_capacity(
                         max(int(np.asarray(jax.device_get(
@@ -1252,7 +1718,7 @@ class DistributedExecutor(_Executor):
                                             node.residual, neg, _k)
                     return Batch(p.schema, p.columns, mask)
                 fn = fns[maxk] = self._smap(local_mark, 2,
-                                            replicated_in=(1,))
+                                            replicated_in=(1,), stage="semi")
             yield fn(b, build_rep)
 
     # -- sort family: local pre-reduce + gather-merge -------------------------
@@ -1272,6 +1738,11 @@ class DistributedExecutor(_Executor):
                 for k in node.keys]
         n = self.n
         samples_per_shard = 64
+        # bind value-stable locals (not self) so the program fingerprints
+        # for the cross-query cache; _sort_sentinel_dt is a staticmethod,
+        # so the attribute access yields a plain function
+        _ax = self.axis
+        _sentinel_dt = self._sort_sentinel_dt
 
         # RANGE-partitioned distributed sort (reference dist-sort.rst +
         # MergeOperator.java:45, reshaped for SPMD): sample the primary
@@ -1300,11 +1771,11 @@ class DistributedExecutor(_Executor):
             local_samples = jnp.take(data, pos, axis=0)
             # shards with no non-null rows contribute max-sentinels so
             # they never pull the splitters down
-            sent = jnp.full((m,), self._sort_sentinel_dt(data.dtype),
+            sent = jnp.full((m,), _sentinel_dt(data.dtype),
                             dtype=data.dtype)
             local_samples = jnp.where(n_nn > 0, local_samples, sent)
             all_samples = jax.lax.all_gather(
-                local_samples, self.axis, tiled=True)       # [n*m]
+                local_samples, _ax, tiled=True)       # [n*m]
             s_sorted = jax.lax.sort([all_samples])[0]
             splitters = jnp.take(
                 s_sorted, jnp.arange(1, n, dtype=jnp.int32) * m, axis=0)
@@ -1313,12 +1784,12 @@ class DistributedExecutor(_Executor):
             null_pid = jnp.int32(0 if nulls_first else n - 1)
             pid = jnp.where(nn, pid, null_pid)
             ex = repartition_by_ids(Batch(x.schema, x.columns, live),
-                                    pid, self.axis, n)
+                                    pid, _ax, n)
             return sort_batch(ex, keys)
 
         # shard-major concatenation of the range-partitioned shards IS the
         # global order — yield the device-resident sharded batch directly
-        yield self._smap(program, 1)(b)
+        yield self._smap(program, 1, stage="sort")(b)
 
     def _TopNNode(self, node: TopNNode) -> Iterator[Batch]:
         """Shard-local top-n accumulation (collective-free per batch),
@@ -1330,10 +1801,10 @@ class DistributedExecutor(_Executor):
                 for k in node.keys]
         cap = bucket_capacity(node.count)
         local_topn = self._smap(
-            lambda b: top_n(b, keys, node.count).compact(cap, check=False), 1)
+            lambda b: top_n(b, keys, node.count).compact(cap, check=False), 1, stage="sort")
         merge_fn = self._smap(
             lambda s, c: top_n(concat_batches([s, c]), keys,
-                               node.count).compact(cap, check=False), 2)
+                               node.count).compact(cap, check=False), 2, stage="sort")
         state: Optional[Batch] = None
         for b in self.run(node.child):
             cand = local_topn(b)
@@ -1342,9 +1813,9 @@ class DistributedExecutor(_Executor):
             # every shard computes the same global top-n over the gathered
             # candidates; mask all but shard 0's copy
             final_fn = self._smap(
-                lambda s: sort_batch(
-                    top_n(_gathered(s, self.axis), keys, node.count),
-                    keys), 1)
+                lambda s, _ax=self.axis: sort_batch(
+                    top_n(_gathered(s, _ax), keys, node.count),
+                    keys), 1, stage="sort")
             yield _keep_first_shard(final_fn(state), self.n)
 
     def _UnnestNode(self, node) -> Iterator[Batch]:
@@ -1381,7 +1852,7 @@ class DistributedExecutor(_Executor):
         schema = _plan_schema(node)
         if parts:
             # colocate partitions via hash exchange, evaluate shard-locally
-            b = self._repartitioner(parts)(b)
+            b = self._repartitioner(parts, fused=False)(b)
             fn = self._smap(
                 lambda x: evaluate_window(x, parts, keys, specs), 1)
             out = fn(b)
@@ -1406,7 +1877,7 @@ class DistributedExecutor(_Executor):
             # unconditional hard-invariant check — see _AggregationNode
             from ..ops.jitcache import key_bounds_violation_jit
             self.error_flags.append(key_bounds_violation_jit(b, cols, kb))
-        b = self._repartitioner(cols)(b)
+        b = self._repartitioner(cols, fused=False)(b)
         fn = self._smap(
             lambda x: grouped_aggregate(x, cols, [], mode="single",
                                         key_bounds=kb,
@@ -1422,7 +1893,7 @@ class DistributedExecutor(_Executor):
         b = self._drain(node.child)
         if b is None:
             return
-        b = self._repartitioner(list(node.cols))(b)
+        b = self._repartitioner(list(node.cols), fused=False)(b)
         schema = plan_schema(node)
 
         def local_mark(x: Batch) -> Batch:
@@ -1440,11 +1911,59 @@ class DistributedExecutor(_Executor):
         if len(batches) == 1:
             return batches[0]
         # concat shard-locally to keep the result sharded
-        fn = self._smap(lambda *bs: concat_batches(list(bs)), len(batches))
+        fn = self._smap(lambda *bs: concat_batches(list(bs)), len(batches), stage="scan")
         return fn(*batches)
 
 
 # -- helpers -----------------------------------------------------------------
+
+def _fused_agg_wave_fn(group, key_idx, aggs, kb, cap_out: int,
+                       has_carry: bool, axis: str):
+    """One-dispatch multi-round aggregation program (DrJAX pattern:
+    MapReduce rounds as traced code, not host loops). Stacks the wave's
+    chunks leaf-wise, then runs a ``lax.fori_loop`` of partial-aggregate
+    + state-merge whose carry rides at the STATIC ``cap_out`` capacity
+    the dense key domain proves. Returns ``(state, violation)`` where
+    the violation scalar is pmax-replicated so it can join the query's
+    single end-of-run error sync."""
+    kb_t = tuple(kb) if kb else None
+    group_t = tuple(group)
+
+    def _partial(chunk: Batch) -> Batch:
+        return grouped_aggregate(chunk, group, aggs, mode="partial",
+                                 output_capacity=cap_out,
+                                 key_bounds=kb_t, allow_dense=True)
+
+    def fused_agg_wave(*args):
+        chunks = args[1:] if has_carry else args
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *chunks)
+        if kb_t is not None:
+            # bounds check over every round at once: the stacked [R, C]
+            # leaves broadcast straight through the violation predicate
+            from ..ops.jitcache import _bounds_violation
+            viol = jax.lax.pmax(
+                _bounds_violation(group_t, kb_t)(stacked), axis)
+        else:
+            viol = jnp.int32(0)
+        if has_carry:
+            st0, lo = args[0], 0
+        else:
+            st0, lo = _partial(chunks[0]), 1
+
+        def body(r, st):
+            chunk = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(
+                    x, r, 0, keepdims=False), stacked)
+            return grouped_aggregate(
+                concat_batches([st, _partial(chunk)]), key_idx, aggs,
+                mode="merge", output_capacity=cap_out, key_bounds=kb_t,
+                allow_dense=True)
+
+        return jax.lax.fori_loop(lo, len(chunks), body, st0), viol
+
+    return fused_agg_wave
+
 
 def _gathered(b: Batch, axis: str) -> Batch:
     from ..parallel.exchange import broadcast_batch

@@ -79,7 +79,7 @@ KIND_BUCKET: Dict[str, str] = {
 #: table can never drift apart
 ROUND_COLUMNS: Tuple[str, ...] = (
     "query_id", "round", "stage", "kind", "bucket", "t_start",
-    "wall_s", "rows", "bytes", "loads", "blocking")
+    "wall_s", "rows", "bytes", "loads", "blocking", "rounds")
 
 _FLIGHT_QUERIES = REGISTRY.counter("mesh_flight_queries_total")
 _ROUNDS_TOTAL = REGISTRY.counter("mesh_rounds_total")
@@ -116,12 +116,17 @@ class FlightRecorder:
     def record(self, kind: str, stage: int = -1, wall: float = 0.0,
                rows: int = 0, nbytes: int = 0,
                loads: Optional[Sequence[int]] = None,
-               blocking: bool = True, t_start: float = 0.0) -> None:
+               blocking: bool = True, t_start: float = 0.0,
+               rounds: int = 1) -> None:
         """Append one round record. ``wall`` is host-blocking seconds
         measured by the caller; ``loads`` is the per-shard row load of
         the round (feeds the critical path); ``t_start`` is the
         trace-epoch wall clock at the start of the interval (defaults
-        to now - wall)."""
+        to now - wall); ``rounds`` is the number of DEVICE rounds the
+        dispatch covers — a fused multi-round program (lax.fori_loop
+        over exchange rounds) is one host record with rounds=R, so the
+        per-fused-dispatch timeline still exposes how much device-side
+        looping each host touch amortizes."""
         rec = {
             "kind": kind,
             "stage": int(stage),
@@ -131,6 +136,7 @@ class FlightRecorder:
             "bytes": int(nbytes),
             "loads": tuple(int(x) for x in loads) if loads else None,
             "blocking": bool(blocking),
+            "rounds": max(int(rounds), 1),
         }
         with self._lock:
             rec["round"] = len(self._records)
@@ -204,6 +210,9 @@ class FlightRecorder:
             "n_devices": self.n_devices,
             "wall_s": round(wall_s, 6),
             "rounds": len(records),
+            # device rounds covered by those records: > rounds when
+            # fused dispatches loop multiple exchange rounds on device
+            "device_rounds": sum(r.get("rounds", 1) for r in records),
             "buckets": {b: round(s, 6) for b, s in buckets.items()},
             "dominant_bucket": dominant,
             "reconciled_pct": round(
@@ -275,7 +284,7 @@ def round_rows(query_id: str,
          KIND_BUCKET.get(r["kind"], "dispatch_overhead"),
          round(r["t"], 6), round(r["wall"], 6), r["rows"], r["bytes"],
          "/".join(str(x) for x in r["loads"]) if r["loads"] else "",
-         r["blocking"])
+         r["blocking"], r.get("rounds", 1))
         for r in records
     ]
 

@@ -13,8 +13,11 @@ per column for generator connectors) simply retraces that one call.
 """
 from __future__ import annotations
 
+import dataclasses
+import functools
 import threading
 import time
+import types as _pytypes
 from typing import Optional, Sequence
 
 import jax
@@ -35,6 +38,103 @@ _JIT_COMPILE_S = REGISTRY.counter("jit_compile_seconds_total")
 _JIT_COMPILE_HIST = REGISTRY.histogram("jit_compile_seconds")
 
 
+#: sentinel: a closure captured something we cannot prove is
+#: value-stable, so the program must not be shared across queries
+_SIG_MISS = object()
+
+#: recursion ceiling for closure fingerprints — deep enough for a plan
+#: subtree hanging off a probe closure, cheap enough to run once per
+#: program construction
+_SIG_MAX_DEPTH = 32
+
+
+def _value_sig(v, depth: int, seen) -> object:
+    """Hashable value-identity of one captured object, or ``_SIG_MISS``.
+
+    The contract that makes cross-query program sharing safe: two equal
+    signatures mean the closures compute the SAME traced function for
+    equal input avals. Only value-immutable things get a signature —
+    primitives, tuples/lists of them, frozen dataclasses (the whole
+    plan/expr/type system: PlanNode, ir.Expr, Type, AggSpec, Field),
+    Schema, and nested pure-python functions (their code object +
+    recursively-fingerprinted cells/defaults). Anything
+    identity-hashable or mutable (executors, repartitioners, arrays,
+    dicts) yields ``_SIG_MISS`` and the program keeps today's
+    compile-per-query behavior — a miss is never wrong, only slower."""
+    if depth > _SIG_MAX_DEPTH:
+        return _SIG_MISS
+    if v is None or v is True or v is False:
+        return v
+    t = type(v)
+    if t in (int, float, str, bytes, complex):
+        return (t.__name__, v)
+    if t in (tuple, list):
+        parts = tuple(_value_sig(x, depth + 1, seen) for x in v)
+        if any(p is _SIG_MISS for p in parts):
+            return _SIG_MISS
+        return (t.__name__,) + parts
+    if t is frozenset:
+        parts = tuple(_value_sig(x, depth + 1, seen)
+                      for x in sorted(v, key=repr))
+        if any(p is _SIG_MISS for p in parts):
+            return _SIG_MISS
+        return ("frozenset",) + parts
+    if t is _pytypes.FunctionType:
+        return _fn_sig(v, depth + 1, seen)
+    if t is functools.partial:
+        parts = (_value_sig(v.func, depth + 1, seen),
+                 _value_sig(tuple(v.args), depth + 1, seen),
+                 _value_sig(tuple(sorted(v.keywords.items())),
+                            depth + 1, seen))
+        if any(p is _SIG_MISS for p in parts):
+            return _SIG_MISS
+        return ("partial",) + parts
+    if t is _pytypes.BuiltinFunctionType:
+        return ("bfn", getattr(v, "__module__", None), v.__qualname__)
+    from ..batch import Schema
+    if t is Schema:
+        return ("schema", v.fields)
+    if dataclasses.is_dataclass(v) and not isinstance(v, type) \
+            and v.__dataclass_params__.frozen:
+        parts = tuple(_value_sig(getattr(v, f.name), depth + 1, seen)
+                      for f in dataclasses.fields(v))
+        if any(p is _SIG_MISS for p in parts):
+            return _SIG_MISS
+        return ("dc", t) + parts
+    return _SIG_MISS
+
+
+def _fn_sig(fn, depth: int, seen) -> object:
+    code = getattr(fn, "__code__", None)
+    if code is None or id(fn) in seen:
+        return _SIG_MISS
+    seen = seen | {id(fn)}
+    parts = [code]
+    try:
+        cells = fn.__closure__ or ()
+        for cell in cells:
+            parts.append(_value_sig(cell.cell_contents, depth + 1, seen))
+    except ValueError:            # empty cell (still-initializing def)
+        return _SIG_MISS
+    for d in (fn.__defaults__ or ()):
+        parts.append(_value_sig(d, depth + 1, seen))
+    if any(p is _SIG_MISS for p in parts):
+        return _SIG_MISS
+    return ("fn",) + tuple(parts)
+
+
+def program_signature(fn) -> Optional[object]:
+    """Hashable cross-query identity of a program-defining closure, or
+    None when it captures anything that is not provably value-stable.
+    The mesh executor keys its shard_map program cache on this: the
+    warm-up run of a query shape traces + compiles once, and every
+    later query with the same shape dispatches the SAME executable
+    instead of paying a fresh trace (the last head of the per-query
+    dispatch tax after the fused exchange removed the per-round one)."""
+    sig = _fn_sig(fn, 0, frozenset())
+    return None if sig is _SIG_MISS else sig
+
+
 class _TimedEntry:
     """Jitted callable whose FIRST invocation is timed as a compile
     (jax.jit compiles lazily on first call; later shape buckets retrace
@@ -44,13 +144,19 @@ class _TimedEntry:
     is additionally bracketed with block_until_ready and attributed to
     the operator whose frame made the call."""
 
-    __slots__ = ("name", "fn", "first", "_lock", "record")
+    __slots__ = ("name", "fn", "first", "_lock", "record", "donate")
 
-    def __init__(self, name: str, fn, key=()):
+    def __init__(self, name: str, fn, key=(), donate=()):
         self.name = name
         self.fn = fn
         self.first = True
         self._lock = threading.Lock()
+        #: argument positions this executable DONATES (built with
+        #: ``jax.jit(donate_argnums=...)``): callers must treat those
+        #: inputs as consumed — the round-carried shard buffers of the
+        #: fused exchange loops alias their outputs instead of churning
+        #: HBM, and the donated arrays are deleted on dispatch
+        self.donate = tuple(donate)
         self.record = _prof.EXECUTABLES.register(name, key)
 
     def __call__(self, *args):

@@ -209,6 +209,33 @@ def repartition_by_pids_compact(batch: Batch, pid: jnp.ndarray,
     return Batch(batch.schema, out_cols, out_mask)
 
 
+def repartition_fused(batch: Batch, key_cols: Sequence[int],
+                      axis_name: str, n_partitions: int,
+                      assign: Sequence[int],
+                      quota: int) -> Tuple[Batch, jnp.ndarray]:
+    """Bucket-count + quota-compacted ship fused into ONE collective
+    program: returns ``(shipped, counts)`` where ``counts`` is
+    ``int64[len(assign)]`` live rows per bucket on this shard — the
+    ``_PartitionMap.observe`` feed, left on device so the host fetches
+    control scalars once per stage instead of once per round.
+
+    The caller passes a *capacity-safe* static ``quota`` (the per-shard
+    lane count): any per-(src, dst) live count is bounded by the source
+    shard's live rows, so no counts readback is needed to size the
+    exchange and no row can ever be dropped. Wire/output cost is n*C —
+    the masked all_to_all's cost — traded for erasing the per-round
+    dispatch -> fetch -> redispatch triple; when a tighter stats bound
+    exists, pass it instead and the cost matches the compact path."""
+    bucket = hash_partition_ids(batch, key_cols, len(assign))
+    b_ids = jnp.arange(len(assign), dtype=jnp.int32)[:, None]
+    counts = jnp.sum(batch.row_mask[None, :] & (bucket[None, :] == b_ids),
+                     axis=1).astype(jnp.int64)
+    pid = jnp.take(jnp.asarray(np.asarray(assign, dtype=np.int32)),
+                   bucket, axis=0)
+    return repartition_by_pids_compact(batch, pid, axis_name,
+                                       n_partitions, quota), counts
+
+
 def broadcast_batch(batch: Batch, axis_name: str) -> Batch:
     """Collective broadcast exchange: every shard receives all rows
     (Presto FIXED_BROADCAST_DISTRIBUTION — the replicated-join build side)."""

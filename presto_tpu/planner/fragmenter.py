@@ -91,13 +91,19 @@ class MeshStage:
     its mesh form (``scan-shard`` = data-parallel over splits, ``hash``
     = hash-partitioned on the owning shard, ``single`` = replicated /
     gathered finalize), ``exchange`` how its rows leave (``partition``,
-    ``broadcast``, ``single`` or None for the root)."""
+    ``broadcast``, ``single`` or None for the root). ``fused`` marks a
+    partition exchange the executor collapses into its consumer's
+    shard_map program (compute + bucket-count + ship as one dispatch);
+    one-shot whole-table shuffles — window, distinct, mark-distinct,
+    percentile finalize — stay unfused because a tight per-round quota
+    beats saving a single sync there."""
 
     id: int
     kind: str
     exchange: Optional[str]
     keys: Tuple[int, ...]
     ops: Tuple[str, ...]
+    fused: bool = False
 
 
 @dataclasses.dataclass
@@ -127,6 +133,32 @@ def _stage_ops(node: PlanNode) -> Tuple[str, ...]:
     return tuple(out)
 
 
+#: partition-exchange consumers whose shard_map program absorbs the
+#: shuffle (exec/distributed.py fuses repartition into these); window /
+#: distinct / mark-distinct / sort gather the whole table in one round
+#: and stay on the quota-tight unfused path.
+_FUSABLE_CONSUMERS = frozenset({"Aggregation", "Join", "SemiJoin"})
+
+
+def _partition_consumers(fragments: List[PlanFragment]) -> Dict[int, str]:
+    """Map upstream fragment id -> op name of the nearest operator above
+    the RemoteSourceNode that pulls from it in the consuming fragment."""
+    out: Dict[int, str] = {}
+
+    def walk(n: PlanNode, above: str) -> None:
+        name = type(n).__name__.replace("Node", "")
+        if isinstance(n, RemoteSourceNode):
+            for fid in n.fragment_ids:
+                out[fid] = above
+            return
+        for c in n.children:
+            walk(c, name)
+
+    for f in fragments:
+        walk(f.root, "Output")
+    return out
+
+
 def plan_mesh_stages(root: PlanNode) -> MeshPlan:
     """Cut a plan into mesh stages, or say why it cannot be cut. A plan
     the fragmenter cannot place (an operator with no exchange rule) has
@@ -137,11 +169,15 @@ def plan_mesh_stages(root: PlanNode) -> MeshPlan:
         fragmented = fragment_plan(root)
     except NotImplementedError as e:
         return MeshPlan([], False, str(e))
+    consumers = _partition_consumers(fragmented.fragments)
     stages = [
         MeshStage(f.id, _MESH_STAGE_KIND.get(f.partitioning, "single"),
                   f.output.kind if f.output is not None else None,
                   tuple(f.output.keys) if f.output is not None else (),
-                  _stage_ops(f.root))
+                  _stage_ops(f.root),
+                  fused=(f.output is not None
+                         and f.output.kind == "partition"
+                         and consumers.get(f.id) in _FUSABLE_CONSUMERS))
         for f in fragmented.fragments
     ]
     return MeshPlan(stages, True)

@@ -259,14 +259,14 @@ def format_mesh_rounds(stats) -> str:
     rows = round_rows(fl.query_id, fl.records())
     if rows:
         lines.append("  round stage kind         bucket             "
-                     "wall_ms       rows      bytes loads")
+                     "wall_ms       rows      bytes loads  dev_rounds")
         for r in rows[:_MESH_ROUND_ROWS]:
             (_qid, rnd, stage, kind, bucket, _t, wall_s, nrows,
-             nbytes, loads, _blocking) = r
+             nbytes, loads, _blocking, dev_rounds) = r
             lines.append(
                 f"  {rnd:>5} {stage:>5} {kind:<12} {bucket:<18} "
                 f"{wall_s * 1e3:>7,.1f} {nrows:>10} {nbytes:>10} "
-                f"{loads}")
+                f"{loads} {dev_rounds:>3}")
         if len(rows) > _MESH_ROUND_ROWS:
             lines.append(
                 f"  ... {len(rows) - _MESH_ROUND_ROWS} more rounds "

@@ -297,6 +297,14 @@ def test_mesh_stages_recipe():
     assert "scan-shard" in kinds
     exchanges = {s.exchange for s in mp.stages}
     assert "partition" in exchanges or "broadcast" in exchanges
+    # partition exchanges feeding an agg/join consumer are marked as
+    # fused into the consumer's shard_map program; everything else is
+    # not (the root stage in particular has no exchange to fuse)
+    for s in mp.stages:
+        if s.fused:
+            assert s.exchange == "partition"
+    if "partition" in exchanges:
+        assert any(s.fused for s in mp.stages)
 
 
 def test_per_chip_billing(runner):

@@ -182,6 +182,23 @@ def test_system_runtime_metrics_table(runner):
     assert kind == "counter" and value >= 1
 
 
+def test_system_table_count_star_matches_count_col(runner):
+    """count(*) over a system table prunes every column, leaving the
+    connector page source with nothing to ship — the batch must still
+    carry the row count. Regression: count(*) returned 0 while
+    count(col) was correct."""
+    runner.execute("select count(*) from nation")     # populate metrics
+    for table, col in [("system.runtime.metrics", "name"),
+                       ("system.runtime.mesh_rounds", "query_id")]:
+        star = runner.execute(
+            f"select count(*) from {table}").rows[0][0]
+        by_col = runner.execute(
+            f"select count({col}) from {table}").rows[0][0]
+        assert star == by_col, (table, star, by_col)
+        if table == "system.runtime.metrics":
+            assert star > 0
+
+
 def test_query_span_tree(runner, tracing):
     runner.execute("select count(*) from nation")
     spans = TRACER.export()

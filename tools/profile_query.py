@@ -161,7 +161,8 @@ def profile_query(runner, sql: str, warm_runs: int = 1,
             "supported": mp.supported,
             "stages": [{"id": s.id, "kind": s.kind,
                         "exchange": s.exchange, "keys": list(s.keys),
-                        "ops": list(s.ops)} for s in mp.stages],
+                        "ops": list(s.ops), "fused": s.fused}
+                       for s in mp.stages],
         }
         # flight recorder attribution (obs/flight.py): one command
         # yields both views of a mesh query — per-operator device time
