@@ -1018,6 +1018,49 @@ def _pct(sorted_vals, p):
                            len(sorted_vals) - 1)]
 
 
+def _slo_block(timeseries, slo) -> dict:
+    """The summary's ``slo`` block: declared objectives with final
+    burn/budget/state, every alert transition, and the per-evaluation
+    burn timeline (windowed p95 alongside, for latency objectives).
+    Schema is owned by tools/slo_report.py — check_bench_regression
+    --kind serving validates every pin through it."""
+    slo.evaluate()   # flush a final point so the timeline ends "now"
+    objectives = []
+    for (group, objective, rule, target, threshold_ms, state, _since,
+         burn_short, burn_long, budget) in slo.snapshot_rows():
+        objectives.append({
+            "group": group, "objective": objective, "rule": rule,
+            "target": target, "threshold_ms": threshold_ms,
+            "state": state,
+            "burn_short": burn_short and round(burn_short, 4),
+            "burn_long": burn_long and round(burn_long, 4),
+            "budget_remaining": round(budget, 4)})
+    alerts = [{"ts": round(e["ts"], 3), "group": e["group"],
+               "objective": e["objective"], "rule": e["rule"],
+               "from": e["from"], "to": e["to"]}
+              for e in slo.alert_log()]
+    timeline = []
+    for e in slo.history():
+        burns = [b for b in e["burn"].values() if b is not None]
+        pt = {"t": round(e["t"], 3), "group": e["group"],
+              "objective": e["objective"],
+              "burn": round(max(burns), 4) if burns else None,
+              "state": e["state"]}
+        if e.get("p95_ms") is not None:
+            pt["p95_ms"] = round(e["p95_ms"], 2)
+        timeline.append(pt)
+    # keep the pin readable: stride the timeline down to ~240 points,
+    # always keeping the final point of each objective
+    if len(timeline) > 240:
+        stride = (len(timeline) + 239) // 240
+        tail = timeline[-len(objectives):] if objectives else []
+        timeline = [p for i, p in enumerate(timeline)
+                    if i % stride == 0 or p in tail]
+    return {"sample_interval_s": timeseries.sample_interval_s,
+            "objectives": objectives, "alerts": alerts,
+            "timeline": timeline}
+
+
 def bench_serving(sf: float = 0.01, clients: int = 16,
                   per_client: int = 8, mixes=("mixed", "execute",
                                               "repeated")):
@@ -1045,6 +1088,8 @@ def bench_serving(sf: float = 0.01, clients: int = 16,
     from presto_tpu.connectors.spi import CatalogManager
     from presto_tpu.exec.runner import LocalRunner
     from presto_tpu.obs.metrics import REGISTRY
+    from presto_tpu.obs.slo import SLO
+    from presto_tpu.obs.timeseries import TIMESERIES
     from presto_tpu.server.protocol import PrestoTpuServer
 
     catalogs = CatalogManager()
@@ -1057,17 +1102,31 @@ def bench_serving(sf: float = 0.01, clients: int = 16,
     # it engaged.
     runner.session.properties.update({"plan_template_cache": True,
                                       "result_cache": True})
+    # both serving tenants declare SLOs (docs/observability.md): the
+    # health plane (obs/timeseries.py + obs/slo.py) tracks them live
+    # and the summary's ``slo`` block pins objectives + burn timeline.
+    # Thresholds are deliberately generous — the pin asserts the plane
+    # WORKS (timeline, windowed p95, no spurious pages), not that this
+    # machine class is fast.
+    _slo_spec = {"latencyTargetMs": 2000, "latencyObjective": 0.95,
+                 "availabilityObjective": 0.99}
     srv = PrestoTpuServer(runner, resource_groups={
         "rootGroups": [
             {"name": "serving", "hardConcurrencyLimit": 8,
              "maxQueued": 10_000,
              "subGroups": [
                  {"name": "dash", "hardConcurrencyLimit": 8,
-                  "schedulingWeight": 2},
+                  "schedulingWeight": 2, "slo": dict(_slo_spec)},
                  {"name": "adhoc", "hardConcurrencyLimit": 8,
-                  "schedulingWeight": 1}]}],
+                  "schedulingWeight": 1, "slo": dict(_slo_spec)}]}],
         "selectors": [{"user": "dash-.*", "group": "serving.dash"},
                       {"group": "serving.adhoc"}]})
+    # dense sampling for the bench's short wall: the 5s default would
+    # catch ~2 points per phase; 0.2s gives the burn timeline real
+    # resolution. srv.start() installs the tracker + starts the loop.
+    TIMESERIES.reset()
+    SLO.reset()
+    TIMESERIES.configure(sample_interval_s=0.2)
     srv.start()
     try:
         base = f"http://127.0.0.1:{srv.port}"
@@ -1236,8 +1295,10 @@ def bench_serving(sf: float = 0.01, clients: int = 16,
                  "partials": int(rdelta.get(
                      "result_cache_partial_total", 0.0))},
             ]
+        summary["slo"] = _slo_block(TIMESERIES, SLO)
         return summary
     finally:
+        TIMESERIES.stop()
         srv.stop()
 
 

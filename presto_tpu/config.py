@@ -311,6 +311,12 @@ CONFIG_KEYS: Dict[str, str] = {
                        "writing task (default 4GiB)",
     "failpoints": "deterministic fault-injection spec "
                   "(exec/failpoints.py grammar)",
+    "timeseries.sample-interval-s": "health-plane sampler cadence in "
+                                    "seconds (obs/timeseries.py; "
+                                    "default 5)",
+    "timeseries.retention-points": "bounded ring size per series "
+                                   "(default 360 = 30 min at the "
+                                   "default cadence)",
     # resource-groups.json group keys (server/resource_groups.py; not
     # *.properties keys, registered here so tools/analyze round-trips
     # the serving-plane configuration surface)
@@ -320,6 +326,20 @@ CONFIG_KEYS: Dict[str, str] = {
                        "beyond which a growing query is killed",
     "queryQueuedTimeout": "resource-groups.json: admission deadline for "
                           "queries queued in the group (duration)",
+    "slo": "resource-groups.json: per-group SLO block (obs/slo.py) — "
+           "latencyTargetMs/latencyObjective/availabilityObjective/"
+           "windows",
+    "latencyTargetMs": "resource-groups.json slo block: latency "
+                       "threshold in milliseconds (snaps up to the "
+                       "histogram bucket ladder)",
+    "latencyObjective": "resource-groups.json slo block: fraction of "
+                        "queries that must finish under the threshold "
+                        "(e.g. 0.95)",
+    "availabilityObjective": "resource-groups.json slo block: fraction "
+                             "of queries that must succeed "
+                             "(e.g. 0.999)",
+    "windows": "resource-groups.json slo block: burn-rate windows in "
+               "seconds (default [300, 3600])",
     "connector.name": "catalog properties: which connector factory",
     "tpch.scale-factor": "tpch catalog scale factor",
     "tpcds.scale-factor": "tpcds catalog scale factor",
@@ -353,6 +373,8 @@ ENV_VARS: Dict[str, str] = {
                               "(on/off; default on)",
     "PRESTO_TPU_FAILPOINTS": "failpoint arming spec applied at import "
                              "(exec/failpoints.py grammar)",
+    "PRESTO_TPU_TIMESERIES": "set to 'off' to disable the background "
+                             "health-plane sampler (obs/timeseries.py)",
     "BENCH_REPIN": "allow bench.py to overwrite pinned proxy seconds",
     "BENCH_OUT": "write the bench summary JSON here (regression gate "
                  "input)",
@@ -509,6 +531,12 @@ class NodeConfig:
         #: straight from config.properties, same as the
         #: PRESTO_TPU_FAILPOINTS env var
         self.failpoints = props.get("failpoints")
+        #: health-plane sampler cadence / per-series ring size
+        #: (obs/timeseries.py); None keeps the built-in defaults
+        raw_ts = props.get("timeseries.sample-interval-s")
+        self.timeseries_interval_s = float(raw_ts) if raw_ts else None
+        raw_tr = props.get("timeseries.retention-points")
+        self.timeseries_retention = int(raw_tr) if raw_tr else None
         #: session property defaults: session.<name>=<value>
         self.session_defaults = {
             k[len("session."):]: v for k, v in props.items()
@@ -561,6 +589,12 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
     if cfg.failpoints:
         from .exec.failpoints import FAILPOINTS
         FAILPOINTS.configure_from_spec(cfg.failpoints)
+    if cfg.timeseries_interval_s is not None \
+            or cfg.timeseries_retention is not None:
+        from .obs.timeseries import TIMESERIES
+        TIMESERIES.configure(
+            sample_interval_s=cfg.timeseries_interval_s,
+            retention_points=cfg.timeseries_retention)
     runner = LocalRunner(catalogs=catalogs, catalog=cfg.catalog,
                          schema=cfg.schema)
     # session.<name> defaults go through the same registry gate as SET

@@ -23,10 +23,26 @@ namespace like the rest of the engine's two-level names):
                  process-wide obs registry (reference
                  system.runtime.tasks), straggler/skew columns fed by
                  the coordinator's StageMonitor
-- ``metrics``   (name, kind, value) — the obs metrics registry
-                 (the reference's JMX connector role: engine metrics as
-                 a SQL table); histograms flatten to
+- ``metrics``   (name, kind, value, sampled_at) — the obs metrics
+                 registry (the reference's JMX connector role: engine
+                 metrics as a SQL table); histograms flatten to
                  ``.count/.sum/.min/.max/.p50/.p95/.p99`` rows
+                 (lifetime quantiles — windowed ones live in
+                 ``timeseries``); ``sampled_at`` is one wall-clock
+                 read per query so successive snapshots are
+                 distinguishable
+- ``timeseries`` (name, kind, ts, value) — windowed derived series
+                 from the time-series store (obs/timeseries.py):
+                 counters as per-interval ``.rate`` points, histograms
+                 as per-interval ``.p50/.p95/.p99`` + ``.rate``,
+                 gauges raw
+- ``slo``       (group, objective, rule, target, threshold_ms, state,
+                 since, burn_short, burn_long, budget_remaining) — one
+                 row per declared resource-group objective
+                 (obs/slo.py)
+- ``alerts``    (ts, group, objective, rule, from_state, to_state,
+                 burn_short, burn_long) — the SLO alert transition
+                 log ring, oldest first
 - ``nodes``     (node_id, state, coordinator, heartbeat_age_s,
                  active_tasks, mem_pool_peak_bytes, uri) — the
                  coordinator's node federator view (falls back to local
@@ -72,7 +88,22 @@ _SCHEMAS: Dict[str, List] = {
               ("elapsed_ms", T.DOUBLE), ("output_rows", T.BIGINT),
               ("output_bytes", T.BIGINT), ("straggler", T.BOOLEAN),
               ("skew_ratio", T.DOUBLE)],
-    "metrics": [("name", V), ("kind", V), ("value", T.DOUBLE)],
+    "metrics": [("name", V), ("kind", V), ("value", T.DOUBLE),
+                ("sampled_at", T.DOUBLE)],
+    # windowed derived points from the time-series store
+    # (obs/timeseries.py): the SQL face of /v1/metrics/history
+    "timeseries": [("name", V), ("kind", V), ("ts", T.DOUBLE),
+                   ("value", T.DOUBLE)],
+    # one row per declared resource-group SLO objective (obs/slo.py)
+    "slo": [("group_path", V), ("objective", V), ("rule", V),
+            ("target", T.DOUBLE), ("threshold_ms", T.DOUBLE),
+            ("state", V), ("since", T.DOUBLE),
+            ("burn_short", T.DOUBLE), ("burn_long", T.DOUBLE),
+            ("budget_remaining", T.DOUBLE)],
+    # the SLO alert transition log ring, oldest first (obs/slo.py)
+    "alerts": [("ts", T.DOUBLE), ("group_path", V), ("objective", V),
+               ("rule", V), ("from_state", V), ("to_state", V),
+               ("burn_short", T.DOUBLE), ("burn_long", T.DOUBLE)],
     "nodes": [("node_id", V), ("state", V), ("coordinator", T.BOOLEAN),
               ("heartbeat_age_s", T.DOUBLE), ("active_tasks", T.BIGINT),
               ("mem_pool_peak_bytes", T.BIGINT),
@@ -251,9 +282,30 @@ class SystemConnector(Connector):
                             float(t.get("skew_ratio", 0.0) or 0.0)))
             return out
         if table == "metrics":
+            import time
+
             from ..obs.metrics import REGISTRY
-            return [(m["name"], m["kind"], float(m["value"]))
-                    for m in REGISTRY.snapshot()]
+            from ..obs.timeseries import TIMESERIES
+            sampled_at = time.time()   # ONE clock read per query
+            out = [(m["name"], m["kind"], float(m["value"]),
+                    sampled_at)
+                   for m in REGISTRY.snapshot()]
+            # windowed quantiles next to the lifetime ``.p95`` rows:
+            # ``.p95_5m`` means "over the last 5 minutes" (absent
+            # until the sampler has two points in the window)
+            out.extend((name, "histogram", value, sampled_at)
+                       for name, value in
+                       TIMESERIES.window_quantile_rows(300.0))
+            return out
+        if table == "timeseries":
+            from ..obs.timeseries import TIMESERIES
+            return TIMESERIES.rows()
+        if table == "slo":
+            from ..obs.slo import SLO
+            return SLO.snapshot_rows()
+        if table == "alerts":
+            from ..obs.slo import SLO
+            return SLO.alert_rows()
         if table == "nodes":
             from ..obs.metrics import NODES
             rows = NODES.snapshot()

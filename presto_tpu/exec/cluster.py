@@ -1055,6 +1055,20 @@ class ClusterRunner:
             # its previous incarnation's sample
             drop = ("hbm_in_use_bytes", "hbm_peak_bytes")
         NODES.update(nid, drop=drop, **fields)
+        # federate the heartbeat sample into the coordinator's
+        # time-series store: per-node history becomes range-readable on
+        # the coordinator's /v1/metrics/history and
+        # system.runtime.timeseries without re-polling the worker
+        from ..obs.timeseries import TIMESERIES
+        TIMESERIES.record(f"node_active_tasks.{nid}",
+                          fields["active_tasks"])
+        TIMESERIES.record(f"node_mem_pool_peak_bytes.{nid}",
+                          fields["mem_pool_peak_bytes"])
+        if "hbm_in_use_bytes" in fields:
+            TIMESERIES.record(f"node_hbm_in_use_bytes.{nid}",
+                              fields["hbm_in_use_bytes"])
+            TIMESERIES.record(f"node_hbm_peak_bytes.{nid}",
+                              fields["hbm_peak_bytes"])
 
     def poll_nodes(self, urls: Optional[List[str]] = None) -> None:
         """One synchronous federation sweep (the background heartbeat

@@ -74,6 +74,11 @@ SITES: Dict[str, str] = {
     "mesh.repartition": "mesh executor ships one hash-exchange batch "
                         "over ICI (exec/distributed.py); error fails "
                         "the query before the collective dispatches",
+    "protocol.serve": "statement producer granted its resource-group "
+                      "slot, about to execute (server/protocol.py); "
+                      "key = group path — a sleep rule injects "
+                      "user-visible serving latency, error injects "
+                      "availability failures (SLO chaos drills)",
     "plancache.plan": "plan/template cache captured its write epoch "
                       "and is about to plan+optimize (serving/"
                       "plancache.py, serving/template.py) — the PR 8 "

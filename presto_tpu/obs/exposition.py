@@ -99,6 +99,28 @@ def render_exposition(registry: Optional[MetricsRegistry] = None,
                 (f"{base}_quantile",
                  {**labels, "quantile": _fmt(q)}, float(v)))
 
+    # windowed quantile gauges from the time-series store
+    # (obs/timeseries.py): the ``_quantile`` gauges above are
+    # process-LIFETIME estimates (kept for back-compat); these
+    # ``*_p95_5m``-style series difference the cumulative buckets
+    # between samples, so they mean "over the last 5 minutes".
+    # Absent until the sampler has two points in the window.
+    from .timeseries import TIMESERIES
+    for series in (TIMESERIES.series_names()
+                   if reg is TIMESERIES.registry else ()):
+        if TIMESERIES.kind(series) != "histogram":
+            continue
+        base, _, sub = series.partition(".")
+        base = _name(base)
+        labels = {"key": sub} if sub else {}
+        for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = TIMESERIES.window_quantile(series, 300.0, q)
+            if v is None:
+                continue
+            fam_name = f"{base}_{tag}_5m"
+            family(fam_name, "gauge").samples.append(
+                (fam_name, labels, float(v)))
+
     if nodes is not None:
         for n in nodes.snapshot():
             lab = {"node": str(n.get("node_id", ""))}

@@ -119,7 +119,15 @@ class Histogram:
     def state(self) -> Dict:
         """Consistent copy with cumulative buckets and derived
         quantiles — the shared feed of ``snapshot()`` and the
-        Prometheus exposition."""
+        Prometheus exposition.
+
+        The quantiles are **process-lifetime** estimates (since-boot
+        cumulative bucket counts): a recent latency spike dilutes into
+        everything observed before it.  For "p95 over the last 5
+        minutes" use the windowed series in ``obs.timeseries``
+        (``TimeSeriesStore.window_quantile`` / the ``*_p95_5m``
+        exposition gauges), which difference these cumulative buckets
+        between samples."""
         with self._lock:
             count, total = self.count, self.sum
             mn, mx = self.min, self.max
@@ -332,8 +340,13 @@ class NodeRegistry:
             if seen:
                 n["last_seen"] = time.monotonic()
 
-    def snapshot(self) -> List[Dict]:
-        now = time.monotonic()
+    def snapshot(self, now: Optional[float] = None) -> List[Dict]:
+        """Node docs with ``heartbeat_age_s`` derived from ONE clock
+        read — callers rendering several surfaces in one poll pass
+        ``now`` (``time.monotonic()``) so every row and every surface
+        agree on the same instant instead of drifting per-row."""
+        if now is None:
+            now = time.monotonic()
         with self._lock:
             out = []
             for n in self._nodes.values():

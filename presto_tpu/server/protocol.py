@@ -162,6 +162,7 @@ class _Query:
     def _run(self) -> None:
         from .resource_groups import QueryQueuedTimeoutError
         serving = None
+        t_submit = time.monotonic()
         try:
             # admission: block in QUEUED until the resource group grants
             # a run slot (reference dispatcher/DispatchManager.java:134 +
@@ -186,6 +187,13 @@ class _Query:
                             f"{self._admission.group.path!r}")
                 from ..serving.groups import serving_context
                 serving = serving_context(self._admission)
+                # SLO latency-spike injection point (tests/chaos): a
+                # sleep rule here adds user-visible serving latency, a
+                # fail rule adds availability errors — both flow into
+                # the per-group serving_* metrics recorded below
+                from ..exec.failpoints import FAILPOINTS
+                FAILPOINTS.hit("protocol.serve",
+                               key=self._admission.group.path)
             self.state = "RUNNING"
             kwargs = ({"serving": serving}
                       if serving is not None and self._accepts_serving
@@ -259,8 +267,29 @@ class _Query:
                 serving.close()
             if self._admission is not None:
                 self._admission.release()
+                self._record_serving_slo(t_submit)
             self._put_page(None)      # end-of-stream sentinel
             self.done.set()
+
+    def _record_serving_slo(self, t_submit: float) -> None:
+        """Per-group SLO feed (obs/slo.py): end-to-end serving latency
+        (queue wait included — that's what the tenant experiences) and
+        request/error counts, keyed by the admitting group's path.
+        User cancels are excluded: they are neither a latency sample
+        nor an availability error the server caused."""
+        with self._state_lock:
+            state, error = self.state, self.error
+        if state not in ("FINISHED", "FAILED"):
+            return              # cancelled while queued, never served
+        if error is not None and error.get("errorName") == "USER_CANCELED":
+            return
+        from ..obs.metrics import REGISTRY
+        path = self._admission.group.path
+        REGISTRY.counter(f"serving_requests_total.{path}").inc()
+        if state == "FAILED":
+            REGISTRY.counter(f"serving_errors_total.{path}").inc()
+        REGISTRY.histogram(f"serving_latency_seconds.{path}").observe(
+            time.monotonic() - t_submit)
 
     def _put_page(self, page) -> None:
         """Bounded put that gives up if the query is cancelled (a cancel
@@ -514,6 +543,16 @@ class _Handler(BaseHTTPRequestHandler):
                         if q.state in ("QUEUED", "RUNNING"))},
             })
             return
+        if self.path.split("?")[0].rstrip("/") == "/v1/metrics/history":
+            # windowed range reads over the time-series store
+            # (obs/timeseries.py) — same unauthenticated node-internal
+            # plane as the scrape endpoint below; federated worker
+            # series are readable here too
+            from ..obs.timeseries import TIMESERIES
+            qs = self.path.split("?", 1)[1] if "?" in self.path else ""
+            code, doc = TIMESERIES.history_doc(qs)
+            self._reply(code, doc)
+            return
         if self.path.split("?")[0].rstrip("/") == "/v1/metrics":
             # Prometheus scrape surface (unauthenticated, like
             # /v1/service — node-internal plane): the coordinator's
@@ -754,6 +793,14 @@ class PrestoTpuServer:
         return q
 
     def start(self) -> None:
+        # the health plane rides server lifetime: one process-wide
+        # sampler feeds the time-series store, the SLO tracker
+        # evaluates after every tick (both idempotent — a process
+        # running several servers shares one plane)
+        from ..obs.slo import SLO
+        from ..obs.timeseries import TIMESERIES
+        SLO.install()
+        TIMESERIES.ensure_started()
         self._thread.start()
 
     def begin_shutdown(self) -> None:

@@ -83,6 +83,46 @@ def _parse_timeout_s(v) -> Optional[float]:
     return parse_duration_s(v)
 
 
+def _parse_slo(spec) -> Optional[dict]:
+    """Normalize and validate a per-group ``slo`` block (see
+    docs/serving.md): latency objective (``latencyTargetMs`` +
+    ``latencyObjective``), availability objective
+    (``availabilityObjective``), optional ``windows`` (seconds).
+    Objectives are fractions in (0, 1); fail fast on malformed config
+    so a typo'd SLO cannot silently track nothing."""
+    if spec is None:
+        return None
+    if not isinstance(spec, dict):
+        raise ValueError(f"slo block must be an object, got {spec!r}")
+    out: dict = {}
+    lat_obj = spec.get("latencyObjective")
+    if lat_obj is not None:
+        target_ms = spec.get("latencyTargetMs")
+        if target_ms is None:
+            raise ValueError("slo.latencyObjective requires "
+                             "slo.latencyTargetMs")
+        out["latencyObjective"] = float(lat_obj)
+        out["latencyTargetMs"] = float(target_ms)
+    avail = spec.get("availabilityObjective")
+    if avail is not None:
+        out["availabilityObjective"] = float(avail)
+    for key in ("latencyObjective", "availabilityObjective"):
+        v = out.get(key)
+        if v is not None and not 0.0 < v < 1.0:
+            raise ValueError(f"slo.{key} must be in (0, 1), got {v}")
+    if not out:
+        raise ValueError("slo block declares no objective "
+                         "(latencyObjective or availabilityObjective)")
+    windows = spec.get("windows")
+    if windows is not None:
+        ws = sorted(float(w) for w in windows)
+        if not ws or any(w <= 0 for w in ws):
+            raise ValueError(f"slo.windows must be positive seconds, "
+                             f"got {windows!r}")
+        out["windows"] = ws
+    return out
+
+
 class Admission:
     """Handle for one submitted query: wait() blocks until a run slot is
     granted; release() frees it (must be called exactly once)."""
@@ -141,7 +181,8 @@ class ResourceGroup:
                  max_queued: int = 100, scheduling_weight: int = 1,
                  soft_memory_limit: Optional[int] = None,
                  hard_memory_limit: Optional[int] = None,
-                 query_queued_timeout: Optional[float] = None):
+                 query_queued_timeout: Optional[float] = None,
+                 slo: Optional[dict] = None):
         self.manager = manager
         self.name = name
         self.parent = parent
@@ -156,6 +197,9 @@ class ResourceGroup:
         self.hard_memory_limit = hard_memory_limit
         self.memory_reserved = 0
         self.query_queued_timeout = query_queued_timeout
+        #: normalized SLO block (``_parse_slo``) — consumed by
+        #: obs/slo.py through ``info()``; None = no objectives
+        self.slo = slo
         self.children: Dict[str, ResourceGroup] = {}
         self.queue: List[Admission] = []
         self.running = 0
@@ -215,6 +259,7 @@ class ResourceGroup:
             "hardMemoryLimitBytes": self.hard_memory_limit,
             "memoryReservedBytes": self.memory_reserved,
             "queryQueuedTimeoutS": self.query_queued_timeout,
+            "slo": self.slo,
             "numRunning": self.running,
             "numQueued": len(self.queue),
             "subGroups": [c.info() for c in self.children.values()],
@@ -263,7 +308,8 @@ class ResourceGroupManager:
             hard_memory_limit=_parse_limit_bytes(
                 spec.get("hardMemoryLimit")),
             query_queued_timeout=_parse_timeout_s(
-                spec.get("queryQueuedTimeout")))
+                spec.get("queryQueuedTimeout")),
+            slo=_parse_slo(spec.get("slo")))
         if parent is None:
             self.roots[g.name] = g
         else:

@@ -721,6 +721,15 @@ class _Handler(BaseHTTPRequestHandler):
         if parts[:2] == ["v1", "info"]:
             self._json(200, self.worker.info())
             return
+        if parts[:3] == ["v1", "metrics", "history"]:
+            # windowed range reads (obs/timeseries.py); must precede
+            # the prefix match below — ["v1","metrics"] would swallow
+            # the history path
+            from ..obs.timeseries import TIMESERIES
+            qs = self.path.split("?", 1)[1] if "?" in self.path else ""
+            code, doc = TIMESERIES.history_doc(qs)
+            self._json(code, doc)
+            return
         if parts[:2] == ["v1", "metrics"]:
             # Prometheus scrape surface: the process-wide registry in
             # text exposition format (obs/exposition.py)
@@ -876,6 +885,10 @@ class WorkerServer:
         self._announcer = None
 
     def start(self) -> None:
+        # workers carry the same windowed-history surface as the
+        # coordinator: the process-wide sampler feeds /v1/metrics/history
+        from ..obs.timeseries import TIMESERIES
+        TIMESERIES.ensure_started()
         self._thread.start()
 
     def start_announcing(self, discovery_uri: str,

@@ -50,6 +50,13 @@ def register_manager(manager) -> None:
         _MANAGERS.add(manager)
 
 
+def live_managers() -> list:
+    """Stable list of the live managers (the SLO tracker and the
+    signals feed walk group trees through this)."""
+    with _managers_lock:
+        return list(_MANAGERS)
+
+
 class QueryServingContext:
     """One admitted query's serving identity: the group it bills memory
     to and the scheduler share its device quanta draw from. Carried on

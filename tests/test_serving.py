@@ -460,6 +460,11 @@ def test_serving_regression_gate_smoke(capsys):
     # comparison above)
     assert any("template_hit_rate" in m for m in doc["metrics"])
     assert any("result_hit_rate" in m for m in doc["metrics"])
+    # ISSUE 18: r03+ pins carry the health plane's slo block — smoke
+    # schema-validates it through tools/slo_report.py (objectives,
+    # burn timeline with windowed p95, alert transitions)
+    assert doc["slo"]["ok"], doc["slo"]["violations"]
+    assert doc["slo"]["blocks"] == 1
 
 
 def test_serving_gate_latency_metrics_invert():

@@ -1,7 +1,8 @@
 """Registry-consistency lints: one framework for every string-keyed
 registry where a typo is a silent no-op.
 
-The engine has four such registries; each gets the same treatment —
+The engine has a family of such registries; each gets the same
+treatment —
 every literal USE site must resolve to exactly one DECLARATION, every
 declaration must be used, and the human-facing doc table must
 round-trip against the code:
@@ -18,6 +19,11 @@ round-trip against the code:
   ``FAILPOINTS.hit("...")`` literal must be a declared site, every
   declared site must have a hit() call, and the catalog table in
   ``docs/robustness.md`` must match two-way.
+- **alert rules** (``obs/slo.py`` ALERT_RULES): every literal
+  ``alert_rule("...")`` must name a declared rule, every declared rule
+  must be used, and the "## Alert rules" table in
+  ``docs/observability.md`` round-trips two-way — an unknown alert
+  name is a page that can never fire.
 - **config keys** (``presto_tpu/config.py`` CONFIG_KEYS): literals
   read off parsed ``*.properties`` dicts in config.py / plugin.py /
   connectors must be declared (``session.*``-style prefixes
@@ -47,6 +53,7 @@ CHECKER = "registries"
 
 CONFIG_PY = "presto_tpu/config.py"
 FAILPOINTS_PY = "presto_tpu/exec/failpoints.py"
+SLO_PY = "presto_tpu/obs/slo.py"
 EXPOSITION_PY = "presto_tpu/obs/exposition.py"
 OBS_DOC = "docs/observability.md"
 ROBUSTNESS_DOC = "docs/robustness.md"
@@ -61,7 +68,9 @@ CONFIG_KEY_SCAN = (CONFIG_PY, "presto_tpu/plugin.py",
 
 _METRIC_KINDS = ("counter", "gauge", "histogram")
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*(\*[a-z0-9_]*)*$")
-_UNIT_SUFFIXES = ("_total", "_seconds", "_bytes")
+# _ratio is Prometheus's canonical suffix for unitless 0..1 fractions
+# (SLO burn rates / error budgets)
+_UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio")
 
 #: doc tokens that share the unit-suffix shape but are SQL column
 #: names, not metric families
@@ -69,7 +78,8 @@ _DOC_IGNORE = {"hbm_bytes", "peak_memory_bytes", "output_bytes",
                "arg_bytes", "temp_bytes", "generated_code_bytes",
                "mem_pool_peak_bytes"}
 
-_DOC_FAMILY = re.compile(r"^[a-z][a-z0-9_]*_(?:total|seconds|bytes)$")
+_DOC_FAMILY = re.compile(
+    r"^[a-z][a-z0-9_]*_(?:total|seconds|bytes|ratio)$")
 
 
 def _name_pattern(arg: ast.expr) -> Optional[str]:
@@ -475,6 +485,91 @@ def failpoint_findings(root: str,
     return out
 
 
+# -- alert rules -------------------------------------------------------------
+
+def declared_alert_rules(slo_path: str) -> Dict[str, int]:
+    """ALERT_RULES = {"name": ...} keys -> lineno (obs/slo.py)."""
+    return _module_dict_keys(slo_path, "ALERT_RULES")
+
+
+def alert_rule_uses(paths: Sequence[str], root: str
+                    ) -> List[Tuple[str, str, int]]:
+    """[(rule, rpath, lineno)] for literal ``alert_rule("...")`` calls
+    (plain or attribute-qualified)."""
+    out: List[Tuple[str, str, int]] = []
+    for path in paths:
+        tree = parse_file(path)
+        if tree is None:
+            continue
+        rpath = rel(path, root)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None)
+            if name != "alert_rule":
+                continue
+            rule = str_const(node.args[0])
+            if rule:
+                out.append((rule, rpath, node.lineno))
+    return out
+
+
+def alert_rule_findings(root: str,
+                        scan_paths: Optional[Sequence[str]] = None,
+                        slo_path: Optional[str] = None,
+                        doc_path: Optional[str] = None,
+                        two_way: bool = True) -> List[Finding]:
+    """Alert-name registry lint, same contract as the failpoint sites:
+    every literal ``alert_rule("...")`` must name a declared
+    ``ALERT_RULES`` entry (obs/slo.py raises on unknown names at
+    runtime, but only when that code path runs — the lint catches the
+    typo before a page never fires), every declared rule must have a
+    use, and the "## Alert rules" table in docs/observability.md must
+    round-trip two-way."""
+    slo_path = slo_path or os.path.join(root, SLO_PY)
+    declared = declared_alert_rules(slo_path)
+    paths = (list(scan_paths) if scan_paths is not None
+             else sorted(set(walk_py(root, ["presto_tpu"]))))
+    uses = alert_rule_uses(paths, root)
+    out: List[Finding] = []
+    used: Set[str] = set()
+    for rule, rpath, line in uses:
+        used.add(rule)
+        if rule not in declared:
+            out.append(Finding(
+                CHECKER, "unknown-alert-rule", rpath, line, rule,
+                f"alert_rule({rule!r}) names a rule missing from "
+                f"slo.ALERT_RULES — the tracker would raise instead "
+                f"of alerting"))
+    if not two_way:
+        return out
+    slo_rel = rel(slo_path, root)
+    for rule, line in sorted(declared.items()):
+        if rule not in used:
+            out.append(Finding(
+                CHECKER, "unused-alert-rule", slo_rel, line, rule,
+                f"declared alert rule {rule!r} has no alert_rule() "
+                f"use — it can never fire"))
+    doc = doc_path if doc_path is not None \
+        else os.path.join(root, OBS_DOC)
+    if os.path.isfile(doc):
+        doc_rel = rel(doc, root)
+        documented = doc_table_tokens(doc, "## Alert rules")
+        for rule in sorted(set(declared) - documented):
+            out.append(Finding(
+                CHECKER, "alert-rule-doc-drift", doc_rel, 1, rule,
+                f"alert rule {rule!r} missing from the Alert rules "
+                f"table in {doc_rel}"))
+        for rule in sorted(documented - set(declared)):
+            out.append(Finding(
+                CHECKER, "alert-rule-doc-drift", doc_rel, 1, rule,
+                f"{doc_rel} documents unknown alert rule {rule!r}"))
+    return out
+
+
 # -- config keys -------------------------------------------------------------
 
 def declared_config_keys(config_path: str) -> Dict[str, int]:
@@ -628,6 +723,7 @@ def check(root: str) -> List[Finding]:
         doc_path=os.path.join(root, OBS_DOC)))
     out.extend(session_prop_findings(root))
     out.extend(failpoint_findings(root))
+    out.extend(alert_rule_findings(root))
     out.extend(config_key_findings(root))
     out.extend(env_var_findings(root))
     return out

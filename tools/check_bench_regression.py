@@ -35,6 +35,13 @@ so an exchange change that blows the control-sync or repartition
 budget fails even when rows/s noise hides it. Pins without attribution
 (r06 and older) pass the attribution gate vacuously.
 
+Serving rounds from r03 on also carry the health plane's ``slo``
+block (obs/slo.py via bench.py): declared per-group objectives, burn
+rates, alert transitions, and the burn timeline with the windowed
+p95. ``--kind serving`` schema-validates the block through
+``tools/slo_report.py`` (smoke mode gates the pinned round, run mode
+the candidate); pins without a block (r02 and older) pass vacuously.
+
 Usage:
     python tools/check_bench_regression.py --run bench_out.json
     python tools/check_bench_regression.py --run bench_out.json \
@@ -221,6 +228,20 @@ def _attribution_gate(flat: Dict[str, Dict]) -> Dict:
     return validate_attribution(flat)
 
 
+def _slo_gate(flat: Dict[str, Dict]) -> Dict:
+    """Schema verdict for a serving summary's SLO block (objectives,
+    burn timeline, alert transitions). The schema (and the validator)
+    live in tools/slo_report.py so the report tool and this gate can
+    never disagree about it. Pins without a block (r02 and older)
+    pass vacuously."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        from slo_report import validate_slo_block
+    finally:
+        sys.path.pop(0)
+    return validate_slo_block(flat)
+
+
 def smoke(baseline_path: str) -> Dict:
     """Self-consistency: the pinned round must pass against itself,
     and a halved copy must fail. Proves discovery, parsing, tolerance
@@ -334,6 +355,20 @@ def main(argv=None) -> int:
                 {"metric": "*", "kind": "io", "detail": str(e)}]}
         verdict["attribution"] = attr
         if not attr["ok"]:
+            verdict["verdict"] = "fail"
+
+    if args.kind == "serving":
+        # slo gate: in smoke mode the pinned round itself must carry a
+        # schema-valid slo block (so a bad re-pin cannot be
+        # committed); in run mode the candidate must
+        target = baseline_path if args.smoke else args.run
+        try:
+            slo = _slo_gate(load_summary(target))
+        except (OSError, ValueError) as e:
+            slo = {"blocks": 0, "ok": False, "violations": [
+                {"metric": "*", "kind": "io", "detail": str(e)}]}
+        verdict["slo"] = slo
+        if not slo["ok"]:
             verdict["verdict"] = "fail"
 
     text = json.dumps(verdict, indent=2)

@@ -6,8 +6,9 @@ and the tier-1 wiring in tests/test_obs_ops.py).
 Rules (enforced by the analyze package):
 
 - metric families are ``snake_case`` with a unit suffix
-  (``_total``/``_seconds``/``_bytes``); dotted tails are label
-  encodings validated on the family;
+  (``_total``/``_seconds``/``_bytes``, or ``_ratio`` for unitless
+  0..1 fractions); dotted tails are label encodings validated on the
+  family;
 - one family, one type (a name can't be both counter and gauge);
 - **doc drift**: every family in docs/observability.md exists in code
   (registry call site or exposition-only series), and every registered
