@@ -400,6 +400,14 @@ ENV_VARS: Dict[str, str] = {
     "SERVING_QUERIES": "serving bench statements per client",
     "SERVING_MIX": "comma-separated serving bench phases "
                    "(mixed/execute/repeated)",
+    "SERVING_COORDINATORS": "serving bench fleet width: N>=2 spawns N "
+                            "coordinator subprocesses behind a "
+                            "FleetClient (tools/fleet.py); unset/0 = "
+                            "classic single-coordinator bench",
+    "SERVING_INLINE_LANE": "set to 0 to disable the statement POST "
+                           "inline lane (proven-fast statements "
+                           "executing in the handler thread); default "
+                           "on",
     "SERVING_OUT": "write the serving bench pin JSON here",
     "MULTICHIP_OUT": "write the multichip bench pin JSON here",
     "ELASTIC_OUT": "write the chaos recovery-time summary here "

@@ -1085,6 +1085,16 @@ class ClusterRunner:
                     NODES.update(nid, seen=False, state="UNREACHABLE")
                 continue
             self._note_node_info(url, info)
+        # coordinator-role discovery entries (the serving fleet's
+        # peers) surface in system.runtime.nodes too, flagged
+        # coordinator=True — they are membership, never task targets
+        # (active_urls() filters them out of scheduling)
+        if self.discovery is not None:
+            for n in self.discovery.nodes():
+                if n.get("role") == "coordinator" and n.get("active"):
+                    NODES.update(n["nodeId"], state=n.get(
+                        "state", "ACTIVE"), coordinator=True,
+                        uri=n.get("uri", ""))
 
     def _mesh_route(self, properties: Optional[Dict[str, object]] = None
                     ) -> bool:

@@ -92,6 +92,12 @@ SITES: Dict[str, str] = {
                            "is about to recompute the delta "
                            "(serving/resultcache.py serve()) — the "
                            "PR 12 double-apply window",
+    "fleet.broadcast": "fleet member about to POST one write bump to "
+                       "one peer (serving/fleet.py); key = "
+                       "connector/table@peer — an error rule DROPS the "
+                       "broadcast, leaving that peer to the hit-time "
+                       "data_version revalidation backstop (coherence "
+                       "chaos drills)",
 }
 
 

@@ -369,3 +369,55 @@ class SloTracker:
 
 
 SLO = SloTracker()
+
+
+def slo_block(store: Optional[TimeSeriesStore] = None,
+              tracker: Optional[SloTracker] = None,
+              max_timeline_points: int = 240) -> dict:
+    """The serving ``slo`` block: declared objectives with final
+    burn/budget/state, every alert transition, and the per-evaluation
+    burn timeline (windowed p95 alongside, for latency objectives).
+
+    One builder serves both consumers — ``bench.py serving`` pins it
+    into SERVING_r*.json and the coordinator serves it live on
+    ``GET /v1/slo`` (the fleet bench merges one block per coordinator).
+    Schema is owned by tools/slo_report.py — check_bench_regression
+    --kind serving validates every pin through it."""
+    store = store if store is not None else TIMESERIES
+    tracker = tracker if tracker is not None else SLO
+    tracker.evaluate()  # flush a final point so the timeline ends "now"
+    objectives = []
+    for (group, objective, rule, target, threshold_ms, state, _since,
+         burn_short, burn_long, budget) in tracker.snapshot_rows():
+        objectives.append({
+            "group": group, "objective": objective, "rule": rule,
+            "target": target, "threshold_ms": threshold_ms,
+            "state": state,
+            "burn_short": burn_short and round(burn_short, 4),
+            "burn_long": burn_long and round(burn_long, 4),
+            "budget_remaining": round(budget, 4)})
+    alerts = [{"ts": round(e["ts"], 3), "group": e["group"],
+               "objective": e["objective"], "rule": e["rule"],
+               "from": e["from"], "to": e["to"]}
+              for e in tracker.alert_log()]
+    timeline = []
+    for e in tracker.history():
+        burns = [b for b in e["burn"].values() if b is not None]
+        pt = {"t": round(e["t"], 3), "group": e["group"],
+              "objective": e["objective"],
+              "burn": round(max(burns), 4) if burns else None,
+              "state": e["state"]}
+        if e.get("p95_ms") is not None:
+            pt["p95_ms"] = round(e["p95_ms"], 2)
+        timeline.append(pt)
+    # keep the pin readable: stride the timeline down, always keeping
+    # the final point of each objective
+    if len(timeline) > max_timeline_points:
+        stride = ((len(timeline) + max_timeline_points - 1)
+                  // max_timeline_points)
+        tail = timeline[-len(objectives):] if objectives else []
+        timeline = [p for i, p in enumerate(timeline)
+                    if i % stride == 0 or p in tail]
+    return {"sample_interval_s": store.sample_interval_s,
+            "objectives": objectives, "alerts": alerts,
+            "timeline": timeline}

@@ -23,9 +23,11 @@ bounded queue — the role of the coordinator's per-query output buffer
 """
 from __future__ import annotations
 
+import collections
 import datetime
 import json
 import math
+import os
 import queue
 import secrets
 import threading
@@ -106,6 +108,35 @@ class _ProducerPool:
 _PRODUCERS = _ProducerPool()
 
 
+class _InlinePages:
+    """Page channel for the inline lane. The producer runs to
+    completion in the consumer's own thread before any reader can
+    exist (``_Query.__init__`` calls ``_run()`` synchronously), so a
+    plain deque replaces ``queue.Queue`` — six threading-primitive
+    constructions plus a lock round-trip per put/get, per statement,
+    on the hottest path. Classic paging from another handler thread
+    after the POST returned is still safe: the deque is fully
+    populated before the response is written, and deque append/popleft
+    are atomic."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self):
+        self._d: collections.deque = collections.deque()
+
+    def put(self, item, timeout=None) -> None:
+        self._d.append(item)
+
+    def get(self, timeout=None):
+        return self.get_nowait()
+
+    def get_nowait(self):
+        try:
+            return self._d.popleft()
+        except IndexError:
+            raise queue.Empty from None
+
+
 class _Query:
     """One running statement: executes on the producer pool, pages
     buffered."""
@@ -113,7 +144,8 @@ class _Query:
     def __init__(self, qid: str, slug: str, sql: str, runner,
                  session_overrides: Dict[str, str],
                  admission=None, user: str = "",
-                 accepts_serving: Optional[bool] = None):
+                 accepts_serving: Optional[bool] = None,
+                 inline: bool = False):
         self.user = user
         self.id = qid
         self.slug = slug
@@ -131,7 +163,15 @@ class _Query:
         self.columns: Optional[List[Dict]] = None
         self.set_session: Dict[str, str] = {}
         self.clear_session: List[str] = []
-        self._pages: "queue.Queue" = queue.Queue(maxsize=8)
+        self._inline = bool(inline and (admission is None
+                                        or admission.granted))
+        # inline lane: the producer IS the consumer's thread, so a
+        # bounded put could deadlock — unbounded there (rows are
+        # already materialized; the buffered copy is the same order of
+        # memory the async path would build). Bounded (backpressure on
+        # slow pagers) on the pool path.
+        self._pages = (_InlinePages() if self._inline
+                       else queue.Queue(maxsize=8))
         self._next_token = 0
         self._last_page: Optional[Tuple[int, Optional[List]]] = None
         self._page_lock = checked_lock("protocol.query.pages")
@@ -145,7 +185,20 @@ class _Query:
         self.done = threading.Event()
         self._runner = runner
         self._overrides = session_overrides
-        _PRODUCERS.submit(self._run)
+        if self._inline:
+            # inline lane: a statement the server has seen complete
+            # within the fast-path grace runs in the CALLING (http
+            # handler) thread when its group admits without queueing.
+            # Under keep-alive the handler thread is connection-bound
+            # either way, so this spends no extra thread — it erases
+            # the submit->producer and page->poller wakeups, which on
+            # a saturated host are two forced context switches per
+            # statement.
+            from ..obs.metrics import REGISTRY
+            REGISTRY.counter("serving_inline_lane_total").inc()
+            self._run()
+        else:
+            _PRODUCERS.submit(self._run)
 
     def _queued_timeout_override(self):
         """Per-query ``query_queued_timeout``: the client's session
@@ -327,6 +380,10 @@ class _Query:
                     page = self._pages.get(timeout=min(remaining, 0.1))
                     break
                 except queue.Empty:
+                    if self._inline:
+                        # the inline producer already ran to completion;
+                        # an empty channel means no page is ever coming
+                        return False, None
                     continue
             self._last_page = (token, page)
             self._next_token = token + 1
@@ -419,6 +476,32 @@ refresh(); setInterval(refresh, 2000);
 """
 
 
+class _FastHeaders:
+    """Case-insensitive read-only header mapping — the slice of
+    ``email.message.Message`` this server consumes (``.get`` with a
+    default). Keys are stored lower-cased by :meth:`_Handler.parse_request`."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d: Dict[str, str]):
+        self._d = d
+
+    def get(self, name: str, default=None):
+        return self._d.get(name.lower(), default)
+
+    def __getitem__(self, name: str) -> str:
+        v = self._d.get(name.lower())
+        if v is None:
+            raise KeyError(name)
+        return v
+
+    def __contains__(self, name) -> bool:
+        return isinstance(name, str) and name.lower() in self._d
+
+    def items(self):
+        return list(self._d.items())
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "presto-tpu"
     protocol_version = "HTTP/1.1"
@@ -430,20 +513,139 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):   # silence request logging
         pass
 
+    def parse_request(self) -> bool:
+        """Drop-in for ``BaseHTTPRequestHandler.parse_request`` with
+        the header block parsed by a plain line loop instead of the
+        email package (``http.client.parse_headers`` routes every
+        request through the MIME feedparser — a measurable slice of a
+        warm cache-hit statement's handler CPU). Same request-line,
+        close/keep-alive, Expect, and limit semantics; headers land in
+        a :class:`_FastHeaders` (case-insensitive ``.get``, the only
+        surface this server uses)."""
+        self.command = None
+        self.request_version = version = self.default_request_version
+        self.close_connection = True
+        requestline = str(self.raw_requestline,
+                          "iso-8859-1").rstrip("\r\n")
+        self.requestline = requestline
+        words = requestline.split()
+        if not words:
+            return False
+        if len(words) >= 3:
+            version = words[-1]
+            if version == "HTTP/1.1":
+                # the only version real clients send here
+                self.close_connection = False
+            else:
+                try:
+                    if not version.startswith("HTTP/"):
+                        raise ValueError
+                    base = version.split("/", 1)[1]
+                    nums = base.split(".")
+                    if len(nums) != 2:
+                        raise ValueError
+                    vnum = int(nums[0]), int(nums[1])
+                except (ValueError, IndexError):
+                    self.send_error(
+                        400, "Bad request version (%r)" % version)
+                    return False
+                if vnum >= (2, 0):
+                    self.send_error(
+                        505, "Invalid HTTP version (%s)" % base)
+                    return False
+                if vnum >= (1, 1) \
+                        and self.protocol_version >= "HTTP/1.1":
+                    self.close_connection = False
+            self.request_version = version
+        if not 2 <= len(words) <= 3:
+            self.send_error(
+                400, "Bad request syntax (%r)" % requestline)
+            return False
+        command, path = words[:2]
+        if len(words) == 2:
+            self.close_connection = True
+            if command != "GET":
+                self.send_error(
+                    400, "Bad HTTP/0.9 request type (%r)" % command)
+                return False
+        self.command, self.path = command, path
+        if self.path.startswith("//"):
+            # gh-87389: collapse leading // (open-redirect hardening,
+            # mirrored from the stock parser)
+            self.path = "/" + self.path.lstrip("/")
+        hdrs: Dict[str, str] = {}
+        last: Optional[str] = None
+        while True:
+            line = self.rfile.readline(65537)
+            if len(line) > 65536:
+                self.send_error(431, "Line too long")
+                return False
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if len(hdrs) >= 100:
+                self.send_error(431, "Too many headers")
+                return False
+            text = line.decode("iso-8859-1").rstrip("\r\n")
+            if text[:1] in (" ", "\t") and last is not None:
+                # obs-fold continuation line
+                hdrs[last] += " " + text.strip()
+                continue
+            key, sep, value = text.partition(":")
+            if not sep:
+                continue        # tolerated, like the email parser
+            last = key.strip().lower()
+            hdrs[last] = value.strip()
+        self.headers = _FastHeaders(hdrs)
+        conntype = hdrs.get("connection", "").lower()
+        if conntype == "close":
+            self.close_connection = True
+        elif (conntype == "keep-alive"
+                and self.protocol_version >= "HTTP/1.1"):
+            self.close_connection = False
+        expect = hdrs.get("expect", "").lower()
+        if (expect == "100-continue"
+                and self.protocol_version >= "HTTP/1.1"
+                and self.request_version >= "HTTP/1.1"):
+            if not self.handle_expect_100():
+                return False
+        return True
+
     @property
     def _srv(self) -> "PrestoTpuServer":
         return self.server.presto       # type: ignore[attr-defined]
 
+    #: (whole second, rendered Date header value) — every response
+    #: within one second shares the strftime work
+    _date_cache: Tuple[int, str] = (0, "")
+    _version_cache: str = ""
+
     def _reply(self, code: int, doc: Dict,
                headers: Optional[Dict[str, str]] = None) -> None:
+        # hand-composed response in ONE wfile.write: the wfile of a
+        # BaseHTTPRequestHandler is unbuffered, so the stock
+        # send_response/.../end_headers + body sequence costs two
+        # sendall syscalls (and two TCP segments) per response — on
+        # the serving hot path that is measurable against a ~1ms
+        # statement
         body = json.dumps(doc).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
+        now = int(time.time())
+        date = _Handler._date_cache
+        if date[0] != now:
+            date = (now, self.date_time_string(now))
+            _Handler._date_cache = date
+        if not _Handler._version_cache:
+            _Handler._version_cache = self.version_string()
+        status = self.responses.get(code, ("", ""))[0]
+        head = (f"HTTP/1.1 {code} {status}\r\n"
+                f"Server: {_Handler._version_cache}\r\n"
+                f"Date: {date[1]}\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n")
         for k, v in (headers or {}).items():
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
+            head += f"{k}: {v}\r\n"
+        if self.close_connection:
+            head += "Connection: close\r\n"
+        self.wfile.write(head.encode("latin-1") + b"\r\n" + body)
 
     def do_POST(self) -> None:
         if self.path == "/v1/announce":
@@ -453,8 +655,28 @@ class _Handler(BaseHTTPRequestHandler):
             doc = json.loads(self.rfile.read(n) or b"{}")
             self._srv.discovery.announce(doc.get("nodeId", ""),
                                          doc.get("uri", ""),
-                                         doc.get("state", "ACTIVE"))
+                                         doc.get("state", "ACTIVE"),
+                                         doc.get("role", "worker"))
             self._reply(202, {"announced": True})
+            return
+        if self.path in ("/v1/fleet/bump", "/v1/fleet/heartbeat"):
+            # coordinator-to-coordinator plane (serving/fleet.py):
+            # write bumps keep peer caches coherent, heartbeats carry
+            # federated resource-group counts. Node-internal like
+            # /v1/announce — not behind client auth. 404 when this
+            # server is not a fleet member.
+            fleet = self._srv.fleet
+            if fleet is None:
+                self._reply(404, {"error": "not a fleet member"})
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n) or b"{}")
+            if self.path.endswith("/bump"):
+                folded = fleet.fold_bump(doc)
+                self._reply(200, {"folded": bool(folded)})
+            else:
+                fleet.fold_heartbeat(doc)
+                self._reply(200, {"ok": True})
             return
         if self.path != "/v1/statement":
             self._reply(404, {"error": "not found"})
@@ -481,7 +703,9 @@ class _Handler(BaseHTTPRequestHandler):
                 sql, overrides,
                 user=getattr(self, "_auth_user", None)
                 or self.headers.get("X-Presto-User", ""),
-                source=self.headers.get("X-Presto-Source", ""))
+                source=self.headers.get("X-Presto-Source", ""),
+                inline=(self._srv._inline_lane
+                        and sql in self._srv._fast_sql))
         except QueryQueueFullError as e:
             self._reply(429, {"error": {"message": str(e),
                                         "errorName": "QUERY_QUEUE_FULL",
@@ -499,6 +723,9 @@ class _Handler(BaseHTTPRequestHandler):
         except KeyError:
             ok, page = False, None
         if not ok:
+            # exceeded the grace: classic paging, and the statement
+            # loses its inline-lane seat until it proves fast again
+            self._srv.note_fast_statement(sql, False)
             self._reply(200, self._results_doc(q, 0, first=True))
             return
         token = 0
@@ -512,6 +739,7 @@ class _Handler(BaseHTTPRequestHandler):
                 page = page + page2
                 token = 1
                 # don't chase further pages: hand off to normal paging
+                self._srv.note_fast_statement(sql, False)
             elif ok2:
                 doc = self._results_doc(q, token, page=page)
                 doc.pop("nextUri", None)       # stream fully drained
@@ -520,8 +748,14 @@ class _Handler(BaseHTTPRequestHandler):
                     # folding the sentinel must not swallow the verdict
                     # the classic GET path would have delivered
                     doc["error"] = q.error
+                else:
+                    self._srv.note_fast_statement(sql, True)
                 self._reply(200, doc, self._session_headers(q))
                 return
+        if page is None and q.error is None:
+            # sentinel on the first poll: a zero-page statement that
+            # drained within the grace — inline-lane eligible too
+            self._srv.note_fast_statement(sql, True)
         self._reply(200, self._results_doc(q, token, page=page),
                     self._session_headers(q))
 
@@ -534,7 +768,9 @@ class _Handler(BaseHTTPRequestHandler):
             # balancers / rolling-restart tooling watch the state flip
             # to SHUTTING_DOWN and drain traffic away
             self._reply(200, {
-                "nodeId": "coordinator",
+                "nodeId": (self._srv.fleet.node_id
+                           if self._srv.fleet is not None
+                           else "coordinator"),
                 "state": ("SHUTTING_DOWN" if self._srv.shutting_down
                           else "ACTIVE"),
                 "queries": {
@@ -542,6 +778,25 @@ class _Handler(BaseHTTPRequestHandler):
                         1 for q in list(self._srv.queries.values())
                         if q.state in ("QUEUED", "RUNNING"))},
             })
+            return
+        if self.path.rstrip("/") == "/v1/fleet":
+            # fleet membership status (node-internal plane, like
+            # /v1/service): peers, bump seq, remote group counts + ages
+            fleet = self._srv.fleet
+            if fleet is None:
+                self._reply(404, {"error": "not a fleet member"})
+                return
+            self._reply(200, fleet.status())
+            return
+        if self.path.rstrip("/") == "/v1/slo":
+            # the live ``slo`` block (same builder as the bench pin);
+            # flush a sample first so the timeline includes traffic
+            # served since the last 0.2s/5s tick — the fleet bench
+            # reads this at phase close from every coordinator
+            from ..obs.slo import SLO, slo_block
+            from ..obs.timeseries import TIMESERIES
+            TIMESERIES.sample()
+            self._reply(200, slo_block(TIMESERIES, SLO))
             return
         if self.path.split("?")[0].rstrip("/") == "/v1/metrics/history":
             # windowed range reads over the time-series store
@@ -731,7 +986,8 @@ class PrestoTpuServer:
 
     def __init__(self, runner=None, host: str = "127.0.0.1", port: int = 0,
                  resource_groups: Optional[Dict] = None,
-                 authenticator=None, jwt_authenticator=None):
+                 authenticator=None, jwt_authenticator=None,
+                 discovery=None):
         from .resource_groups import ResourceGroupManager
         self.authenticator = authenticator
         self.jwt_authenticator = jwt_authenticator
@@ -749,7 +1005,27 @@ class PrestoTpuServer:
         # dict for real concurrency tiers
         self.resource_groups = ResourceGroupManager(resource_groups)
         from ..exec.discovery import DiscoveryNodeManager
-        self.discovery = DiscoveryNodeManager()
+        # a fleet coordinator passes its ClusterRunner's discovery so
+        # /v1/announce feeds the SAME membership the scheduler reads
+        # (one shared worker pool across the fleet)
+        self.discovery = (discovery if discovery is not None
+                          else DiscoveryNodeManager())
+        #: fleet membership (serving/fleet.FleetMember) — None until
+        #: :meth:`enable_fleet`; a standalone coordinator never pays a
+        #: fleet branch
+        self.fleet = None
+        #: statements whose LAST run drained within the single-round-
+        #: trip grace: the inline-lane gate (do_POST). Keyed by raw
+        #: statement text; a slow re-run (e.g. after a cache
+        #: invalidation) evicts itself, so a statement can only hold a
+        #: handler thread for one slow execution before reverting to
+        #: the producer pool. Bounded so adversarial unique statements
+        #: can't grow it. SERVING_INLINE_LANE=0 disables the lane.
+        self._fast_sql: Dict[str, bool] = {}
+        self._inline_lane = os.environ.get(
+            "SERVING_INLINE_LANE", "1") != "0"
+        self._qid_date: Optional[datetime.date] = None
+        self._qid_prefix = ""
 
         class _StatementHTTPServer(ThreadingHTTPServer):
             # a 100-client fleet opening a connection per statement
@@ -760,23 +1036,63 @@ class PrestoTpuServer:
             # any bench fleet.
             request_queue_size = 1024
 
+            # live client sockets, tracked so kill() can reset them:
+            # shutting the listener only stops NEW connections — a
+            # "dead" in-process coordinator would otherwise keep
+            # serving its established keep-alives forever, and a chaos
+            # kill would never exercise client failover
+            def get_request(self):
+                sock, addr = super().get_request()
+                with self._socks_lock:
+                    self._client_socks.add(sock)
+                return sock, addr
+
+            def shutdown_request(self, request):
+                with self._socks_lock:
+                    self._client_socks.discard(request)
+                super().shutdown_request(request)
+
+            def close_client_connections(self):
+                import socket as _socket
+                with self._socks_lock:
+                    socks = list(self._client_socks)
+                    self._client_socks.clear()
+                for s in socks:
+                    try:
+                        s.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
         self.httpd = _StatementHTTPServer((host, port), _Handler)
+        self.httpd._client_socks = set()  # type: ignore[attr-defined]
+        self.httpd._socks_lock = threading.Lock()  # type: ignore[attr-defined]
         self.httpd.presto = self      # type: ignore[attr-defined]
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
 
     def create_query(self, sql: str, overrides: Dict[str, str],
-                     user: str = "", source: str = "") -> _Query:
+                     user: str = "", source: str = "",
+                     inline: bool = False) -> _Query:
+        today = datetime.date.today()
         with self._lock:
             self._seq += 1
-            qid = (f"{datetime.date.today().strftime('%Y%m%d')}"
-                   f"_{self._seq:06d}")
+            if self._qid_date != today:
+                # strftime costs ~8µs; at serving rates that's real
+                # money for a string that changes once a day
+                self._qid_date = today
+                self._qid_prefix = today.strftime("%Y%m%d")
+            qid = f"{self._qid_prefix}_{self._seq:06d}"
         admission = self.resource_groups.submit(user=user, source=source)
         try:
             q = _Query(qid, secrets.token_hex(8), sql, self.runner,
                        overrides, admission, user=user,
-                       accepts_serving=self._accepts_serving)
+                       accepts_serving=self._accepts_serving,
+                       inline=inline)
         except BaseException:
             # a construction failure must not strand the queue slot
             admission.release()
@@ -791,6 +1107,42 @@ class PrestoTpuServer:
                     if len(self.queries) <= 100:
                         break
         return q
+
+    def note_fast_statement(self, sql: str, fast: bool) -> None:
+        """Inline-lane memo maintenance, called from the statement POST
+        at reply time: a single-round-trip drain earns the statement an
+        inline seat; a slow or multi-page run revokes it."""
+        with self._lock:
+            if not fast:
+                self._fast_sql.pop(sql, None)
+                return
+            if sql not in self._fast_sql and len(self._fast_sql) >= 512:
+                self._fast_sql.pop(next(iter(self._fast_sql)))
+            self._fast_sql[sql] = True
+
+    def enable_fleet(self, node_id: str, peers=(),
+                     advertised_host: str = "127.0.0.1",
+                     heartbeat_s: float = 1.0,
+                     staleness_grace_s: Optional[float] = None):
+        """Join a coordinator fleet (serving/fleet.py): coherent caches
+        via write-bump broadcast, fleet-wide resource-group limits via
+        heartbeat federation. ``peers`` is the other coordinators'
+        base URLs; call :meth:`start` (or have a bound port) first so
+        the advertised self URL is real. Idempotent per server."""
+        if self.fleet is not None:
+            return self.fleet
+        from ..serving.fleet import FleetMember
+        catalogs = getattr(
+            getattr(self.runner, "session", None), "catalogs", None)
+        self.fleet = FleetMember(
+            node_id, f"http://{advertised_host}:{self.port}",
+            catalogs=catalogs,
+            resource_groups=self.resource_groups,
+            discovery=self.discovery, peers=peers,
+            heartbeat_s=heartbeat_s,
+            staleness_grace_s=staleness_grace_s)
+        self.fleet.start()
+        return self.fleet
 
     def start(self) -> None:
         # the health plane rides server lifetime: one process-wide
@@ -808,6 +1160,11 @@ class PrestoTpuServer:
         out, then stop the server (the coordinator half of the worker's
         GracefulShutdownHandler-style drain)."""
         self.shutting_down = True
+        if self.fleet is not None:
+            # clean drain: tell peers to drop our federated counts NOW
+            # (a drain is not a loss — no staleness grace, no
+            # coordinator_lost_total)
+            self.fleet.leave()
 
         def drain():
             # terminal state is set when the last page is ENQUEUED, not
@@ -835,8 +1192,25 @@ class PrestoTpuServer:
         # shutdown() handshakes with serve_forever — calling it on a
         # server whose loop never started (embedded create_query use)
         # would block forever
+        if self.fleet is not None:
+            self.fleet.stop()
         if self._thread.is_alive():
             self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def kill(self) -> None:
+        """Process-death stand-in for in-process chaos tests: stop
+        accepting, RESET every established client connection (a real
+        SIGKILL'd process drops its sockets — in-flight requests see a
+        transport error, exercising client failover), and silence the
+        fleet heartbeat so peers declare this coordinator lost via the
+        staleness grace. No drain, no ``leaving`` farewell."""
+        if self.fleet is not None:
+            self.fleet.stop()
+        self.shutting_down = True
+        if self._thread.is_alive():
+            self.httpd.shutdown()
+        self.httpd.close_client_connections()  # type: ignore[attr-defined]
         self.httpd.server_close()
 
 

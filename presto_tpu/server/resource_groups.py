@@ -209,27 +209,64 @@ class ResourceGroup:
         return len(self.queue) + sum(c.queued_total()
                                      for c in self.children.values())
 
+    def _remote_running(self) -> int:
+        """Running count this group's path holds on OTHER coordinators
+        (fleet federation; serving/fleet.py). 0 when standalone. Called
+        under manager.lock — the provider takes only its own lock
+        (order: resourcegroups.manager -> fleet.member)."""
+        fed = self.manager.federation
+        if fed is None:
+            return 0
+        try:
+            return int(fed.remote_running(self.path))
+        except Exception:
+            return 0
+
+    def _remote_memory(self) -> int:
+        fed = self.manager.federation
+        if fed is None:
+            return 0
+        try:
+            return int(fed.remote_memory(self.path))
+        except Exception:
+            return 0
+
     def over_soft_memory(self) -> bool:
+        return (self.soft_memory_limit is not None
+                and self.memory_reserved + self._remote_memory()
+                > self.soft_memory_limit)
+
+    def _over_soft_memory_local(self) -> bool:
         return (self.soft_memory_limit is not None
                 and self.memory_reserved > self.soft_memory_limit)
 
     def can_run_more(self) -> bool:
         g: Optional[ResourceGroup] = self
         while g is not None:
-            if g.running >= g.hard_concurrency_limit:
+            remote = g._remote_running()
+            if g.running + remote >= g.hard_concurrency_limit:
+                if remote and g.running < g.hard_concurrency_limit:
+                    # a coordinator-local view would have admitted:
+                    # the fleet-wide limit is what blocked
+                    g.manager._note_remote_blocked()
                 return False
             if g.over_soft_memory():
                 # kill-or-queue: over the soft limit the group keeps its
                 # running queries but admits nothing new until memory
                 # returns (reference InternalResourceGroup.canRunMore)
+                if not g._over_soft_memory_local():
+                    g.manager._note_remote_blocked()
                 return False
             g = g.parent
         return True
 
     def _pick_queued(self) -> Optional["ResourceGroup"]:
         """Deepest-first weighted-fair choice of a descendant leaf-queue
-        with work, honoring every level's concurrency limit."""
-        if self.running >= self.hard_concurrency_limit \
+        with work, honoring every level's concurrency limit (federated:
+        a group whose fleet-wide running count fills its limit is not a
+        candidate, so it cannot shadow an admissible sibling)."""
+        if self.running + self._remote_running() \
+                >= self.hard_concurrency_limit \
                 or self.over_soft_memory():
             return None
         candidates = [c._pick_queued() for c in self.children.values()]
@@ -281,6 +318,13 @@ class ResourceGroupManager:
         #: because memory charges arrive from inside QueryMemoryPool
         #: reservations (hot path) while ``lock`` serializes dispatch
         self.memory_lock = checked_lock("resourcegroups.memory")
+        #: fleet federation provider (serving/fleet.FleetMember), set by
+        #: the member on install; None = standalone coordinator. Must
+        #: expose remote_running(path) / remote_memory(path) /
+        #: note_remote_blocked(), and must never call back into this
+        #: manager while holding its own lock (lock order:
+        #: resourcegroups.manager -> fleet.member).
+        self.federation = None
         self.roots: Dict[str, ResourceGroup] = {}
         self.selectors: List[dict] = []
         config = config or {
@@ -370,6 +414,28 @@ class ResourceGroupManager:
                     started = True
                 if not started:
                     return
+
+    def _note_remote_blocked(self) -> None:
+        fed = self.federation
+        if fed is not None:
+            try:
+                fed.note_remote_blocked()
+            except Exception:
+                pass
+
+    def group_counts(self) -> Dict[str, dict]:
+        """Per-group ``{running, queued, memory}`` snapshot, keyed by
+        dotted path — the fleet heartbeat payload (serving/fleet.py)."""
+        out: Dict[str, dict] = {}
+        with self.lock:
+            stack = list(self.roots.values())
+            while stack:
+                g = stack.pop()
+                out[g.path] = {"running": g.running,
+                               "queued": len(g.queue),
+                               "memory": g.memory_reserved}
+                stack.extend(g.children.values())
+        return out
 
     def info(self) -> List[dict]:
         with self.lock:

@@ -891,12 +891,19 @@ class WorkerServer:
         TIMESERIES.ensure_started()
         self._thread.start()
 
-    def start_announcing(self, discovery_uri: str,
+    def start_announcing(self, discovery_uri,
                          advertised_host: str = "127.0.0.1",
                          interval_s: float = 5.0) -> None:
         """Join a coordinator by announcement (reference workers announce
-        via discovery and may join any time — elastic scale-out)."""
+        via discovery and may join any time — elastic scale-out).
+        ``discovery_uri`` may be a list (or a comma-separated string)
+        of coordinator URIs: a fleet worker announces to every
+        coordinator each beat, making ONE worker pool visible to all
+        fleet members."""
         from ..exec.discovery import Announcer
+        if isinstance(discovery_uri, str) and "," in discovery_uri:
+            discovery_uri = [u.strip() for u in discovery_uri.split(",")
+                             if u.strip()]
         self._announcer = Announcer(
             discovery_uri, self.node_id,
             f"http://{advertised_host}:{self.port}", interval_s)

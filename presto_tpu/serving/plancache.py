@@ -140,31 +140,46 @@ class PlanCache:
         return cls._repr_memo.get(stmt, lambda s: repr(s).encode())
 
     @classmethod
-    def fingerprint(cls, stmt, session, user: str = "") -> bytes:
-        """Canonical statement fingerprint. The AST and its literals are
-        frozen dataclasses, so ``repr`` is a stable canonical form; the
-        session slice covers everything that can change what ``optimize``
-        produces (properties drive optimizer gates, views expand at plan
-        time, the user scopes secured-catalog resolution)."""
-        h = hashlib.sha256()
-        h.update(cls._stmt_repr(stmt))
-        h.update(repr((session.catalog, session.schema)).encode())
+    def session_fragment(cls, session, user: str = "") -> bytes:
+        """Everything :meth:`fingerprint` hashes beyond the statement
+        repr. Exposed so a caller keying SEVERAL statements against one
+        (session, user) — a serving query fingerprints both its bound
+        form and its parameterized template — pays the session-slice
+        walk once and hands the bytes to each call."""
+        cats = getattr(session.catalogs, "_inner", session.catalogs)
         # connector identities: two runners mounting same-named catalogs
         # over DIFFERENT connector instances (separate datasets) must
         # not share fingerprints — plans embed stats/bounds captured
         # from one instance's data. id() reuse after GC is covered by
         # the entry's weakref deps check (a dead dep drops the entry).
-        cats = getattr(session.catalogs, "_inner", session.catalogs)
         try:
             ids = sorted((n, id(cats.get(n))) for n in cats.names())
         except Exception:
             ids = [("<unresolvable>", 0)]
-        h.update(repr(ids).encode())
-        h.update(repr(sorted((k, repr(v)) for k, v in
-                             session.properties.items())).encode())
-        h.update(repr(sorted((k, repr(v)) for k, v in
-                             session.views.items())).encode())
-        h.update(user.encode())
+        return b"".join((
+            repr((session.catalog, session.schema)).encode(),
+            repr(ids).encode(),
+            repr(sorted((k, repr(v)) for k, v in
+                        session.properties.items())).encode(),
+            repr(sorted((k, repr(v)) for k, v in
+                        session.views.items())).encode(),
+            user.encode(),
+        ))
+
+    @classmethod
+    def fingerprint(cls, stmt, session, user: str = "",
+                    fragment: Optional[bytes] = None) -> bytes:
+        """Canonical statement fingerprint. The AST and its literals are
+        frozen dataclasses, so ``repr`` is a stable canonical form; the
+        session slice covers everything that can change what ``optimize``
+        produces (properties drive optimizer gates, views expand at plan
+        time, the user scopes secured-catalog resolution). ``fragment``
+        must be this (session, user)'s :meth:`session_fragment` when
+        supplied."""
+        h = hashlib.sha256()
+        h.update(cls._stmt_repr(stmt))
+        h.update(fragment if fragment is not None
+                 else cls.session_fragment(session, user))
         return h.digest()
 
     @staticmethod
@@ -346,14 +361,27 @@ def parse_cached(sql: str):
     return stmt
 
 
+def key_fragment(session, user: str = "",
+                 secured: bool = False) -> bytes:
+    """The (session, user) fragment under the same key rule as
+    :func:`bound_fingerprint` — compute once, pass to several
+    ``bound_fingerprint`` calls keying against the same session."""
+    return PlanCache.session_fragment(session,
+                                      user=user if secured else "")
+
+
 def bound_fingerprint(stmt, session, user: str = "",
-                      secured: bool = False) -> bytes:
+                      secured: bool = False,
+                      fragment: Optional[bytes] = None) -> bytes:
     """THE bound-statement key rule (user folds in only when access
     control is active) — every consumer (plan cache, template cache's
     fallback key, result cache, EXPLAIN ANALYZE's probe) must go
-    through here so they can never diverge on what a key covers."""
+    through here so they can never diverge on what a key covers.
+    ``fragment``, when supplied, must come from :func:`key_fragment`
+    with the same (session, user, secured)."""
     return PlanCache.fingerprint(stmt, session,
-                                 user=user if secured else "")
+                                 user=user if secured else "",
+                                 fragment=fragment)
 
 
 def cached_plan(stmt, session, user: str = "", secured: bool = False):

@@ -45,7 +45,8 @@ from typing import Any, Dict, Optional, Tuple
 from ..exec.failpoints import FAILPOINTS
 from ..obs.metrics import REGISTRY
 from ..sql import ast as A
-from .plancache import PlanCache, bound_fingerprint, cached_plan
+from .plancache import (PlanCache, bound_fingerprint, cached_plan,
+                        key_fragment)
 
 _GUARD_FALLBACK = REGISTRY.counter(
     "plan_template_cache_guard_fallback_total")
@@ -172,14 +173,16 @@ def template_plan(stmt, session, user: str = "", secured: bool = False):
     from ..planner.optimizer import optimize
     from ..planner.planner import plan_query
 
+    # one session-slice walk for both keys (bound + template)
+    frag = key_fragment(session, user=user, secured=secured)
     bound_key = bound_fingerprint(stmt, session, user=user,
-                                  secured=secured)
+                                  secured=secured, fragment=frag)
     template_stmt, marked_stmt, values = parameterize_cached(stmt)
     if not values:
         plan = cached_plan(stmt, session, user=user, secured=secured)
         return plan, None, bound_key
     tkey = bound_fingerprint(template_stmt, session, user=user,
-                             secured=secured)
+                             secured=secured, fragment=frag)
     entry = TEMPLATES.get(tkey)
     if isinstance(entry, Template):
         if len(values) == entry.n_slots and all(

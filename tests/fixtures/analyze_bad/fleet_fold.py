@@ -1,0 +1,42 @@
+"""Red fixture for the distributed broadcast-fold clauses in
+tools/analyze/caches.py (fleet_findings).
+
+Every fold here violates the contract on purpose:
+
+- ``fold_bump`` stores the dedupe high-water seq BEFORE the audited
+  spi.notify_data_change call → fleet-fold-seq-order.
+- ``fold_silent`` never reaches notify_data_change at all
+  → fleet-fold-unaudited.
+- ``_nudge`` pokes a cache's invalidate()/note_write() directly from
+  the fleet module → fleet-fold-bypass (twice).
+"""
+
+
+class BadFleetMember:
+    def __init__(self, spi, cache):
+        self.spi = spi
+        self.cache = cache
+        self._seen = {}
+        self._lock = None
+
+    def fold_bump(self, doc):
+        key = (doc["origin"], doc["connectorId"], doc["table"])
+        seq = doc["seq"]
+        if self._seen.get(key, -1) >= seq:
+            return False
+        # WRONG: delivery is recorded before the caches hear about
+        # the write — a crash between these two lines loses the bump.
+        self._seen[key] = seq
+        conn = self.spi.catalogs.get(doc["connectorId"])
+        self.spi.notify_data_change(conn, doc["table"])
+        return True
+
+    def fold_silent(self, doc):
+        # WRONG: swallows the bump without the audited notify path.
+        self._seen[(doc["origin"], doc["table"])] = doc["seq"]
+        return True
+
+    def _nudge(self, table):
+        # WRONG: bypasses spi.notify_data_change entirely.
+        self.cache.note_write(table)
+        self.cache.invalidate(table)

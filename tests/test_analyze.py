@@ -242,6 +242,39 @@ def test_caches_live_tree_contracts_hold():
     assert caches.check(REPO) == []
 
 
+# -- red fixtures: distributed broadcast-fold clauses (ISSUE 19) -------------
+
+def test_caches_catches_fleet_fold_violations():
+    fs = caches.fleet_findings(
+        REPO, module="tests/fixtures/analyze_bad/fleet_fold.py",
+        fold_fns=("fold_bump", "fold_silent"))
+    assert _rules(fs) == {"fleet-fold-bypass", "fleet-fold-seq-order",
+                          "fleet-fold-unaudited"}
+    # the direct cache pokes are flagged individually
+    bypass = [f for f in fs if f.rule == "fleet-fold-bypass"]
+    assert sorted(f.symbol for f in bypass) == [
+        "self.cache.invalidate", "self.cache.note_write"]
+    # fold_bump stores the dedupe seq before notify; fold_silent
+    # never notifies at all
+    assert {f.symbol for f in fs if f.rule == "fleet-fold-seq-order"} \
+        == {"fleet.fold_bump"}
+    assert {f.symbol for f in fs if f.rule == "fleet-fold-unaudited"} \
+        == {"fleet.fold_silent"}
+
+
+def test_caches_fleet_fold_live_tree_clean():
+    assert caches.fleet_findings(REPO) == []
+
+
+def test_caches_fleet_module_registered():
+    # the contract is only worth anything if it points at a real file
+    assert os.path.isfile(os.path.join(REPO, caches.FLEET_MODULE))
+    mod = caches._Mod(os.path.join(REPO, caches.FLEET_MODULE),
+                      caches.FLEET_MODULE)
+    for name in caches.FLEET_FOLD_FNS:
+        assert mod.fn(name) is not None
+
+
 # -- red fixtures: env-var registry (ISSUE 15 satellite) ---------------------
 
 def test_registries_catches_undeclared_env_vars():

@@ -44,6 +44,23 @@ def test_chaos_smoke():
     assert summary["elastic"]["value"] > 0
 
 
+def test_fleet_coordinator_kill():
+    """ISSUE 19: kill 1 of 3 coordinators mid-run over one shared
+    worker pool — zero failed queries (FleetClient re-dispatches),
+    survivors drop the dead coordinator's federated resource-group
+    counts after the staleness grace, and the loss is observable as
+    ``coordinator_lost_total`` through plain SQL."""
+    import chaos_smoke
+    summary = chaos_smoke.run_fleet_chaos(sf=0.01)
+    assert summary["ok"] is True
+    kill = summary["scenarios"]["coordinator_kill"]
+    assert kill["failed"] == 0
+    assert kill["queries"] >= 6
+    assert kill["failovers"] >= 1
+    assert kill["coordinator_lost_total"] >= 1.0
+    assert kill["survivor_lost_view"] == ["coord-2"]
+
+
 def test_elastic_regression_gate_smoke(capsys):
     """The elastic recovery-time gate's self-consistency: the pinned
     ELASTIC_r*.json passes against itself and a degraded (slower)

@@ -144,6 +144,43 @@ def test_alert_rule_registry():
     assert slo_report.OBJECTIVES == ("latency", "availability")
 
 
+def test_slo_block_multi_coordinator_validation():
+    """ISSUE 19: the merged fleet slo block — a valid two-coordinator
+    block passes; untagged rows, a fleet of one, and p95 coverage
+    missing for a coordinator all fail."""
+    from tools.slo_report import validate_slo_block
+
+    def block(tag=True, coords=2):
+        extra = {"coordinator": "coord-0"} if tag else {}
+        obj = {"group": "serving.dash", "objective": "latency",
+               "target": 0.95, "threshold_ms": 2000.0, "state": "OK",
+               "burn_short": 0.0, "burn_long": 0.0,
+               "budget_remaining": 1.0, **extra}
+        pt = {"t": 1.0, "group": "serving.dash",
+              "objective": "latency", "state": "OK", "burn": 0.0,
+              "p95_ms": 10.0, **extra}
+        return {"coordinators": coords, "sample_interval_s": 0.2,
+                "objectives": [obj], "alerts": [], "timeline": [pt]}
+
+    def verdict(blk):
+        return validate_slo_block({"m": {"metric": "m", "slo": blk}})
+
+    assert verdict(block())["ok"]
+    assert not verdict(block(tag=False))["ok"]      # untagged rows
+    assert not verdict(block(coords=1))["ok"]       # fleet of one
+    # the p95 coverage check is per coordinator: a latency objective
+    # on coord-0 is NOT covered by a timeline point from coord-1
+    drifted = block()
+    drifted["timeline"][0]["coordinator"] = "coord-1"
+    v = verdict(drifted)
+    assert not v["ok"]
+    assert any("coord-0" in x["detail"] for x in v["violations"])
+    # and the single-coordinator (r03) form still validates untagged
+    legacy = block(tag=False)
+    legacy.pop("coordinators")
+    assert verdict(legacy)["ok"]
+
+
 # -- state machine hysteresis -------------------------------------------------
 
 def _step_seq(burns, start="OK"):

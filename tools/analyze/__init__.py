@@ -112,6 +112,8 @@ def run_changed(files: List[str], root: Optional[str] = None,
     conn = scoped(caches.CONNECTOR_SCOPE)
     if conn:
         findings.extend(caches.connector_findings(root, scan_paths=conn))
+    if caches.FLEET_MODULE in changed:
+        findings.extend(caches.fleet_findings(root))
     # registries, use->declaration direction only
     py = scoped(["presto_tpu", "tools", "bench.py",
                  "__graft_entry__.py"])

@@ -51,6 +51,27 @@ tests/fixtures/analyze_bad/):
   chain (``_data_changed``/``_note_write``-style); a write path that
   skips it leaves every engine cache serving deleted data.
 
+Distributed clauses (ISSUE 19: the fleet's broadcast-fold surface,
+presto_tpu/serving/fleet.py — remote write bumps folded into local
+caches):
+
+- ``fleet-fold-unaudited`` — every declared fold function must reach
+  ``spi.notify_data_change`` (the audited re-entry point): folding a
+  remote bump through the spi path runs every cache's registered
+  ``_on_write`` listener (note_write epoch bump, then invalidate), so
+  the epoch-before-deps veto covers remote writes exactly like local
+  ones.
+- ``fleet-fold-bypass`` — the fleet module must never call a cache's
+  ``invalidate``/``note_write`` directly; a direct poke skips the
+  other caches' listeners and the lock/epoch discipline the audited
+  path carries.
+- ``fleet-fold-seq-order`` — inside a fold function, the
+  ``notify_data_change`` call must come LEXICALLY BEFORE the dedupe
+  high-water store (``self._seen[...] = seq``): seq-then-notify marks
+  the bump delivered before the caches heard it, so a fold that dies
+  mid-way is deduped away on retry and the remote write is never
+  applied (the broadcast-fold form of epoch-before-deps).
+
 Like every checker in this package: no engine import, stable idents
 (``caches:rule:path:symbol``), findings suppressed only via the
 committed (empty) baseline.
@@ -574,6 +595,96 @@ def connector_findings(root: str,
     return out
 
 
+# -- distributed fold rules (ISSUE 19: serving/fleet.py) ----------------------
+
+#: the fleet-membership module whose fold surface is under contract
+FLEET_MODULE = "presto_tpu/serving/fleet.py"
+#: functions folding REMOTE write bumps into the local caches
+FLEET_FOLD_FNS = ("fold_bump",)
+#: the dedupe high-water attribute a fold may only advance post-notify
+FLEET_SEEN_ATTR = "_seen"
+
+
+def fleet_findings(root: str, module: str = FLEET_MODULE,
+                   fold_fns: Sequence[str] = FLEET_FOLD_FNS
+                   ) -> List[Finding]:
+    """The broadcast-fold contract: remote bumps re-enter caches only
+    through the audited spi path, and only record delivery after it."""
+    path = os.path.join(root, module)
+    if not os.path.isfile(path):
+        return [Finding(
+            CHECKER, "cache-missing-module", module, 1, "fleet",
+            f"declared fleet module {module!r} missing")]
+    mod = _Mod(path, rel(path, root))
+    if mod.tree is None:
+        return [Finding(CHECKER, "parse-error", mod.rpath, 1,
+                        "<module>", "file does not parse")]
+    out: List[Finding] = []
+    # fleet-fold-bypass: the module as a whole never pokes caches
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) \
+                and node.func.attr in ("invalidate", "note_write"):
+            out.append(Finding(
+                CHECKER, "fleet-fold-bypass", mod.rpath, node.lineno,
+                dotted(node.func) or node.func.attr,
+                f"fleet module calls .{node.func.attr}() directly — "
+                f"remote bumps must reach caches ONLY through "
+                f"spi.notify_data_change so every registered listener "
+                f"runs its audited note_write+invalidate sequence"))
+    for name in fold_fns:
+        fn = mod.fn(name)
+        if fn is None:
+            out.append(Finding(
+                CHECKER, "fleet-fold-unaudited", mod.rpath, 1,
+                f"fleet.{name}",
+                f"declared fold function {name!r} not found"))
+            continue
+        notify_line = None
+        for c in _calls_in(fn):
+            if _call_tail(c) == "notify_data_change":
+                notify_line = c.lineno if notify_line is None \
+                    else min(notify_line, c.lineno)
+        if notify_line is None:
+            out.append(Finding(
+                CHECKER, "fleet-fold-unaudited", mod.rpath, fn.lineno,
+                f"fleet.{name}",
+                f"fold function {name!r} never calls "
+                f"spi.notify_data_change — a remote write bump that "
+                f"skips the audited path leaves local caches (and the "
+                f"epoch veto) blind to the write"))
+            continue
+        seen_store = None
+        for n in ast.walk(fn):
+            targets = n.targets if isinstance(n, ast.Assign) else (
+                [n.target] if isinstance(n, (ast.AnnAssign, ast.AugAssign))
+                else ())
+            for t in targets:
+                if isinstance(t, ast.Subscript) and (
+                        dotted(t.value) or "").endswith(
+                        f".{FLEET_SEEN_ATTR}"):
+                    seen_store = n.lineno if seen_store is None \
+                        else min(seen_store, n.lineno)
+        if seen_store is None:
+            out.append(Finding(
+                CHECKER, "fleet-fold-seq-order", mod.rpath, fn.lineno,
+                f"fleet.{name}",
+                f"fold function {name!r} never advances the dedupe "
+                f"high-water mark (self.{FLEET_SEEN_ATTR}[...] = seq) "
+                f"— without it every re-delivered bump re-folds and "
+                f"the monotonic-delivery contract is gone"))
+        elif seen_store < notify_line:
+            out.append(Finding(
+                CHECKER, "fleet-fold-seq-order", mod.rpath, seen_store,
+                f"fleet.{name}",
+                f"fold function {name!r} stores the dedupe seq "
+                f"(line {seen_store}) BEFORE notify_data_change "
+                f"(line {notify_line}) — a fold that dies between the "
+                f"two is recorded as delivered and the remote write "
+                f"is never applied (seq store must follow the notify)"))
+    return out
+
+
 # -- entry points -------------------------------------------------------------
 
 def check_specs(specs: Sequence[CacheSpec], root: str) -> List[Finding]:
@@ -627,4 +738,5 @@ def check(root: str) -> List[Finding]:
     out = check_specs(SPECS, root)
     out.extend(_undeclared_findings(root, SPECS))
     out.extend(connector_findings(root))
+    out.extend(fleet_findings(root))
     return out

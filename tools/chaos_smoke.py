@@ -517,16 +517,200 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
                 pass
 
 
+def run_fleet_chaos(sf: float = 0.01, coordinators: int = 3,
+                    clients: int = 2, per_client: int = 3,
+                    verbose: bool = False) -> dict:
+    """Coordinator-death drill (ISSUE 19): an in-process fleet of
+    ``coordinators`` statement servers over ONE shared worker pool,
+    killed down to survivors mid-run.
+
+    Asserts the fleet contract end to end: ZERO failed queries (the
+    FleetClient re-dispatches around the corpse), the survivors drop
+    the dead coordinator's federated resource-group counts once its
+    heartbeats age past the staleness grace, and the loss is
+    observable — ``coordinator_lost_total`` read back over plain SQL
+    from a survivor."""
+    from presto_tpu.client import FleetClient
+    from presto_tpu.exec.cluster import ClusterRunner
+    from presto_tpu.exec.discovery import DiscoveryNodeManager
+    from presto_tpu.exec.failpoints import FAILPOINTS
+    from presto_tpu.server.protocol import PrestoTpuServer
+    from presto_tpu.server.worker import WorkerServer
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(msg, file=sys.stderr, flush=True)
+
+    groups = {
+        "rootGroups": [
+            {"name": "serving", "hardConcurrencyLimit": 8,
+             "maxQueued": 1000}],
+        "selectors": [{"group": "serving"}]}
+
+    # one shared discovery plane = one shared worker pool: every
+    # coordinator's scheduler reads the same membership
+    discovery = DiscoveryNodeManager(ttl_s=3600.0)
+    worker = WorkerServer(tpch_sf=sf)
+    worker.start()
+    discovery.announce(worker.node_id,
+                       f"http://127.0.0.1:{worker.port}")
+
+    servers = []
+    summary: dict = {"sf": sf, "coordinators": coordinators,
+                     "scenarios": {}}
+    FAILPOINTS.clear()
+    try:
+        for i in range(coordinators):
+            runner = ClusterRunner(tpch_sf=sf, heartbeat=False,
+                                   discovery=discovery)
+            srv = PrestoTpuServer(runner, resource_groups=groups,
+                                  discovery=discovery)
+            srv.start()
+            servers.append(srv)
+        urls = [f"http://127.0.0.1:{s.port}" for s in servers]
+        for i, srv in enumerate(servers):
+            srv.enable_fleet(
+                f"coord-{i}",
+                peers=[u for j, u in enumerate(urls) if j != i],
+                heartbeat_s=0.2, staleness_grace_s=0.6)
+        victim_idx = coordinators - 1
+        victim_id = f"coord-{victim_idx}"
+
+        # the kill only means something once the victim's heartbeats
+        # are IN every survivor's federated admission view
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if all(victim_id in s.fleet.status()["remote"]
+                   for s in servers[:victim_idx]):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                "victim heartbeats never reached the survivors")
+
+        # warm every coordinator once (round-robin covers the fleet)
+        # and take the fault-free reference rows
+        warm = FleetClient(urls, user="fleet-chaos")
+        want = warm.execute(QUERY).rows
+        for _ in range(coordinators - 1):
+            _assert_rows_equal(warm.execute(QUERY).rows, want,
+                               "fleet_warmup")
+        warm.close()
+        log(f"fleet warm: {len(want)} rows via {coordinators} "
+            f"coordinators")
+
+        t0 = time.perf_counter()
+        total = clients * per_client
+        kill_after = max(1, total // 3)
+        done = [0]
+        count_lock = threading.Lock()
+        killed = threading.Event()
+        errors: list = []
+        fleet_clients = []
+
+        def killer() -> None:
+            while not killed.is_set():
+                with count_lock:
+                    n = done[0]
+                if n >= kill_after:
+                    killed.set()
+                    log(f"killing {victim_id} after {n} statements")
+                    servers[victim_idx].kill()
+                    return
+                time.sleep(0.01)
+
+        def client_run(ci: int) -> None:
+            fc = FleetClient(urls, user="fleet-chaos")
+            fleet_clients.append(fc)
+            for _ in range(per_client):
+                try:
+                    res = fc.execute(QUERY)
+                    _assert_rows_equal(res.rows, want,
+                                       "coordinator_kill")
+                except Exception as e:        # noqa: BLE001
+                    errors.append(f"client {ci}: {e!r}")
+                with count_lock:
+                    done[0] += 1
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        threads = [threading.Thread(target=client_run, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        killed.set()
+        kt.join(timeout=5)
+        assert not errors, f"queries failed across the kill: {errors}"
+
+        # survivors absorb the loss: the dead coordinator ages out of
+        # the federated admission view after the staleness grace and
+        # lands in the lost ledger; the counter is SQL-visible
+        deadline = time.time() + 10.0
+        absorbed = False
+        lost_seen = 0.0
+        views = []
+        while time.time() < deadline:
+            views = [s.fleet.status()
+                     for s in servers[:victim_idx]]
+            absorbed = all(
+                victim_id in v["lost"]
+                and victim_id not in v["remote"] for v in views)
+            lost_seen = _metric_sql(servers[0].runner,
+                                    "coordinator_lost_total")
+            if absorbed and lost_seen >= 1.0:
+                break
+            time.sleep(0.1)
+        assert absorbed, \
+            f"survivors still count the dead coordinator: {views}"
+        assert lost_seen >= 1.0, \
+            "coordinator_lost_total never moved"
+
+        summary["scenarios"]["coordinator_kill"] = {
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+            "queries": total,
+            "failed": len(errors),
+            "failovers": sum(fc.failovers_total
+                             for fc in fleet_clients),
+            "retries": sum(fc.retries_total for fc in fleet_clients),
+            "coordinator_lost_total": lost_seen,
+            "survivor_lost_view": sorted(views[0]["lost"]),
+        }
+        log(f"coordinator_kill: "
+            f"{summary['scenarios']['coordinator_kill']}")
+        summary["ok"] = True
+        return summary
+    finally:
+        FAILPOINTS.clear()
+        for srv in servers:
+            try:
+                srv.kill()
+            except Exception:
+                pass
+        try:
+            worker.stop()
+        except Exception:
+            pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sf", type=float, default=0.01,
                     help="TPC-H scale factor (default 0.01)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the coordinator-fleet death drill "
+                         "instead of the worker chaos suite")
     ap.add_argument("-q", "--quiet", action="store_true")
     ap.add_argument("--elastic-out", default=os.environ.get(
         "ELASTIC_OUT"), metavar="PATH",
         help="write the elastic recovery-time summary (bench format) "
              "for check_bench_regression --kind elastic")
     args = ap.parse_args(argv)
+    if args.fleet:
+        summary = run_fleet_chaos(sf=args.sf, verbose=not args.quiet)
+        print(json.dumps(summary, indent=2))
+        return 0 if summary.get("ok") else 1
     summary = run_chaos(sf=args.sf, verbose=not args.quiet)
     print(json.dumps(summary, indent=2))
     if args.elastic_out and summary.get("elastic"):

@@ -42,6 +42,15 @@ p95. ``--kind serving`` schema-validates the block through
 ``tools/slo_report.py`` (smoke mode gates the pinned round, run mode
 the candidate); pins without a block (r02 and older) pass vacuously.
 
+Fleet serving rounds (r04 on, benched with ``SERVING_COORDINATORS``
+>= 2) additionally carry a ``fleet`` block; the gate validates its
+invariants — per-coordinator QPS present for every member and summing
+to the aggregate, cross-coordinator cache coherence demonstrated
+(remote invalidation observed, >= 1 cross-coordinator cache hit), and
+a coordinator-kill drill with zero failed queries — through
+:func:`_fleet_gate`. Pins without a fleet block (r03 and older, or a
+single-coordinator rerun) pass that gate vacuously.
+
 Usage:
     python tools/check_bench_regression.py --run bench_out.json
     python tools/check_bench_regression.py --run bench_out.json \
@@ -242,6 +251,97 @@ def _slo_gate(flat: Dict[str, Dict]) -> Dict:
     return validate_slo_block(flat)
 
 
+def _fleet_gate(flat: Dict[str, Dict]) -> Dict:
+    """Invariant verdict for the ``fleet`` block a multi-coordinator
+    serving summary carries (SERVING_r04 on, ``SERVING_COORDINATORS``
+    fleet mode): per-coordinator QPS that actually sums to the
+    aggregate (no dead member hiding behind a fleet-wide number),
+    cross-coordinator cache coherence demonstrated (a remote write
+    observed invalidating, and at least one cross-coordinator
+    result-cache hit pinned), and a clean coordinator-kill drill
+    (ZERO failed queries, the loss observed). Pins without a fleet
+    block (r03 and older, or single-coordinator reruns) pass
+    vacuously."""
+    violations: List[Dict] = []
+    blocks = 0
+    for metric in sorted(flat):
+        fl = flat[metric].get("fleet")
+        if fl is None:
+            continue
+        blocks += 1
+
+        def bad(kind: str, detail: str, _m=metric) -> None:
+            violations.append({"metric": _m, "kind": kind,
+                               "detail": detail})
+
+        if not isinstance(fl, dict):
+            bad("schema", "fleet is not an object")
+            continue
+        n = fl.get("coordinators")
+        if isinstance(n, bool) or not isinstance(n, int) or n < 3:
+            bad("schema", "coordinators must be an int >= 3 (the "
+                          "fleet claim needs a real fleet)")
+            continue
+        per = fl.get("per_coordinator_qps")
+        if not isinstance(per, dict) or len(per) != n:
+            bad("schema", f"per_coordinator_qps must name all {n} "
+                          "coordinators")
+        else:
+            lazy = [c for c, q in sorted(per.items())
+                    if not isinstance(q, (int, float))
+                    or isinstance(q, bool) or q <= 0]
+            if lazy:
+                bad("balance", "coordinators with zero/invalid QPS: "
+                              f"{', '.join(lazy)} — every member "
+                              "must carry traffic")
+            agg = fl.get("aggregate_qps")
+            if not isinstance(agg, (int, float)) \
+                    or isinstance(agg, bool) or agg <= 0:
+                bad("schema", "aggregate_qps must be positive")
+            elif not lazy:
+                total = sum(per.values())
+                if abs(total - agg) > 0.25 * agg:
+                    bad("balance",
+                        f"per-coordinator QPS sums to {total:g} but "
+                        f"aggregate is {agg:g} (>25% apart) — the "
+                        "aggregate is not the fleet's own traffic")
+        coh = fl.get("coherence")
+        if not isinstance(coh, dict):
+            bad("coherence", "missing coherence block")
+        else:
+            if coh.get("remote_invalidation_observed") is not True:
+                bad("coherence", "remote write was never observed "
+                                 "invalidating a peer's caches")
+            if coh.get("row_exact") is not True:
+                bad("coherence", "post-write cross-coordinator read "
+                                 "was not row-exact")
+            hits = coh.get("xcoord_result_cache_hits")
+            if not isinstance(hits, (int, float)) \
+                    or isinstance(hits, bool) or hits < 1:
+                bad("coherence", "needs >= 1 pinned cross-coordinator "
+                                 "result-cache hit")
+        kill = fl.get("kill")
+        if not isinstance(kill, dict):
+            bad("kill", "missing coordinator-kill block")
+        else:
+            if kill.get("failed_queries") != 0:
+                bad("kill", f"{kill.get('failed_queries')!r} queries "
+                            "failed across the coordinator kill "
+                            "(must be 0)")
+            lost = kill.get("coordinator_lost_total")
+            if not isinstance(lost, (int, float)) \
+                    or isinstance(lost, bool) or lost < 1:
+                bad("kill", "coordinator_lost_total never reached 1 — "
+                            "the loss was not observed")
+            if not kill.get("killed") or \
+                    kill.get("killed") not in (
+                        kill.get("survivor_lost_view") or ()):
+                bad("kill", "killed coordinator absent from the "
+                            "survivor's lost view")
+    return {"blocks": blocks, "violations": violations,
+            "ok": not violations}
+
+
 def smoke(baseline_path: str) -> Dict:
     """Self-consistency: the pinned round must pass against itself,
     and a halved copy must fail. Proves discovery, parsing, tolerance
@@ -369,6 +469,16 @@ def main(argv=None) -> int:
                 {"metric": "*", "kind": "io", "detail": str(e)}]}
         verdict["slo"] = slo
         if not slo["ok"]:
+            verdict["verdict"] = "fail"
+        # fleet gate (r04 on): same smoke-vs-run target as the slo
+        # gate; pins without a fleet block pass vacuously
+        try:
+            fleet = _fleet_gate(load_summary(target))
+        except (OSError, ValueError) as e:
+            fleet = {"blocks": 0, "ok": False, "violations": [
+                {"metric": "*", "kind": "io", "detail": str(e)}]}
+        verdict["fleet"] = fleet
+        if not fleet["ok"]:
             verdict["verdict"] = "fail"
 
     text = json.dumps(verdict, indent=2)

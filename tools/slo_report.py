@@ -18,6 +18,12 @@ dropped the timeline or fired an unexplained PAGE cannot be committed.
 the candidate must. Pins without an ``slo`` block (r02 and older)
 pass vacuously — the gate never fails on history it cannot see.
 
+Fleet pins (r04 on, ``SERVING_COORDINATORS>=2``) carry the MERGED
+multi-coordinator form: a ``coordinators`` count plus a
+``coordinator`` tag on every objective, alert and timeline row; the
+windowed-p95 coverage check then applies per coordinator (every
+member's sampler must have fed its own latency histogram).
+
 Usage:
     python tools/slo_report.py                 # latest SERVING_r*.json
     python tools/slo_report.py SERVING_r03.json
@@ -105,6 +111,27 @@ def _check_block(metric: str, slo: object,
             slo["sample_interval_s"] <= 0:
         bad("schema", "sample_interval_s must be a positive number")
 
+    # fleet pins (r04 on) merge per-coordinator blocks into one:
+    # ``coordinators`` counts the fleet and EVERY objective/alert/
+    # timeline row must say which coordinator it came from, or the
+    # merged block could silently collapse to one member's view
+    fleet = slo.get("coordinators")
+    if fleet is not None and (isinstance(fleet, bool)
+                              or not isinstance(fleet, int)
+                              or fleet < 2):
+        bad("schema", "coordinators must be an int >= 2")
+        fleet = None
+
+    def coord_of(row: dict, where: str):
+        if fleet is None:
+            return None
+        c = row.get("coordinator")
+        if not isinstance(c, str) or not c:
+            bad("schema", f"{where}: multi-coordinator block rows "
+                          "need a non-empty coordinator tag")
+            return None
+        return c
+
     objectives = slo["objectives"]
     if not isinstance(objectives, list) or not objectives:
         return bad("schema", "objectives must be a non-empty list")
@@ -123,8 +150,9 @@ def _check_block(metric: str, slo: object,
         if not _num(obj.get("target")) or \
                 not (0.0 < obj["target"] < 1.0):
             bad("schema", f"{where}: target must be in (0, 1)")
+        coord = coord_of(obj, where)
         if obj.get("objective") == "latency":
-            latency_keys.add((obj.get("group"), "latency"))
+            latency_keys.add((coord, obj.get("group"), "latency"))
             if not _num(obj.get("threshold_ms")) or \
                     obj["threshold_ms"] <= 0:
                 bad("schema", f"{where}: latency objective needs a "
@@ -152,6 +180,7 @@ def _check_block(metric: str, slo: object,
         if not isinstance(a, dict):
             bad("schema", f"{where} is not an object")
             continue
+        coord_of(a, where)
         if not _num(a.get("ts")):
             bad("schema", f"{where}: ts must be a number")
         if a.get("rule") not in RULES:
@@ -185,21 +214,27 @@ def _check_block(metric: str, slo: object,
         if b is not None and (not _num(b) or b < 0):
             bad("schema", f"{where}: burn must be None or a "
                           "non-negative number")
+        coord = coord_of(pt, where)
         p95 = pt.get("p95_ms")
         if p95 is not None:
             if not _num(p95) or p95 < 0:
                 bad("schema", f"{where}: p95_ms must be a "
                               "non-negative number")
             else:
-                seen_p95.add((pt.get("group"), pt.get("objective")))
+                seen_p95.add((coord, pt.get("group"),
+                              pt.get("objective")))
     # the windowed p95 is what makes the latency timeline actionable;
     # a latency objective whose timeline never carries one means the
-    # sampler never saw the histogram — a broken pin, not a quiet one
-    for group, objective in sorted(latency_keys):
-        if (group, objective) not in seen_p95:
-            bad("schema", f"latency objective for group {group!r} "
-                          "has no timeline point with a windowed "
-                          "p95_ms")
+    # sampler never saw the histogram — a broken pin, not a quiet one.
+    # In a merged fleet block the coverage is PER COORDINATOR: every
+    # member's sampler must have seen its own histogram
+    for coord, group, objective in sorted(
+            latency_keys, key=lambda k: (k[0] or "", k[1], k[2])):
+        if (coord, group, objective) not in seen_p95:
+            who = f" on coordinator {coord!r}" if coord else ""
+            bad("schema", f"latency objective for group {group!r}"
+                          f"{who} has no timeline point with a "
+                          "windowed p95_ms")
 
 
 def validate_slo_block(flat: Dict[str, Dict]) -> Dict:
@@ -225,9 +260,13 @@ def render(flat: Dict[str, Dict], verdict: Dict) -> str:
         slo = flat[metric].get("slo")
         if not isinstance(slo, dict):
             continue
+        fleet = slo.get("coordinators")
+        fleet_s = f", merged over {fleet} coordinators" \
+            if isinstance(fleet, int) and not isinstance(fleet, bool) \
+            else ""
         lines.append(f"{metric}: slo block "
                      f"(sampled every "
-                     f"{slo.get('sample_interval_s')}s)")
+                     f"{slo.get('sample_interval_s')}s{fleet_s})")
         for obj in slo.get("objectives") or ():
             if not isinstance(obj, dict):
                 continue
@@ -244,7 +283,10 @@ def render(flat: Dict[str, Dict], verdict: Dict) -> str:
                 if obj.get("objective") == "latency" and \
                 _num(thr) and _num(target) \
                 else f"target {target}"
-            lines.append(f"  {obj.get('group')}/"
+            c = obj.get("coordinator")
+            gname = f"{c}:{obj.get('group')}" if c \
+                else obj.get("group")
+            lines.append(f"  {gname}/"
                          f"{obj.get('objective')} ({detail}): "
                          f"{obj.get('state')}, {budget_s}, {worst}")
         alerts = slo.get("alerts") or ()
