@@ -201,6 +201,12 @@ _sp("shared_scan", "boolean", True,
     "decode instead of racing duplicates")
 _sp("speculative_execution", "boolean", True,
     "duplicate straggler tasks on another node, first finished wins")
+_sp("speculative_spool_reads", "boolean", True,
+    "on an exchange transport failure with a committed spool copy, "
+    "race the spool replay against a resumed live pull (first "
+    "complete remainder wins, loser cancelled) instead of committing "
+    "to the replay — pays off when the spool is a latency-modeled "
+    "object store and the worker was merely restarting")
 _sp("spill_partitions", "integer", 16,
     "hash partitions for spill-to-host aggregation")
 _sp("spool_exchange", "boolean", True,
@@ -309,6 +315,33 @@ CONFIG_KEYS: Dict[str, str] = {
                  "every node at shared storage for cross-node replay",
     "spool.max-bytes": "spool disk budget; appends past it fail the "
                        "writing task (default 4GiB)",
+    "spool.backend": "which SpoolStore backend serves new queries: "
+                     "local (append-only page logs, default) or "
+                     "object (content-addressed emulated bucket — "
+                     "exec/spool.py ObjectSpoolStore)",
+    "spool.object.dir": "object-backend bucket directory; point every "
+                        "node at common storage so shuffle state "
+                        "survives the worker set scaling to zero",
+    "spool.object.put-latency-ms": "modeled per-put object-store "
+                                   "round-trip latency (emulates "
+                                   "GCS/S3; default 0)",
+    "spool.object.get-latency-ms": "modeled per-get object-store "
+                                   "round-trip latency (default 0)",
+    "spool.object.bandwidth-mbps": "modeled object-store transfer "
+                                   "bandwidth in megabits/s "
+                                   "(0 = latency-only model)",
+    "autoscale.enabled": "run the elasticity control loop "
+                         "(exec/autoscale.py) on this coordinator",
+    "autoscale.min-workers": "autoscaler floor for the worker set "
+                             "(default 1)",
+    "autoscale.max-workers": "autoscaler ceiling for the worker set "
+                             "(default 8)",
+    "autoscale.scale-step": "max workers launched/drained per control "
+                            "decision (bounded scale steps; default 1)",
+    "autoscale.cooldown-s": "minimum seconds between applied scale "
+                            "actions (default 30)",
+    "autoscale.interval-s": "control-loop evaluation cadence in "
+                            "seconds (default 5)",
     "failpoints": "deterministic fault-injection spec "
                   "(exec/failpoints.py grammar)",
     "timeseries.sample-interval-s": "health-plane sampler cadence in "
@@ -373,6 +406,13 @@ ENV_VARS: Dict[str, str] = {
                               "(on/off; default on)",
     "PRESTO_TPU_FAILPOINTS": "failpoint arming spec applied at import "
                              "(exec/failpoints.py grammar)",
+    "PRESTO_TPU_DEVICE_FLOOR_MS": "modeled per-quantum/per-scanned-"
+                                  "batch device-service floor in ms "
+                                  "(exec/taskexec.py; 0 = off) — the "
+                                  "fixed-throughput device model the "
+                                  "elastic load-ramp bench uses on "
+                                  "hosts whose CPUs cannot show real "
+                                  "multi-process scaling",
     "PRESTO_TPU_TIMESERIES": "set to 'off' to disable the background "
                              "health-plane sampler (obs/timeseries.py)",
     "BENCH_REPIN": "allow bench.py to overwrite pinned proxy seconds",
@@ -534,6 +574,32 @@ class NodeConfig:
         self.spool_dir = props.get("spool.dir")
         raw_sp = props.get("spool.max-bytes")
         self.spool_max_bytes = int(raw_sp) if raw_sp else None
+        #: which SpoolStore backend serves new queries (local/object)
+        #: plus the object backend's bucket + latency/bandwidth model
+        self.spool_backend = props.get("spool.backend")
+        self.spool_object_dir = props.get("spool.object.dir")
+        raw_pl = props.get("spool.object.put-latency-ms")
+        self.spool_object_put_latency_s = \
+            float(raw_pl) / 1e3 if raw_pl else None
+        raw_gl = props.get("spool.object.get-latency-ms")
+        self.spool_object_get_latency_s = \
+            float(raw_gl) / 1e3 if raw_gl else None
+        raw_bw = props.get("spool.object.bandwidth-mbps")
+        self.spool_object_bandwidth_mbps = \
+            float(raw_bw) if raw_bw else None
+        #: elasticity control loop (exec/autoscale.py)
+        self.autoscale_enabled = props.get(
+            "autoscale.enabled", "false").lower() == "true"
+        raw_min = props.get("autoscale.min-workers")
+        self.autoscale_min_workers = int(raw_min) if raw_min else 1
+        raw_max = props.get("autoscale.max-workers")
+        self.autoscale_max_workers = int(raw_max) if raw_max else 8
+        raw_step = props.get("autoscale.scale-step")
+        self.autoscale_scale_step = int(raw_step) if raw_step else 1
+        raw_cd = props.get("autoscale.cooldown-s")
+        self.autoscale_cooldown_s = float(raw_cd) if raw_cd else 30.0
+        raw_iv = props.get("autoscale.interval-s")
+        self.autoscale_interval_s = float(raw_iv) if raw_iv else 5.0
         #: deterministic fault-injection spec (exec/failpoints.py
         #: grammar, ';'-separated) — chaos/soak runs arm failpoints
         #: straight from config.properties, same as the
@@ -571,6 +637,27 @@ def load_resource_groups(etc_dir: str):
         return _json.load(f)
 
 
+def configure_spool(cfg: NodeConfig,
+                    directory: Optional[str] = None) -> None:
+    """Apply a NodeConfig's ``spool.*`` block to the process-wide
+    store (both the coordinator and worker boot paths route here)."""
+    if not (directory or cfg.spool_dir or cfg.spool_max_bytes is not None
+            or cfg.spool_backend or cfg.spool_object_dir
+            or cfg.spool_object_put_latency_s is not None
+            or cfg.spool_object_get_latency_s is not None
+            or cfg.spool_object_bandwidth_mbps is not None):
+        return
+    from .exec.spool import SPOOL
+    SPOOL.configure(
+        directory=directory or cfg.spool_dir,
+        max_bytes=cfg.spool_max_bytes,
+        backend=cfg.spool_backend,
+        object_dir=cfg.spool_object_dir,
+        object_put_latency_s=cfg.spool_object_put_latency_s,
+        object_get_latency_s=cfg.spool_object_get_latency_s,
+        object_bandwidth_mbps=cfg.spool_object_bandwidth_mbps)
+
+
 def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
                     port: Optional[int] = None):
     """Boot a statement server from a config directory — the
@@ -590,10 +677,7 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
     if cfg.result_cache_bytes is not None:
         from .serving.resultcache import RESULTS
         RESULTS.set_limit(cfg.result_cache_bytes)
-    if cfg.spool_dir or cfg.spool_max_bytes is not None:
-        from .exec.spool import SPOOL
-        SPOOL.configure(directory=cfg.spool_dir,
-                        max_bytes=cfg.spool_max_bytes)
+    configure_spool(cfg)
     if cfg.failpoints:
         from .exec.failpoints import FAILPOINTS
         FAILPOINTS.configure_from_spec(cfg.failpoints)
@@ -614,4 +698,24 @@ def server_from_etc(etc_dir: str, host: str = "127.0.0.1",
         runner=runner, host=host,
         port=cfg.http_port if port is None else port,
         resource_groups=load_resource_groups(etc_dir))
+    if cfg.autoscale_enabled:
+        # close the elasticity loop: signals feed -> rules -> local
+        # subprocess workers announcing back to this coordinator. The
+        # controller starts with the server (PrestoTpuServer.start is
+        # not hooked — the loop thread is harmless pre-start) and
+        # stops with it (protocol.stop()).
+        from .exec.autoscale import (AutoscaleController,
+                                     AutoscalePolicy,
+                                     LocalProcessProvider)
+        policy = AutoscalePolicy(
+            min_workers=cfg.autoscale_min_workers,
+            max_workers=cfg.autoscale_max_workers,
+            scale_step=cfg.autoscale_scale_step,
+            cooldown_s=cfg.autoscale_cooldown_s,
+            interval_s=cfg.autoscale_interval_s)
+        provider = LocalProcessProvider(
+            [f"http://{host}:{srv.port}"],
+            spool_dir=cfg.spool_dir, etc_dir=etc_dir)
+        srv.autoscaler = AutoscaleController(provider, policy=policy)
+        srv.autoscaler.start()
     return srv, cfg

@@ -71,6 +71,20 @@ SITES: Dict[str, str] = {
                      "spooled while keeping the original checksum — "
                      "plants an on-disk corruption for the read path "
                      "to detect (exec/spool.py)",
+    "spool.object_put": "object-store spool uploads one blob/manifest "
+                        "(exec/spool.py ObjectSpoolStore); error fails "
+                        "the writing task before the object lands",
+    "spool.object_get": "object-store spool downloads one page blob "
+                        "(exec/spool.py ObjectSpoolStore); error loses "
+                        "the object copy",
+    "exchange.spec_live": "speculative exchange read: the live-pull "
+                          "arm is about to issue one HTTP pull "
+                          "(server/worker.py); an error rule forces "
+                          "the spool-replay arm to win the race",
+    "exchange.spec_replay": "speculative exchange read: the "
+                            "spool-replay arm is about to start "
+                            "(server/worker.py); a sleep/error rule "
+                            "forces the live arm to win the race",
     "mesh.repartition": "mesh executor ships one hash-exchange batch "
                         "over ICI (exec/distributed.py); error fails "
                         "the query before the collective dispatches",

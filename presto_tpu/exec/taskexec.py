@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, List, Optional, TypeVar
 
 from ..obs.metrics import REGISTRY
@@ -54,6 +55,46 @@ LEVEL_THRESHOLDS = (0.0, 1.0, 10.0, 60.0, 300.0)
 
 #: idle GroupShare retention bound (see DeviceScheduler._shares)
 _MAX_SHARES = 256
+
+
+def _service_floor_s() -> float:
+    """Modeled per-quantum device-service floor, seconds
+    (``PRESTO_TPU_DEVICE_FLOOR_MS``). Zero (the default) is a no-op.
+
+    When set, every quantum holds the device for at least this long —
+    a fixed-throughput device model, the same spirit as the object
+    spool's modeled RTT/bandwidth. Elasticity benches set it on their
+    WORKER processes so per-worker capacity is the bottleneck even on
+    a single-core host, where real multi-process compute cannot
+    overlap and throughput could never track the worker count."""
+    import os
+    try:
+        return max(0.0, float(
+            os.environ.get("PRESTO_TPU_DEVICE_FLOOR_MS", "0") or 0)
+            / 1e3)
+    except ValueError:
+        return 0.0
+
+
+_SERVICE_FLOOR_S = _service_floor_s()
+
+
+def device_floor_pad(elapsed_s: float = 0.0) -> None:
+    """Pad one fused kernel chain up to the modeled device-service
+    floor (no-op unless ``PRESTO_TPU_DEVICE_FLOOR_MS`` is set).
+
+    ``run_quantum`` applies this to each task OUTPUT page, but a source
+    task's device work is proportional to the batches it SCANS, and the
+    output buffer coalesces those (filters and partial aggregates can
+    collapse a whole partition into one output page). Scan paths call
+    this per input batch, from inside the owning quantum, so modeled
+    per-worker capacity tracks the rows a worker actually processes —
+    which is what shrinks when the pool scales out."""
+    if _SERVICE_FLOOR_S > 0.0:
+        pad = _SERVICE_FLOOR_S - elapsed_s
+        if pad > 0.0:
+            time.sleep(pad)
+
 
 R = TypeVar("R")
 
@@ -233,7 +274,12 @@ class DeviceScheduler:
                             level=handle.level)
                 if TRACER.enabled else None)
         try:
-            return fn()
+            result = fn()
+            # fixed-throughput device model: pad the quantum to the
+            # floor while HOLDING the device, so capacity is
+            # per-worker and additive across workers
+            device_floor_pad(time.perf_counter() - t0)
+            return result
         finally:
             dt = time.perf_counter() - t0
             if span is not None:
@@ -269,6 +315,57 @@ class DeviceScheduler:
                     self._running = None
                     self._running_thread = None
                 self._cv.notify_all()
+
+    @contextmanager
+    def stalled(self, handle: Optional[TaskHandle]):
+        """Release the device for the duration of a blocking INPUT
+        wait inside a quantum (an exchange consumer parked on remote
+        pages), re-acquiring through normal eligibility on exit.
+
+        ``note_stall`` credits the TIME; this releases the DEVICE.
+        Without it a consumer blocked on another worker's producer
+        holds this worker's device, and two workers whose consumers
+        wait on each other's starved producers deadlock the fleet —
+        the multi-process cluster's version of the classic
+        quantum-holder-waits-on-queued-producer cycle (single-process
+        clusters never see it: all workers share one scheduler and a
+        query's tasks share one re-entrant handle)."""
+        ident = threading.get_ident()
+        with self._cv:
+            # the calling thread is inside run_quantum for this handle
+            # (it owns one nesting level), so giving that level back is
+            # safe even when a sibling thread of the same query is the
+            # recorded runner
+            held = (handle is not None and self._running is handle
+                    and self._running_depth > 0)
+            if held:
+                self._running_depth -= 1
+                if self._running_depth == 0:
+                    self._running = None
+                    self._running_thread = None
+                REGISTRY.counter("device_stall_release_total").inc()
+                self._cv.notify_all()
+        try:
+            yield
+        finally:
+            if held:
+                # re-acquire as soon as the device frees: this is the
+                # CONTINUATION of a quantum already granted through
+                # fair eligibility, not a new one — rejoining the
+                # fair queue here would bill one full queue rotation
+                # per input page, quantizing exchange-bound queries to
+                # the whole cluster's quantum length. Abort is NOT an
+                # escape hatch: the nesting level must be restored so
+                # the enclosing run_quantum's bookkeeping stays
+                # balanced; the body's own cancellation check raises
+                # right after.
+                with self._cv:
+                    while not (self._running is None
+                               or self._running is handle):
+                        self._cv.wait(timeout=1.0)
+                    self._running = handle
+                    self._running_thread = ident
+                    self._running_depth += 1
 
     def note_stall(self, seconds: float) -> None:
         """Record input-stall time (the scan pipeline's consumer waited

@@ -788,6 +788,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._reply(200, fleet.status())
             return
+        if self.path.rstrip("/") == "/v1/autoscale":
+            # elasticity controller status (node-internal plane, like
+            # /v1/slo): policy, worker set, confirmation streaks, and
+            # the last control tick's decisions/applied/blocked
+            ctl = self._srv.autoscaler
+            if ctl is None:
+                self._reply(404, {"error": "autoscaler not enabled"})
+                return
+            self._reply(200, ctl.status())
+            return
         if self.path.rstrip("/") == "/v1/slo":
             # the live ``slo`` block (same builder as the bench pin);
             # flush a sample first so the timeline includes traffic
@@ -1014,6 +1024,11 @@ class PrestoTpuServer:
         #: :meth:`enable_fleet`; a standalone coordinator never pays a
         #: fleet branch
         self.fleet = None
+        #: elasticity control loop (exec/autoscale.AutoscaleController)
+        #: — None unless wired by :func:`config.server_from_etc`
+        #: (autoscale.enabled=true) or attached by the embedding
+        #: harness; surfaced read-only at GET /v1/autoscale
+        self.autoscaler = None
         #: statements whose LAST run drained within the single-round-
         #: trip grace: the inline-lane gate (do_POST). Keyed by raw
         #: statement text; a slow re-run (e.g. after a cache
@@ -1192,6 +1207,8 @@ class PrestoTpuServer:
         # shutdown() handshakes with serve_forever — calling it on a
         # server whose loop never started (embedded create_query use)
         # would block forever
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.fleet is not None:
             self.fleet.stop()
         if self._thread.is_alive():

@@ -60,6 +60,11 @@ _EXCHANGE_RECV_BYTES = REGISTRY.counter("exchange_received_bytes_total")
 _EXCHANGE_WAIT = REGISTRY.histogram("exchange_wait_seconds")
 _EXCHANGE_SPOOL_FALLBACK = REGISTRY.counter(
     "exchange_spool_fallback_total")
+_SPEC_READS = REGISTRY.counter("exchange_speculative_read_total")
+_SPEC_REPLAY_WON = REGISTRY.counter(
+    "exchange_speculative_replay_won_total")
+_SPEC_LIVE_WON = REGISTRY.counter(
+    "exchange_speculative_live_won_total")
 
 _query_handles: Dict[str, list] = {}
 _query_handles_lock = checked_lock("worker.query_handles")
@@ -280,17 +285,30 @@ class ExchangeClient:
     def __init__(self, locations: List[str], buffer_id: int,
                  timeout_s: float = 300.0,
                  fail_fast_s: Optional[float] = None,
-                 cancel_event: Optional[threading.Event] = None):
+                 cancel_event: Optional[threading.Event] = None,
+                 speculative: bool = True,
+                 stall_handle=None):
         self.locations = locations
         self.buffer_id = buffer_id
         self.timeout_s = timeout_s
         self.fail_fast_s = (self.TRANSPORT_FAILURE_TIMEOUT_S
                             if fail_fast_s is None else float(fail_fast_s))
+        #: session property ``speculative_spool_reads``: on a transport
+        #: failure with a committed spool copy, race the spool replay
+        #: against resumed live pulls instead of committing to either
+        self.speculative = bool(speculative)
         #: abort propagation: a DELETEd task must stop waiting on its
         #: upstreams NOW — an exchange wait runs inside a device-
         #: scheduler quantum, and a cancelled task parked there would
         #: hold the device hostage for the whole transport window
         self.cancel_event = cancel_event
+        #: the consuming task's device-scheduler handle: a blocking
+        #: wait on remote pages releases the device through
+        #: ``DeviceScheduler.stalled`` — holding it while parked on
+        #: another worker's output deadlocks multi-process clusters
+        #: (each worker's device held by a consumer whose producer is
+        #: starved behind it on the peer)
+        self.stall_handle = stall_handle
         self.queue: "_queue.Queue" = _queue.Queue(maxsize=64)
         self.stop = threading.Event()
         self._threads = [
@@ -333,6 +351,147 @@ class ExchangeClient:
                 self.queue.put(page)
             token = nxt
         return True
+
+    def _replay_arm(self, query_id: str, task_id: str, token: int,
+                    end: int, cancel: threading.Event,
+                    results: "_queue.Queue") -> None:
+        """Speculative-race arm 1: buffer the remainder from the spool
+        (NOT into the consumer queue — the main thread enqueues only
+        the winner's pages)."""
+        from ..exec.spool import SPOOL, SpoolCorruptionError
+        buf: List[bytes] = []
+        try:
+            FAILPOINTS.hit("exchange.spec_replay", key=task_id,
+                           task_id=task_id)
+            while token < end:
+                if cancel.is_set():
+                    return
+                try:
+                    pages, nxt = SPOOL.read_pages(
+                        query_id, task_id, self.buffer_id, token)
+                except (SpoolCorruptionError, FailpointError) as e:
+                    # a committed-but-damaged copy is decisive: the
+                    # producer must re-run no matter what the live arm
+                    # finds — surface it as the race verdict
+                    results.put(("replay", None, ExchangeFailedError(
+                        f"upstream task {task_id} spool replay "
+                        f"failed: {e}", task_id=task_id), True))
+                    return
+                if nxt == token:
+                    results.put(("replay", None, ExchangeFailedError(
+                        f"upstream task {task_id} spool replay "
+                        f"failed: page log ends at token {token} "
+                        f"of {end}", task_id=task_id), True))
+                    return
+                buf.extend(pages)
+                token = nxt
+            results.put(("replay", buf, None, False))
+        except FailpointError as e:
+            results.put(("replay", None, ExchangeFailedError(
+                f"upstream task {task_id} spool replay failed: {e}",
+                task_id=task_id), False))
+
+    def _live_arm(self, url: str, task_id: str, token: int,
+                  cancel: threading.Event,
+                  results: "_queue.Queue") -> None:
+        """Speculative-race arm 2: resume pulling from the (possibly
+        merely slow or restarting) live worker, buffering pages until
+        the upstream reports complete."""
+        buf: List[bytes] = []
+        deadline = time.monotonic() + self.fail_fast_s
+        while not cancel.is_set() and not self.stop.is_set():
+            try:
+                FAILPOINTS.hit("exchange.spec_live", key=url,
+                               task_id=task_id)
+                FAILPOINTS.hit("exchange.pull", key=url,
+                               task_id=task_id)
+                req = urllib.request.Request(
+                    f"{url}/results/{self.buffer_id}/{token}"
+                    f"?max_wait=2")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    body = resp.read()
+                    complete = resp.headers.get(
+                        "X-Buffer-Complete") == "true"
+                    token = int(resp.headers.get("X-Next-Token", token))
+            except (FailpointError, urllib.error.HTTPError) as e:
+                # injected loss, or the upstream answered and refused:
+                # the live arm is out of the race for good
+                results.put(("live", None, e, False))
+                return
+            except Exception as e:
+                if time.monotonic() >= deadline:
+                    results.put(("live", None, e, False))
+                    return
+                time.sleep(jittered(0.2))
+                continue
+            buf.extend(unframe_pages(body))
+            if complete:
+                results.put(("live", buf, None, False))
+                return
+        # cancelled: the replay arm already won
+
+    def _race_spool(self, url: str, task_id: str,
+                    token: int) -> Optional[bool]:
+        """Speculative read: race the durable-spool replay against a
+        resumed live pull, first complete remainder wins, loser
+        cancelled. Engaged on transport failures when the upstream's
+        attempt has a committed spool copy — with an object-store
+        backend a replay pays real GCS/S3-style latency, so a worker
+        that was merely restarting can beat it; with the producer truly
+        gone the replay wins unopposed. Returns True when the
+        remainder was enqueued (either arm), None when there is no
+        committed copy; raises :class:`ExchangeFailedError` when both
+        arms lose (a corrupt spool copy is decisive immediately)."""
+        from ..exec.spool import SPOOL
+        query_id = task_id.split(".")[0]
+        tokens = SPOOL.finished_tokens(query_id, task_id)
+        if tokens is None or self.buffer_id >= len(tokens):
+            return None
+        if not self.speculative:
+            return self._drain_spool(task_id, token)
+        # the replay ATTEMPT counts as a spool fallback (same meaning
+        # as the non-speculative path: a committed copy is being read)
+        _EXCHANGE_SPOOL_FALLBACK.inc()
+        _SPEC_READS.inc()
+        end = tokens[self.buffer_id]
+        cancel = threading.Event()
+        results: "_queue.Queue" = _queue.Queue()
+        arms = [
+            threading.Thread(
+                target=self._replay_arm,
+                args=(query_id, task_id, token, end, cancel, results),
+                daemon=True),
+            threading.Thread(
+                target=self._live_arm,
+                args=(url, task_id, token, cancel, results),
+                daemon=True),
+        ]
+        for t in arms:
+            t.start()
+        errors: List[Exception] = []
+        decisive: Optional[Exception] = None
+        for _ in range(len(arms)):
+            who, buf, err, is_decisive = results.get()
+            if buf is not None:
+                cancel.set()           # first complete remainder wins
+                (_SPEC_REPLAY_WON if who == "replay"
+                 else _SPEC_LIVE_WON).inc()
+                for page in buf:
+                    _EXCHANGE_RECV_BYTES.inc(len(page))
+                    self.queue.put(page)
+                return True
+            if is_decisive:
+                cancel.set()
+                decisive = err
+                break
+            errors.append(err)
+        cancel.set()
+        if decisive is not None:
+            raise decisive
+        raise ExchangeFailedError(
+            f"upstream task {task_id} lost the speculative read on "
+            f"both arms: {'; '.join(str(e) for e in errors)}",
+            task_id=task_id, url=url)
 
     def _pull(self, url: str) -> None:
         token = 0
@@ -377,9 +536,11 @@ class ExchangeClient:
                         task_id=task_id, url=url) from None
                 except Exception as e:  # transport: bounded retry
                     # a dead producer whose attempt committed its
-                    # spool needs no retry window at all — drain the
-                    # rest from storage immediately
-                    if self._drain_spool(task_id, token):
+                    # spool needs no retry window at all — race the
+                    # spool replay against a resumed live pull (the
+                    # worker may be merely restarting; with an
+                    # object-store spool the replay is not free)
+                    if self._race_spool(url, task_id, token):
                         break
                     now = time.monotonic()
                     if first_err is None:
@@ -413,17 +574,20 @@ class ExchangeClient:
         except _queue.Empty:
             pass
         from ..exec import taskexec
+        sched = (self.stall_handle.scheduler
+                 if self.stall_handle is not None else taskexec.GLOBAL)
         t0 = time.monotonic()
         try:
-            while True:
-                if self.cancel_event is not None \
-                        and self.cancel_event.is_set():
-                    from ..errors import QueryCancelledError
-                    raise QueryCancelledError("task aborted")
-                try:
-                    return self.queue.get(timeout=0.25)
-                except _queue.Empty:
-                    continue
+            with sched.stalled(self.stall_handle):
+                while True:
+                    if self.cancel_event is not None \
+                            and self.cancel_event.is_set():
+                        from ..errors import QueryCancelledError
+                        raise QueryCancelledError("task aborted")
+                    try:
+                        return self.queue.get(timeout=0.25)
+                    except _queue.Empty:
+                        continue
         finally:
             dt = time.monotonic() - t0
             _EXCHANGE_WAIT.observe(dt)
@@ -463,14 +627,26 @@ class _TaskExecutor(local_exec._Executor):
         # queries hit device memory on every node, and cold splits
         # decode/stage on background threads while this task's kernels
         # run (exec/scancache.py)
-        from ..exec import scancache
+        from ..exec import scancache, taskexec
         conn = self.session.catalogs.get(node.catalog)
         opts = scancache.options_from_session(self.session)
-        yield from scancache.scan_splits(
+        it = scancache.scan_splits(
             conn, node.catalog, list(node.columns),
             list(self.assigned_splits), self._scan_pushdown_fn(node),
             self.rows_per_batch, opts, stats=self.stats,
             static_pushdown=node.pushdown or None)
+        # modeled device floor per SCANNED batch (no-op unless
+        # PRESTO_TPU_DEVICE_FLOOR_MS is set): the output buffer above
+        # this node coalesces pages, so the quantum-level floor alone
+        # would bill a worker by what it EMITS, not what it processes
+        sentinel = object()
+        while True:
+            t0 = time.perf_counter()
+            b = next(it, sentinel)
+            if b is sentinel:
+                return
+            taskexec.device_floor_pad(time.perf_counter() - t0)
+            yield b
 
     def _RemoteSourceNode(self, node) -> Iterator[Batch]:
         locations: List[str] = []
@@ -479,10 +655,16 @@ class _TaskExecutor(local_exec._Executor):
         fail_fast = float(self.session.properties.get(
             "exchange_failure_timeout_s",
             ExchangeClient.TRANSPORT_FAILURE_TIMEOUT_S))
+        from ..exec.local import bool_property
         client = ExchangeClient(locations, self.partition,
                                 fail_fast_s=fail_fast,
                                 cancel_event=getattr(
-                                    self, "cancel_event", None))
+                                    self, "cancel_event", None),
+                                speculative=bool_property(
+                                    self.session,
+                                    "speculative_spool_reads", True),
+                                stall_handle=getattr(
+                                    self, "task_handle", None))
         schema = local_exec._plan_schema(node)
         for b in client.batches():
             # positional contract: upstream emits the same field layout
@@ -605,6 +787,9 @@ class Task:
                 # abort propagation: the executor checks this event per
                 # scan batch, so a DELETE interrupts a task mid-scan
                 ex.cancel_event = self._abort
+                # exchange consumers release the device while parked on
+                # remote pages (DeviceScheduler.stalled via this handle)
+                ex.task_handle = handle
                 ex.init_values = self.init_values
                 ex.mark_shared([self.root])
                 # fair device scheduling across concurrent tasks: one
@@ -883,6 +1068,10 @@ class WorkerServer:
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
         self._announcer = None
+        #: set once stop() ran — subprocess workers (the autoscaler's
+        #: LocalProcessProvider) park their main thread on it so a
+        #: drained worker EXITS its process instead of sleeping forever
+        self.stopped = threading.Event()
 
     def start(self) -> None:
         # workers carry the same windowed-history surface as the
@@ -921,6 +1110,7 @@ class WorkerServer:
         # peer (exchange pulls, coordinator probes) hang to its full
         # timeout instead of failing over to the spool instantly
         self.httpd.server_close()
+        self.stopped.set()
 
     def create_task(self, task_id: str, doc: dict) -> Task:
         # idempotent: the coordinator's transport retries task PUTs, so
@@ -1050,6 +1240,15 @@ def main() -> None:
                    help="coordinator URL to announce to "
                         "(overrides etc discovery.uri)")
     args = p.parse_args()
+    try:
+        # ops hook: SIGUSR1 dumps every thread's stack to stderr — the
+        # way to see what a wedged worker is waiting on without
+        # attaching a debugger to the subprocess
+        import faulthandler
+        import signal
+        faulthandler.register(signal.SIGUSR1)
+    except (ImportError, AttributeError, ValueError):
+        pass
     catalogs = None
     node_id = args.node_id
     port = args.port
@@ -1065,10 +1264,8 @@ def main() -> None:
         if cfg.failpoints:
             FAILPOINTS.configure_from_spec(cfg.failpoints)
         spool_dir = spool_dir or cfg.spool_dir
-        if spool_dir or cfg.spool_max_bytes is not None:
-            from ..exec.spool import SPOOL
-            SPOOL.configure(directory=spool_dir,
-                            max_bytes=cfg.spool_max_bytes)
+        from ..config import configure_spool
+        configure_spool(cfg, directory=spool_dir)
     elif spool_dir:
         from ..exec.spool import SPOOL
         SPOOL.configure(directory=spool_dir)
@@ -1079,8 +1276,11 @@ def main() -> None:
     if discovery_uri:
         w.start_announcing(discovery_uri, advertised_host=args.host)
     try:
-        while True:
-            time.sleep(3600)
+        # park until drained: a PUT /v1/info/state SHUTTING_DOWN (the
+        # autoscaler's scale-down path) ends in stop(), and the process
+        # must exit so its provider can reap it
+        while not w.stopped.wait(timeout=3600):
+            pass
     except KeyboardInterrupt:
         pass
 

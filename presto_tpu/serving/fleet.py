@@ -332,6 +332,11 @@ class FleetMember:
             doc = {"origin": self.node_id, "hbSeq": self._hb_seq,
                    "leaving": bool(leaving), "groups": groups,
                    "serving": self._serving_counters(),
+                   # self-identification makes peering dynamic: a
+                   # scaled-up coordinator heartbeats its way into
+                   # every member's peer list, and a leaving farewell
+                   # prunes it back out (autoscaled coordinator tier)
+                   "url": self.self_url,
                    "ts": time.time()}
             peers = list(self._peers)
         for peer in peers:
@@ -370,17 +375,35 @@ class FleetMember:
         if not origin or origin == self.node_id:
             return False
         leaving = bool(doc.get("leaving"))
+        url = str(doc.get("url") or "").rstrip("/")
         with self._lock:
             if leaving:
                 # clean drain: counts drop immediately, and the member
-                # is forgotten — NOT a loss
+                # is forgotten — NOT a loss. The EXPLICIT deregister
+                # (vs waiting out the staleness grace): its url leaves
+                # the peer list now, so no further broadcast/heartbeat
+                # is ever addressed to the drained coordinator
                 self._remote.pop(origin, None)
                 self._lost.discard(origin)
+                if url and url in self._peers:
+                    self._peers.remove(url)
             else:
                 self._remote[origin] = {
                     "t": time.monotonic(),
                     "groups": dict(doc.get("groups") or {})}
                 self._lost.discard(origin)
+                # dynamic peering: an autoscaled-up coordinator only
+                # knows the incumbents — its first heartbeat teaches
+                # each of them its url (docs without "url" — older
+                # members, hand-built tests — change nothing)
+                if url and url != self.self_url \
+                        and url not in self._peers:
+                    self._peers.append(url)
+        if leaving and self._discovery is not None:
+            # drop its coordinator record from the shared membership
+            # immediately too (role="coordinator" entries never enter
+            # worker scheduling, but status surfaces read them)
+            self._discovery.remove(origin)
         _HEARTBEAT_FOLD.inc()
         # federate the peer's serving counters into the local store
         # (the PR 16 record() path, origin-tagged like worker series):

@@ -1,0 +1,316 @@
+"""The elasticity control loop (ISSUE 20): rule-registry parity with
+the reference watcher, and the controller's stability machinery —
+hysteresis, cooldown, bounded steps, the PAGE-never-scale-down
+invariant re-checked at apply time, drain-never-kill scale-down,
+launch-before-drain node replacement, and coordinator-tier routing for
+admission-bound groups. Every controller test drives ``evaluate`` tick
+by tick with injected signals and a fake provider — no sockets, no
+sleeps."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+from presto_tpu.exec import autoscale
+from presto_tpu.exec.autoscale import (AutoscaleController,
+                                       AutoscalePolicy, NodeHandle,
+                                       NodeProvider, decide,
+                                       demo_signals)
+from presto_tpu.obs.signals import (CacheSignals, ClusterSignals,
+                                    GroupSignals, NodeSignals)
+
+
+# -- the watcher is a shim over THE rule registry -----------------------------
+
+def test_watcher_is_a_shim_over_the_controller_rules():
+    """tools/autoscale_watch.py must re-export the controller's rule
+    registry — same function objects, so the reference watcher and the
+    control loop cannot drift."""
+    import autoscale_watch as watch
+    assert watch.decide is autoscale.decide
+    assert watch.demo_signals is autoscale.demo_signals
+    assert watch.RULES is autoscale.RULES
+
+
+def test_rules_registry_covers_every_action():
+    assert sorted(autoscale.RULES) == [
+        "grow_cache", "replace_node", "scale_coordinator",
+        "scale_down", "scale_up"]
+
+
+def test_demo_signals_decision_contract():
+    """The synthetic busy cluster fires every classic rule exactly as
+    the watcher's ``--demo`` mode documents (same fixture the signals
+    feed's contract test pins)."""
+    decisions = decide(demo_signals())
+    by_action = {}
+    for d in decisions:
+        by_action.setdefault(d["action"], []).append(d["target"])
+    assert by_action["scale_up"] == ["serving.dash", "serving.adhoc"]
+    assert by_action["scale_down"] == ["batch"]
+    assert by_action["replace_node"] == ["w1"]
+    assert by_action["grow_cache"] == ["scan_cache"]
+    # the paging group may never be recommended down
+    assert "serving.adhoc" not in by_action["scale_down"]
+
+
+# -- controller fixtures ------------------------------------------------------
+
+class FakeProvider(NodeProvider):
+    """Ledger provider: every controller call is recorded, drains can
+    be forced to fail, nothing real happens."""
+
+    def __init__(self, n: int = 1):
+        self._seq = 0
+        self._handles = []
+        self.calls = []
+        self.drain_ok = True
+        for _ in range(n):
+            self.launch()
+            self.calls.clear()
+
+    def launch(self):
+        self._seq += 1
+        h = NodeHandle(f"w{self._seq}",
+                       f"http://127.0.0.1:{7000 + self._seq}")
+        self._handles.append(h)
+        self.calls.append(("launch", h.node_id))
+        return h
+
+    def nodes(self):
+        return list(self._handles)
+
+    def drain(self, handle, timeout_s: float = 30.0):
+        self.calls.append(("drain", handle.node_id))
+        if self.drain_ok:
+            self._handles.remove(handle)
+        return self.drain_ok
+
+    def terminate(self, handle):
+        self.calls.append(("terminate", handle.node_id))
+        if handle in self._handles:
+            self._handles.remove(handle)
+
+
+def _signals(groups=(), nodes=(), caches=None):
+    return ClusterSignals(ts=0.0, groups=tuple(groups),
+                          nodes=tuple(nodes),
+                          caches=caches or CacheSignals())
+
+
+def _busy(group="serving", queued=40, running=8, limit=8,
+          alert="OK"):
+    return GroupSignals(group=group, state="FULL", running=running,
+                        queued=queued, hard_concurrency_limit=limit,
+                        alert_state=alert)
+
+
+def _idle(group="batch", alert="OK"):
+    return GroupSignals(group=group, state="CAN_RUN", running=0,
+                        queued=0, hard_concurrency_limit=16,
+                        error_budget_remaining=1.0, alert_state=alert)
+
+
+def _controller(provider, **policy):
+    policy.setdefault("confirm_evals", 2)
+    policy.setdefault("cooldown_s", 30.0)
+    return AutoscaleController(provider,
+                               AutoscalePolicy(**policy),
+                               signals_fn=lambda: _signals())
+
+
+# -- hysteresis / cooldown / bounds -------------------------------------------
+
+def test_hysteresis_one_snapshot_moves_nothing():
+    prov = FakeProvider(n=1)
+    ctl = _controller(prov, confirm_evals=3)
+    # busy node so scale_up fires; three confirmations required
+    sig = _signals(groups=[_busy()],
+                   nodes=[NodeSignals("w1", "active", 1.0, 4)])
+    for tick in range(2):
+        rep = ctl.evaluate(signals=sig, now=float(tick))
+        assert rep["applied"] == []
+        assert rep["blocked"][0]["blocked"] == "hysteresis"
+        assert prov.calls == []
+    rep = ctl.evaluate(signals=sig, now=2.0)
+    assert [a["action"] for a in rep["applied"]] == ["scale_up"]
+    assert ("launch", "w2") in prov.calls
+
+
+def test_streak_resets_when_recommendation_stops():
+    prov = FakeProvider(n=1)
+    ctl = _controller(prov, confirm_evals=2)
+    busy = _signals(groups=[_busy()])
+    calm = _signals(groups=[_busy(queued=0, running=1)])
+    ctl.evaluate(signals=busy, now=0.0)       # streak 1
+    ctl.evaluate(signals=calm, now=1.0)       # streak wiped
+    rep = ctl.evaluate(signals=busy, now=2.0)  # streak back to 1
+    assert rep["applied"] == []
+    assert prov.calls == []
+
+
+def test_cooldown_spaces_applied_actions():
+    prov = FakeProvider(n=1)
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=30.0,
+                      max_workers=8)
+    sig = _signals(groups=[_busy()])
+    assert ctl.evaluate(signals=sig, now=0.0)["applied"]
+    rep = ctl.evaluate(signals=sig, now=5.0)
+    assert rep["applied"] == []
+    assert rep["blocked"][0]["blocked"] == "cooldown"
+    # past the cooldown the same confirmed decision applies again
+    assert ctl.evaluate(signals=sig, now=31.0)["applied"]
+
+
+def test_bounds_clamp_scale_up_and_down():
+    prov = FakeProvider(n=2)
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0,
+                      min_workers=2, max_workers=2)
+    up = _signals(groups=[_busy()])
+    rep = ctl.evaluate(signals=up, now=0.0)
+    assert rep["blocked"][0]["blocked"] == "bounds"
+    down = _signals(groups=[_idle()])
+    rep = ctl.evaluate(signals=down, now=1.0)
+    assert rep["blocked"][0]["blocked"] == "bounds"
+    assert prov.calls == []
+    assert len(prov.nodes()) == 2
+
+
+# -- the invariants -----------------------------------------------------------
+
+def test_page_anywhere_holds_every_scale_down():
+    """While ANY group pages, the cluster never shrinks — even a group
+    the rules judged idle (the PR 16 invariant, re-checked at apply
+    time, not just in the rules)."""
+    prov = FakeProvider(n=3)
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0)
+    sig = _signals(groups=[_idle("batch"),
+                           _busy("dash", alert="PAGE")])
+    for tick in range(3):
+        rep = ctl.evaluate(signals=sig, now=float(tick))
+        down = [b for b in rep["blocked"]
+                if b["action"] == "scale_down"]
+        assert down and down[0]["blocked"] == "page-held"
+    assert ("drain", "w1") not in prov.calls
+    assert ("drain", "w2") not in prov.calls
+    assert len(prov.nodes()) >= 3
+
+
+def test_scale_down_is_always_a_drain_never_a_kill():
+    prov = FakeProvider(n=3)
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0)
+    rep = ctl.evaluate(signals=_signals(groups=[_idle()]), now=0.0)
+    assert [a["action"] for a in rep["applied"]] == ["scale_down"]
+    kinds = {c[0] for c in prov.calls}
+    assert kinds == {"drain"}, prov.calls
+
+
+def test_stuck_drain_blocks_instead_of_escalating():
+    """A drain that never confirms leaves the node serving — blocked
+    as drain-failed, retried next tick, NEVER terminated."""
+    prov = FakeProvider(n=3)
+    prov.drain_ok = False
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0)
+    rep = ctl.evaluate(signals=_signals(groups=[_idle()]), now=0.0)
+    assert rep["applied"] == []
+    assert rep["blocked"][0]["blocked"] == "drain-failed"
+    assert ("terminate", "w1") not in prov.calls
+    assert len(prov.nodes()) == 3
+
+
+def test_replace_node_launches_capacity_first():
+    prov = FakeProvider(n=2)
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0,
+                      max_workers=8)
+    sig = _signals(nodes=[NodeSignals("w1", "active", 120.0, 0)])
+    rep = ctl.evaluate(signals=sig, now=0.0)
+    assert [a["action"] for a in rep["applied"]] == ["replace_node"]
+    # the replacement launched BEFORE the stale node drained out
+    assert prov.calls.index(("launch", "w3")) \
+        < prov.calls.index(("drain", "w1"))
+
+
+def test_replace_node_terminates_only_a_corpse():
+    prov = FakeProvider(n=2)
+    prov.drain_ok = False
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0)
+    sig = _signals(nodes=[NodeSignals("w1", "active", 120.0, 0)])
+    ctl.evaluate(signals=sig, now=0.0)
+    # too dead to drain -> terminate IS the right tool (replacement of
+    # a corpse, not scale-down)
+    assert ("terminate", "w1") in prov.calls
+
+
+def test_victim_selection_prefers_idle_nodes():
+    prov = FakeProvider(n=3)
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0)
+    sig = _signals(groups=[_idle()],
+                   nodes=[NodeSignals("w1", "active", 1.0, 5),
+                          NodeSignals("w2", "active", 1.0, 0),
+                          NodeSignals("w3", "active", 1.0, 2)])
+    ctl.evaluate(signals=sig, now=0.0)
+    assert ("drain", "w2") in prov.calls
+    assert ("drain", "w1") not in prov.calls
+
+
+# -- coordinator-tier routing -------------------------------------------------
+
+def _admission_bound():
+    return _signals(
+        groups=[_busy(queued=40, running=8, limit=8)],
+        nodes=[NodeSignals("w1", "active", 1.0, 0),
+               NodeSignals("w2", "active", 1.0, 1)])
+
+
+def test_admission_bound_routes_to_coordinator_scaler():
+    class Scaler:
+        reasons = []
+
+        def scale_up(self, reason):
+            self.reasons.append(reason)
+            return True
+
+    prov = FakeProvider(n=2)
+    scaler = Scaler()
+    ctl = AutoscaleController(prov, AutoscalePolicy(
+        confirm_evals=1, cooldown_s=0.0, max_workers=2),
+        signals_fn=lambda: _signals(), coordinator_scaler=scaler)
+    rep = ctl.evaluate(signals=_admission_bound(), now=0.0)
+    applied = {a["action"] for a in rep["applied"]}
+    assert "scale_coordinator" in applied
+    assert scaler.reasons and "admission-bound" in scaler.reasons[0]
+
+
+def test_admission_bound_without_scaler_blocks():
+    prov = FakeProvider(n=2)
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0,
+                      max_workers=2)
+    rep = ctl.evaluate(signals=_admission_bound(), now=0.0)
+    blocked = {b["action"]: b["blocked"] for b in rep["blocked"]}
+    assert blocked["scale_coordinator"] == "no-scaler"
+
+
+# -- observability ------------------------------------------------------------
+
+def test_status_surface_reports_policy_and_streaks():
+    prov = FakeProvider(n=1)
+    ctl = _controller(prov, confirm_evals=3)
+    ctl.evaluate(signals=_signals(groups=[_busy()]), now=0.0)
+    st = ctl.status()
+    assert st["running"] is False
+    assert st["policy"]["confirmEvals"] == 3
+    assert st["streaks"].get("scale_up:serving") == 1
+    assert st["workers"][0]["nodeId"] == "w1"
+
+
+def test_controller_actions_are_counted():
+    from presto_tpu.obs.metrics import REGISTRY
+    prov = FakeProvider(n=1)
+    ctl = _controller(prov, confirm_evals=1, cooldown_s=0.0,
+                      max_workers=8)
+    before = REGISTRY.counter("autoscale_actions_total.scale_up").value
+    ctl.evaluate(signals=_signals(groups=[_busy()]), now=0.0)
+    after = REGISTRY.counter("autoscale_actions_total.scale_up").value
+    assert after == before + 1

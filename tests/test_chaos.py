@@ -4,7 +4,7 @@ run.
 
 Thin pytest wrapper over tools/chaos_smoke.py (also runnable directly
 from the CLI) — an elastic discovery-fed in-process cluster survives
-one injected task failure, one exchange drop, one 15s straggler
+one injected task failure, one exchange drop, one 30s straggler
 (speculative win), a worker death, a worker killed AFTER spooling its
 output (replayed, NOT re-run), an on-disk spool-page corruption
 (checksum -> retry from upstream), a fresh worker joining mid-query
@@ -73,6 +73,11 @@ def test_elastic_regression_gate_smoke(capsys):
     verdict = json.loads(out)
     assert verdict["verdict"] == "pass"
     assert "elastic_recovery_ms" in verdict["metrics"]
+    # ramp gate (ELASTIC_r02 on): the pinned round must carry a
+    # schema-valid 1 -> N -> 1 load-ramp block, so a bad re-pin
+    # cannot be committed
+    assert verdict["ramp"]["ok"] is True
+    assert verdict["ramp"]["blocks"] >= 1
 
 
 def test_lock_discipline_clean_after_chaos():

@@ -227,3 +227,52 @@ def test_remote_bump_vs_local_insert_interleaving():
         ex = explore(make, max_schedules=48, preemption_bound=2)
     assert ex.schedules, "explorer executed no schedules"
     ex.assert_clean()
+
+
+def test_explicit_deregister_beats_the_staleness_grace():
+    """ISSUE 20 scale-down race: a DRAINED coordinator must leave the
+    survivor's peer list and federated counts the moment its final
+    ``leaving`` heartbeat folds — not ``staleness_grace_s`` later, and
+    never declared lost. A KILLED coordinator (no leaving heartbeat)
+    keeps holding its admission share until the grace expires, then is
+    declared lost exactly once. With the grace set huge, only the
+    explicit path can possibly clear state — the regression this pins:
+    an autoscaler that drains and instantly relaunches must never see
+    the old member's ghost counts bind admission against the new one."""
+    survivor = FleetMember("coord-0", "http://127.0.0.1:9100",
+                           staleness_grace_s=3600.0)
+    lost0 = _metric("coordinator_lost_total")
+
+    def hb(origin, url, leaving=False):
+        return {"origin": origin, "url": url, "leaving": leaving,
+                "groups": {"serving": {"running": 7, "memory": 0}}}
+
+    # two members join: their counts bind admission, urls enter peering
+    survivor.fold_heartbeat(hb("coord-drain", "http://127.0.0.1:9101"))
+    survivor.fold_heartbeat(hb("coord-kill", "http://127.0.0.1:9102"))
+    assert survivor.remote_running("serving") == 14
+    assert set(survivor.peers()) == {"http://127.0.0.1:9101",
+                                     "http://127.0.0.1:9102"}
+
+    # clean drain: one leaving heartbeat clears EVERYTHING now
+    survivor.fold_heartbeat(hb("coord-drain", "http://127.0.0.1:9101",
+                               leaving=True))
+    st = survivor.status()
+    assert survivor.remote_running("serving") == 7
+    assert st["peers"] == ["http://127.0.0.1:9102"]
+    assert "coord-drain" not in st["remote"]
+    assert st["lost"] == []                      # a drain is NOT a loss
+    assert _metric("coordinator_lost_total") == lost0
+
+    # killed member: counts persist inside the grace...
+    survivor._sweep_lost()
+    assert survivor.remote_running("serving") == 7
+    assert "coord-kill" in survivor.status()["remote"]
+    # ...and only expiring the grace declares the loss (once)
+    survivor.staleness_grace_s = 0.0
+    survivor._sweep_lost()
+    survivor._sweep_lost()
+    st = survivor.status()
+    assert st["lost"] == ["coord-kill"]
+    assert survivor.remote_running("serving") == 0
+    assert _metric("coordinator_lost_total") == lost0 + 1

@@ -123,8 +123,11 @@ def test_q1_reconciles_and_reports_dominant(tpch, n):
     assert cp["per_shard_s"][cp["slowest_shard"]] == \
         max(cp["per_shard_s"])
     # per-shard path never exceeds total bucketed wall (rounds gate
-    # shards at most fully)
-    assert max(cp["per_shard_s"]) <= sum(a["buckets"].values()) + 1e-6
+    # shards at most fully); each bucket is independently rounded to
+    # 6 decimals, so the sum can trail the true wall by half an ULP
+    # per bucket — the slack must cover that, not just float noise
+    slack = (len(a["buckets"]) + 1) * 5e-7
+    assert max(cp["per_shard_s"]) <= sum(a["buckets"].values()) + slack
 
 
 @pytest.mark.slow

@@ -323,3 +323,322 @@ def test_spool_session_property_registered():
     assert validate_session_property("spool_exchange", "false") is False
     with pytest.raises(Exception):
         validate_session_property("spool_exchang", True)
+
+
+# -- the object-store backend (ISSUE 20) --------------------------------------
+
+@pytest.fixture()
+def obj(tmp_path):
+    from presto_tpu.exec.spool import ObjectSpoolStore
+    return ObjectSpoolStore(directory=str(tmp_path / "bucket"))
+
+
+def _counter(name: str) -> float:
+    from presto_tpu.obs.metrics import REGISTRY
+    return REGISTRY.counter(name).value
+
+
+def test_object_store_roundtrip_and_manifest_commit(obj):
+    """Pages upload as content-addressed blobs immediately; the
+    attempt becomes visible to OTHER processes only when the manifest
+    commits — but the owning process reads its uncommitted pages
+    through the live index the whole time."""
+    w = obj.writer("q1", "q1.0.0", 2)
+    w.append(0, 0, b"page-zero")
+    w.append(0, 1, b"page-one")
+    w.append(1, 0, b"other-buffer")
+    # uncommitted: no completion marker, live index still serves
+    assert obj.finished_tokens("q1", "q1.0.0") is None
+    pages, nxt = obj.read_pages("q1", "q1.0.0", 0, 0)
+    assert pages == [b"page-zero", b"page-one"] and nxt == 2
+    w.finish([2, 1])
+    assert obj.finished_tokens("q1", "q1.0.0") == [2, 1]
+    # token addressing, mid-stream resume
+    pages, nxt = obj.read_pages("q1", "q1.0.0", 0, 1)
+    assert pages == [b"page-one"] and nxt == 2
+    pages, nxt = obj.read_pages("q1", "q1.0.0", 1, 0)
+    assert pages == [b"other-buffer"] and nxt == 1
+
+
+def test_object_store_survives_scale_to_zero(obj, tmp_path):
+    """A committed attempt is readable by a PROCESS THAT NEVER WROTE
+    IT (fresh store over the same bucket): every worker that produced
+    the data can be gone — the scale-to-zero contract."""
+    from presto_tpu.exec.spool import ObjectSpoolStore
+    w = obj.writer("q1", "q1.0.0", 1)
+    w.append(0, 0, b"durable-page")
+    w.finish([1])
+    fresh = ObjectSpoolStore(directory=str(tmp_path / "bucket"))
+    assert fresh.finished_tokens("q1", "q1.0.0") == [1]
+    pages, nxt = fresh.read_pages("q1", "q1.0.0", 0, 0)
+    assert pages == [b"durable-page"] and nxt == 1
+
+
+def test_object_store_content_addressed_dedup(obj):
+    """Identical payloads (broadcast pages fanned to every consumer
+    buffer) store ONE blob: dedup counted, bytes charged once."""
+    dedup0 = _counter("spool_object_dedup_total")
+    w = obj.writer("q1", "q1.0.0", 3)
+    payload = b"broadcast-page" * 16
+    for buf in range(3):
+        w.append(buf, 0, payload)
+    w.finish([1, 1, 1])
+    assert _counter("spool_object_dedup_total") == dedup0 + 2
+    blob_dir = os.path.join(obj.directory, "q1", "blobs")
+    assert len(os.listdir(blob_dir)) == 1
+    # accounting charges the blob once plus the manifest — never the
+    # 3x a per-reference charge would cost
+    assert obj.usage()["bytes"] < 3 * len(payload)
+    for buf in range(3):
+        pages, _ = obj.read_pages("q1", "q1.0.0", buf, 0)
+        assert pages == [payload]
+
+
+def test_object_torn_manifest_is_uncommitted_not_corrupt(obj):
+    """A torn/garbled manifest upload is an UNCOMMITTED attempt —
+    readers keep their normal retry semantics, nothing raises."""
+    w = obj.writer("q1", "q1.0.0", 1)
+    w.append(0, 0, b"page")
+    path = obj._manifest_path("q1", "q1.0.0", create=True)
+    with open(path, "wb") as f:
+        f.write(b'{"tok')                 # torn mid-upload
+    assert obj.finished_tokens("q1", "q1.0.0") is None
+    with open(path, "wb") as f:
+        f.write(b'{"no_tokens_key": 1}')  # garbled
+    assert obj.finished_tokens("q1", "q1.0.0") is None
+
+
+def test_object_corruption_is_attributed_to_the_page(obj):
+    """The planted-corruption contract carries over from the disk
+    backend: digest/crc are of the CLEAN page, so the read side names
+    the exact page that failed its checksum."""
+    FAILPOINTS.configure("spool.corrupt", action="error", times=1)
+    w = obj.writer("q1", "q1.0.0", 1)
+    w.append(0, 0, b"page-to-corrupt")
+    w.finish([1])
+    before = _counter("spool_corruption_total")
+    with pytest.raises(SpoolCorruptionError, match=r"b0/t0"):
+        obj.read_pages("q1", "q1.0.0", 0, 0)
+    assert _counter("spool_corruption_total") == before + 1
+
+
+def test_object_missing_blob_is_corruption(obj):
+    """A manifest referencing a vanished blob is a damaged copy, not
+    a retryable miss — the consumer must re-run the producer."""
+    w = obj.writer("q1", "q1.0.0", 1)
+    w.append(0, 0, b"page")
+    w.finish([1])
+    import hashlib
+    digest = hashlib.sha256(b"page").hexdigest()[:32]
+    os.unlink(obj._blob_path("q1", digest))
+    with pytest.raises(SpoolCorruptionError, match="unreadable"):
+        obj.read_pages("q1", "q1.0.0", 0, 0)
+
+
+def test_object_release_query_gc_zero_orphans(obj):
+    w = obj.writer("qa", "qa.0.0", 1)
+    w.append(0, 0, b"qa-page")
+    w.finish([1])
+    w = obj.writer("qb", "qb.0.0", 1)
+    w.append(0, 0, b"qb-page")
+    w.finish([1])
+    assert obj.query_dirs() == ["qa", "qb"]
+    used = obj.usage()["bytes"]
+    freed = obj.release_query("qa")
+    assert freed > 0
+    assert obj.query_dirs() == ["qb"]
+    assert obj.usage()["bytes"] == used - freed
+    assert obj.release_query("qa") == 0          # idempotent
+    obj.release_query("qb")
+    assert obj.query_dirs() == []
+    assert obj.usage()["bytes"] == 0             # zero orphans
+
+
+def test_object_abandon_respects_shared_blob_refcounts(obj):
+    """Two attempts of one query share a dedup'd blob: abandoning one
+    keeps the blob for the survivor; abandoning both deletes it."""
+    shared = b"shared-payload" * 8
+    w1 = obj.writer("q1", "q1.0.0", 1)
+    w1.append(0, 0, shared)
+    w2 = obj.writer("q1", "q1.0.1", 1)
+    w2.append(0, 0, shared)
+    import hashlib
+    blob = obj._blob_path(
+        "q1", hashlib.sha256(shared).hexdigest()[:32])
+    w1.abandon()
+    assert os.path.exists(blob)                  # w2 still references
+    pages, _ = obj.read_pages("q1", "q1.0.1", 0, 0)
+    assert pages == [shared]
+    w2.abandon()
+    assert not os.path.exists(blob)
+    assert obj.usage()["bytes"] == 0
+
+
+def test_object_max_bytes_refuses_puts(tmp_path):
+    from presto_tpu.exec.spool import ObjectSpoolStore
+    small = ObjectSpoolStore(directory=str(tmp_path / "b"),
+                             max_bytes=64)
+    w = small.writer("q1", "q1.0.0", 1)
+    with pytest.raises(SpoolFullError):
+        w.append(0, 0, b"x" * 128)
+    small.release_query("q1")
+    w = small.writer("q2", "q2.0.0", 1)
+    w.append(0, 0, b"x" * 32)                    # freed space reusable
+
+
+def test_object_failpoints_cover_both_directions(obj):
+    from presto_tpu.exec.failpoints import FailpointError
+    FAILPOINTS.configure("spool.object_put", action="error", times=1,
+                         message="chaos: object put")
+    w = obj.writer("q1", "q1.0.0", 1)
+    with pytest.raises(FailpointError, match="object put"):
+        w.append(0, 0, b"page")
+    FAILPOINTS.clear()
+    w.append(0, 0, b"page")
+    w.finish([1])
+    FAILPOINTS.configure("spool.object_get", action="error", times=1,
+                         message="chaos: object get")
+    with pytest.raises(FailpointError, match="object get"):
+        obj.read_pages("q1", "q1.0.0", 0, 0)
+
+
+def test_object_latency_bandwidth_model(tmp_path):
+    """The modeled round trip really costs wall time (latency +
+    size/bandwidth) and lands in the RTT histogram."""
+    from presto_tpu.exec.spool import ObjectSpoolStore
+    st = ObjectSpoolStore(directory=str(tmp_path / "b"),
+                          get_latency_s=0.05,
+                          bandwidth_bytes_per_s=1e6)
+    w = st.writer("q1", "q1.0.0", 1)
+    w.append(0, 0, b"x" * 100_000)
+    w.finish([1])
+    st._manifests.clear()                 # force the wire path
+    t0 = time.monotonic()
+    pages, _ = st.read_pages("q1", "q1.0.0", 0, 0)
+    dt = time.monotonic() - t0
+    assert pages == [b"x" * 100_000]
+    # one manifest get + one 100kB blob get: >= 2x latency + 0.1s
+    assert dt >= 0.15, f"modeled RTT not paid ({dt:.3f}s)"
+
+
+def test_facade_backend_switch_and_config(tmp_path):
+    from presto_tpu.exec.spool import SwitchableSpoolStore
+    sw = SwitchableSpoolStore()
+    sw.configure(directory=str(tmp_path / "local"),
+                 object_dir=str(tmp_path / "bucket"),
+                 backend="object", object_put_latency_s=0.0,
+                 object_get_latency_s=0.0, object_bandwidth_mbps=0.0)
+    assert sw.backend == "object"
+    w = sw.writer("q1", "q1.0.0", 1)
+    w.append(0, 0, b"page")
+    w.finish([1])
+    assert sw.finished_tokens("q1", "q1.0.0") == [1]
+    assert (tmp_path / "bucket" / "q1").is_dir()
+    with pytest.raises(ValueError, match="local or object"):
+        sw.configure(backend="s3")
+    sw.configure(backend="local")
+    assert sw.backend == "local"
+
+
+# -- speculative reads: replay vs live, both outcomes -------------------------
+
+def _committed_page_store(store, qid, tid):
+    import numpy as np
+    from presto_tpu import types as T
+    from presto_tpu.batch import Batch, Schema
+    from presto_tpu.exec.pages import serialize_page
+    schema = Schema([("x", T.BIGINT)])
+    batch = Batch.from_arrays(schema, [np.arange(4, dtype=np.int64)],
+                              [np.ones(4, dtype=bool)], [None],
+                              num_rows=4)
+    page = serialize_page(batch)
+    w = store.writer(qid, tid, 1)
+    w.append(0, 0, page)
+    w.finish([1])
+    return page
+
+
+def test_speculative_replay_wins_when_live_stays_dead(tmp_path,
+                                                      monkeypatch):
+    """Producer truly gone (port refuses, the spec_live failpoint
+    keeps the resumed pull dead): the object-store replay wins the
+    race and the consumer gets every row."""
+    import presto_tpu.exec.spool as spool_mod
+    from presto_tpu.exec.spool import ObjectSpoolStore
+    from presto_tpu.server.worker import ExchangeClient
+    store = ObjectSpoolStore(directory=str(tmp_path / "bucket"))
+    monkeypatch.setattr(spool_mod, "SPOOL", store)
+    _committed_page_store(store, "qs", "qs.0.0")
+    FAILPOINTS.configure("exchange.spec_live", action="error",
+                         message="chaos: live pull down")
+    reads0 = _counter("exchange_speculative_read_total")
+    won0 = _counter("exchange_speculative_replay_won_total")
+    client = ExchangeClient(["http://127.0.0.1:1/v1/task/qs.0.0"], 0,
+                            fail_fast_s=5.0)
+    got = [b.to_pylist() for b in client.batches()]
+    assert got == [[(0,), (1,), (2,), (3,)]]
+    assert _counter("exchange_speculative_read_total") == reads0 + 1
+    assert _counter("exchange_speculative_replay_won_total") == won0 + 1
+
+
+def test_speculative_live_wins_when_replay_is_slow(tmp_path,
+                                                   monkeypatch):
+    """Producer merely restarting: the live pull completes while the
+    object-store replay is still paying its modeled round trips — the
+    live arm wins and the replay is cancelled."""
+    import http.server
+    import presto_tpu.exec.spool as spool_mod
+    from presto_tpu.exec.spool import ObjectSpoolStore
+    from presto_tpu.server.worker import ExchangeClient, frame_pages
+    store = ObjectSpoolStore(directory=str(tmp_path / "bucket"))
+    monkeypatch.setattr(spool_mod, "SPOOL", store)
+    page = _committed_page_store(store, "ql", "ql.0.0")
+
+    class Upstream(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):              # noqa: N802 (stdlib casing)
+            body = frame_pages([page])
+            self.send_response(200)
+            self.send_header("X-Buffer-Complete", "true")
+            self.send_header("X-Next-Token", "1")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Upstream)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = (f"http://127.0.0.1:{httpd.server_address[1]}"
+               "/v1/task/ql.0.0")
+        FAILPOINTS.configure("exchange.spec_replay", action="sleep",
+                             sleep_s=1.5)
+        won0 = _counter("exchange_speculative_live_won_total")
+        client = ExchangeClient([url], 0, fail_fast_s=5.0)
+        assert client._race_spool(url, "ql.0.0", 0) is True
+        assert _counter("exchange_speculative_live_won_total") \
+            == won0 + 1
+        assert client.queue.get_nowait() == page
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_speculative_disabled_session_property_drains_serially(
+        tmp_path, monkeypatch):
+    """``speculative_spool_reads=false`` falls back to the plain
+    serial spool drain — no race, no speculative counters."""
+    import presto_tpu.exec.spool as spool_mod
+    from presto_tpu.exec.spool import ObjectSpoolStore
+    from presto_tpu.server.worker import ExchangeClient
+    store = ObjectSpoolStore(directory=str(tmp_path / "bucket"))
+    monkeypatch.setattr(spool_mod, "SPOOL", store)
+    page = _committed_page_store(store, "qn", "qn.0.0")
+    reads0 = _counter("exchange_speculative_read_total")
+    client = ExchangeClient(["http://127.0.0.1:1/v1/task/qn.0.0"], 0,
+                            fail_fast_s=5.0, speculative=False)
+    assert client._race_spool("http://127.0.0.1:1/v1/task/qn.0.0",
+                              "qn.0.0", 0) is True
+    assert _counter("exchange_speculative_read_total") == reads0
+    assert client.queue.get_nowait() == page

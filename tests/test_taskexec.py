@@ -93,3 +93,99 @@ def test_lock_discipline_clean_after_scheduler_exercise():
     from presto_tpu._devtools import lockcheck
     assert lockcheck.ENABLED
     assert lockcheck.GRAPH.check() == [], lockcheck.GRAPH.check()
+
+
+def test_stalled_releases_device_then_restores_bookkeeping():
+    """Regression for the cross-worker exchange deadlock: a consumer
+    blocked on remote pages inside its quantum must RELEASE the device
+    (another query's quantum runs meanwhile), then re-acquire on exit
+    with the nesting depth exactly restored — an unbalanced depth
+    either wedges the scheduler or lets two quanta run at once."""
+    from presto_tpu.obs.metrics import REGISTRY
+    s = DeviceScheduler()
+    a = s.task("stall-a")
+    b = s.task("other-b")
+    stalled_now = threading.Event()
+    release = threading.Event()
+    order = []
+
+    def a_quantum():
+        order.append("a-enter")
+        with s.stalled(a):
+            stalled_now.set()
+            assert release.wait(timeout=5)
+        order.append("a-resume")
+
+    before = REGISTRY.counter("device_stall_release_total").value
+    t = threading.Thread(
+        target=lambda: s.run_quantum(a, a_quantum), daemon=True)
+    t.start()
+    assert stalled_now.wait(timeout=5)
+    # the device is free while A waits on input: B's quantum runs NOW
+    s.run_quantum(b, lambda: order.append("b-ran"))
+    release.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert order == ["a-enter", "b-ran", "a-resume"]
+    assert REGISTRY.counter(
+        "device_stall_release_total").value == before + 1
+    # bookkeeping balanced: scheduler idle, depth zero
+    assert s._running is None
+    assert s._running_depth == 0
+
+
+def test_stalled_inside_nested_quantum_keeps_reentrancy():
+    """stalled() gives back ONE nesting level. Inside a re-entrant
+    (nested same-handle) quantum the outer level still holds the
+    device, and the exit path must rebuild depth to exactly 2 before
+    unwinding — off-by-one here frees the device while the outer
+    quantum is mid-flight."""
+    s = DeviceScheduler()
+    a = s.task("nested")
+
+    def inner():
+        with s.stalled(a):
+            # one level released, the outer one still held
+            assert s._running is a
+            assert s._running_depth == 1
+        assert s._running_depth == 2
+
+    def outer():
+        s.run_quantum(a, inner)
+
+    s.run_quantum(a, outer)
+    assert s._running is None
+    assert s._running_depth == 0
+
+
+def test_stalled_without_held_quantum_is_a_noop():
+    """Outside any quantum (fair_scheduling off, init paths) stalled()
+    must not touch scheduler state or the release counter."""
+    from presto_tpu.obs.metrics import REGISTRY
+    s = DeviceScheduler()
+    a = s.task("free")
+    before = REGISTRY.counter("device_stall_release_total").value
+    with s.stalled(a):
+        pass
+    with s.stalled(None):
+        pass
+    assert REGISTRY.counter(
+        "device_stall_release_total").value == before
+    assert s._running is None and s._running_depth == 0
+
+
+def test_device_floor_pad_models_fixed_throughput(monkeypatch):
+    """The modeled device-service floor pads a kernel chain up to the
+    floor and never double-bills work that already took longer."""
+    import presto_tpu.exec.taskexec as tx
+    monkeypatch.setattr(tx, "_SERVICE_FLOOR_S", 0.05)
+    t0 = time.perf_counter()
+    tx.device_floor_pad(0.0)
+    assert time.perf_counter() - t0 >= 0.045
+    t0 = time.perf_counter()
+    tx.device_floor_pad(10.0)         # chain already past the floor
+    assert time.perf_counter() - t0 < 0.02
+    monkeypatch.setattr(tx, "_SERVICE_FLOOR_S", 0.0)
+    t0 = time.perf_counter()
+    tx.device_floor_pad(0.0)          # disabled: free
+    assert time.perf_counter() - t0 < 0.02

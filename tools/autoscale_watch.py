@@ -1,32 +1,13 @@
 #!/usr/bin/env python
-"""Reference consumer of the autoscaler signals feed (obs/signals.py).
+"""Reference consumer of the autoscaler signals feed — thin shim.
 
-``cluster_signals()`` is a frozen, read-only snapshot — it decides
-nothing. This tool is the demo policy that proves the feed carries
-enough to act on: a pure function :func:`decide` maps one
-``ClusterSignals`` snapshot to a list of recommendations
-(``scale_up`` / ``scale_down`` / ``replace_node`` / ``grow_cache``),
-each with the signal values that justified it. A real autoscaler
-would swap the thresholds and actually provision; the contract — what
-fields exist and what they mean — is exactly what this file consumes,
-and tests/test_slo.py drives it in-suite so a feed change that breaks
-a consumer fails tier-1.
-
-Policy (deliberately boring thresholds, all keyword-overridable):
-
-- ``scale_up`` a group when its queue backs up past
-  ``queue_ratio`` x the hard concurrency limit, or when its SLO alert
-  has escalated to PAGE (burning budget 10x+ over plan: more
-  replicas, not more patience);
-- ``scale_down`` a group only when it is quiet (no queue, running
-  below ``idle_ratio`` of the limit), its alert is OK, and its error
-  budget is healthy — a WARN holds scale-down, shrinking a burning
-  group digs the hole deeper;
-- ``replace_node`` when a node's heartbeat is older than
-  ``stale_heartbeat_s`` (the registry's own liveness signal);
-- ``grow_cache`` when any serving cache's fill fraction exceeds
-  ``cache_pressure`` — cache evictions surface as latency burn one
-  window later, so pressure is the leading indicator.
+The policy used to live here; it is now the ONE rule registry in
+``presto_tpu/exec/autoscale.py`` (:data:`RULES` / :func:`decide`),
+shared verbatim with the real :class:`AutoscaleController` so the
+reference watcher and the controller cannot drift
+(tests/test_autoscale.py pins the parity: ``watch.decide is
+autoscale.decide``). This tool keeps its CLI: print what the rules
+recommend for one snapshot, decide nothing, provision nothing.
 
 Usage:
     python tools/autoscale_watch.py          # snapshot this process
@@ -40,117 +21,14 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, List
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-from presto_tpu.obs.signals import (  # noqa: E402
-    CacheSignals, ClusterSignals, GroupSignals, NodeSignals,
-    cluster_signals)
-
-
-def decide(signals: ClusterSignals, *,
-           queue_ratio: float = 2.0,
-           idle_ratio: float = 0.25,
-           stale_heartbeat_s: float = 30.0,
-           cache_pressure: float = 0.9,
-           min_budget: float = 0.5) -> List[Dict]:
-    """Map one frozen snapshot to scaling recommendations.
-
-    Pure and deterministic: same snapshot, same decisions. Each entry
-    is ``{"action", "target", "reason", "signals": {...}}`` with the
-    raw values the rule fired on, so the operator (or a test) can
-    audit the decision against the feed."""
-    out: List[Dict] = []
-    for g in signals.groups:
-        limit = max(1, g.hard_concurrency_limit)
-        if g.queued >= queue_ratio * limit or g.alert_state == "PAGE":
-            why = (f"alert {g.alert_state}" if g.alert_state == "PAGE"
-                   else f"queue {g.queued} >= {queue_ratio:g}x "
-                        f"limit {limit}")
-            out.append({"action": "scale_up", "target": g.group,
-                        "reason": why,
-                        "signals": {"queued": g.queued,
-                                    "running": g.running,
-                                    "limit": limit,
-                                    "alert_state": g.alert_state,
-                                    "burn_short": g.burn_short,
-                                    "p95_s": g.p95_s}})
-        elif (g.queued == 0 and g.running < idle_ratio * limit
-              and g.alert_state == "OK"
-              and (g.error_budget_remaining is None
-                   or g.error_budget_remaining >= min_budget)):
-            out.append({"action": "scale_down", "target": g.group,
-                        "reason": f"idle: running {g.running} < "
-                                  f"{idle_ratio:g}x limit {limit}, "
-                                  "no queue, alert OK",
-                        "signals": {"running": g.running,
-                                    "limit": limit,
-                                    "budget":
-                                        g.error_budget_remaining}})
-    for n in signals.nodes:
-        if n.heartbeat_age_s > stale_heartbeat_s:
-            out.append({"action": "replace_node", "target": n.node_id,
-                        "reason": f"heartbeat {n.heartbeat_age_s:.1f}s"
-                                  f" > {stale_heartbeat_s:g}s stale "
-                                  "threshold",
-                        "signals": {"state": n.state,
-                                    "heartbeat_age_s":
-                                        n.heartbeat_age_s}})
-    caches = signals.caches
-    for name, pressure in (("scan", caches.scan_cache_pressure),
-                           ("plan", caches.plan_cache_pressure),
-                           ("result", caches.result_cache_pressure)):
-        if pressure > cache_pressure:
-            out.append({"action": "grow_cache",
-                        "target": f"{name}_cache",
-                        "reason": f"fill {pressure:.0%} > "
-                                  f"{cache_pressure:.0%} pressure "
-                                  "threshold",
-                        "signals": {"pressure": round(pressure, 4)}})
-    return out
-
-
-def demo_signals() -> ClusterSignals:
-    """A synthetic busy cluster exercising every rule: one backed-up
-    group, one paging group, one idle group, one stale node, one hot
-    cache."""
-    return ClusterSignals(
-        ts=0.0,
-        groups=(
-            GroupSignals(group="serving.dash", state="FULL",
-                         running=8, queued=20,
-                         hard_concurrency_limit=8,
-                         p95_s=0.45, burn_short=1.2, burn_long=0.8,
-                         error_budget_remaining=0.6,
-                         alert_state="OK"),
-            GroupSignals(group="serving.adhoc", state="CAN_RUN",
-                         running=3, queued=1,
-                         hard_concurrency_limit=8,
-                         p95_s=2.1, burn_short=14.0, burn_long=11.0,
-                         error_budget_remaining=0.0,
-                         alert_state="PAGE"),
-            GroupSignals(group="batch", state="CAN_RUN",
-                         running=0, queued=0,
-                         hard_concurrency_limit=16,
-                         error_budget_remaining=1.0,
-                         alert_state="OK"),
-        ),
-        nodes=(
-            NodeSignals(node_id="w0", state="active",
-                        heartbeat_age_s=1.5, active_tasks=4),
-            NodeSignals(node_id="w1", state="active",
-                        heartbeat_age_s=95.0, active_tasks=0),
-        ),
-        caches=CacheSignals(scan_cache_resident_bytes=950,
-                            scan_cache_limit_bytes=1000,
-                            plan_cache_entries=10,
-                            plan_cache_capacity=64,
-                            result_cache_resident_bytes=100,
-                            result_cache_limit_bytes=1000),
-    )
+from presto_tpu.exec.autoscale import (  # noqa: E402,F401
+    RULES, decide, demo_signals)
+from presto_tpu.obs.signals import cluster_signals  # noqa: E402
 
 
 def main(argv=None) -> int:

@@ -68,6 +68,15 @@ QUERY = ("select l_returnflag, l_linestatus, count(*) c, "
          "where l_shipdate <= date '1998-09-02' "
          "group by 1, 2 order by 1, 2")
 
+# the load-ramp bench's query: a selective SCAN, not an aggregate. Its
+# device cost is the batches scanned (input-proportional — that's what
+# shrinks per worker as the pool grows), while its tiny result keeps
+# exchange/sort/client cost flat. An aggregate collapses each task to
+# ~one output page, so modeled per-worker cost would never scale.
+RAMP_QUERY = ("select l_orderkey, l_linenumber, l_extendedprice "
+              "from lineitem where l_extendedprice > 90000 "
+              "order by 1, 2")
+
 
 def _metric_sql(runner, name: str) -> float:
     res = runner.local.execute(
@@ -175,11 +184,25 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
         finish = scenario("straggler")
         before = _metric_sql(runner, "speculative_won_total")
         # partition 0 of the source stage sleeps far past the stage
-        # median; attempt suffixes keep the duplicate out of the rule
+        # median; attempt suffixes keep the duplicate out of the rule.
+        # The sleep must also outlast a COLD duplicate: on a loaded
+        # 1-core host the speculative attempt may land on a worker
+        # that never compiled this fragment (~9s JIT) — 15s let the
+        # original occasionally wake first and steal the win
         FAILPOINTS.configure("worker.task_run", action="sleep",
-                             sleep_s=15.0, match=r"\.0\.0@", times=1)
+                             sleep_s=30.0, match=r"\.0\.0@", times=1)
+        # ... and the SIBLING source tasks must clear the monitor's
+        # straggler median floor (min_elapsed_ms): with the scan cache
+        # primed by the earlier scenarios they finish in a few ms, the
+        # stage median lands under the floor, and the straggler is
+        # never flagged — the exact warm-cluster shape that made this
+        # scenario order-dependent inside the full test suite
+        FAILPOINTS.configure("worker.task_run", action="sleep",
+                             sleep_s=0.1, match=r"\.0\.[1-9]\d*@",
+                             times=None)
         _assert_rows_equal(runner.execute(query).rows, want,
                            "straggler")
+        FAILPOINTS.clear()      # the sibling pad rule is unbounded
         won = _metric_sql(runner, "speculative_won_total") - before
         assert won >= 1, "straggler did not produce a speculative win"
         finish(speculative_won=won)
@@ -463,6 +486,177 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
             not in runner._schedulable_workers()
         finish(task_retries=retries, spool_fallbacks=fallbacks)
 
+        # -- (j) preemption storm: workers are preemptible BY DESIGN ---
+        # Poisson-cadence preemptions (seeded — replayable) under
+        # sustained query load: every preemption is a drain notice
+        # (begin_shutdown → active tasks commit their spool → process
+        # exit), a replacement joins, and ZERO queries fail. The first
+        # preemption is deterministic (the drain_exit recipe) so at
+        # least one coordinator-side spool replay is guaranteed
+        # regardless of storm timing.
+        import random as _random
+        finish = scenario("preemption_storm")
+        # drain_exit left the pool at two live workers; the storm
+        # needs three so its >=2-live preemption guard has headroom
+        # after the deterministic first drain
+        while len(live_workers()) < 3:
+            add_worker()
+        before_replay = _metric_sql(runner, "spool_replayed_task_total")
+        before_fb = _metric_sql(runner,
+                                "exchange_spool_fallback_total")
+        victim6 = pick_victim()
+        preempted = threading.Event()
+        pre_lock = threading.Lock()
+
+        def preempt_after_finish(key="", **ctx):
+            with pre_lock:
+                if not preempted.is_set():
+                    wait_stage_finished(victim6, feed_fid)
+                    victim6.begin_shutdown()
+                    preempted.set()
+
+        FAILPOINTS.configure(
+            "exchange.pull", action="callback",
+            callback=preempt_after_finish, times=None,
+            match=rf":{victim6.port}/v1/task/[^/]*\.{feed_fid}\.\d+$")
+        FAILPOINTS.configure(
+            "exchange.pull", action="sleep", sleep_s=1.0, times=None,
+            match=rf":{victim6.port}/v1/task/[^/]*\.{feed_fid}\.\d+$")
+        _assert_rows_equal(runner.execute(query).rows, want,
+                           "preemption_storm")
+        FAILPOINTS.clear()
+        preemptions = [1]
+        storm_stop = threading.Event()
+        rng = _random.Random(0xE1A57)
+
+        def storm() -> None:
+            # expovariate inter-arrivals = Poisson preemption process;
+            # never preempt below two live workers (a real preemptible
+            # pool has a floor too — the autoscaler's min_workers)
+            while not storm_stop.wait(rng.expovariate(1 / 0.5)):
+                lw = live_workers()
+                if len(lw) < 2:
+                    continue
+                v = max(lw, key=lambda w: f"http://127.0.0.1:{w.port}")
+                v.begin_shutdown()
+                preemptions[0] += 1
+                add_worker()
+
+        st = threading.Thread(target=storm, daemon=True)
+        st.start()
+        storm_queries = 1
+        storm_deadline = time.time() + 60.0
+        try:
+            # preemption-bounded, not query-bounded: on a fully warm
+            # cluster a fixed query budget can drain before 3 Poisson
+            # arrivals land — keep the load going until the storm has
+            # actually stormed (the wall-clock cap guards a wedged
+            # storm thread, ~1.5s expected at the 0.5s mean cadence)
+            while (storm_queries < 5 or preemptions[0] < 3) \
+                    and time.time() < storm_deadline:
+                _assert_rows_equal(runner.execute(query).rows, want,
+                                   "preemption_storm")
+                storm_queries += 1
+        finally:
+            storm_stop.set()
+            st.join(timeout=5)
+        while len(live_workers()) < 3:
+            add_worker()
+        replays = _metric_sql(
+            runner, "spool_replayed_task_total") - before_replay
+        fallbacks = _metric_sql(
+            runner, "exchange_spool_fallback_total") - before_fb
+        assert preemptions[0] >= 3, \
+            f"storm landed only {preemptions[0]} preemptions"
+        assert replays >= 1, \
+            "no preempted worker's output was replayed from the spool"
+        finish(queries=storm_queries, preemptions=preemptions[0],
+               spool_replays=replays, spool_fallbacks=fallbacks)
+
+        # -- (k) scale to zero: the worker set vanishes ENTIRELY -------
+        # mid-shuffle with the spool on the OBJECT-STORE backend
+        # (latency-modeled GCS/S3 stand-in): every worker is killed
+        # after the source stage committed, two FRESH workers join,
+        # and the query completes row-exact — shuffle state outlived
+        # the entire worker set because it lives in the object store,
+        # not on any worker's disk
+        import shutil as _shutil
+        import tempfile as _tempfile
+        finish = scenario("scale_to_zero")
+        obj_dir = _tempfile.mkdtemp(prefix="chaos-objspool-")
+        SPOOL.configure(backend="object", object_dir=obj_dir,
+                        object_put_latency_s=0.002,
+                        object_get_latency_s=0.002)
+        try:
+            before = _metric_sql(runner, "task_retry_total")
+            before_replay = _metric_sql(runner,
+                                        "spool_replayed_task_total")
+            before_put = _metric_sql(runner, "spool_object_put_total")
+            before_get = _metric_sql(runner, "spool_object_get_total")
+            wiped = threading.Event()
+            wipe_lock = threading.Lock()
+
+            def wipe(key="", **ctx):
+                with wipe_lock:
+                    if wiped.is_set():
+                        return
+                    lw = live_workers()
+                    deadline = time.time() + 30.0
+                    while time.time() < deadline:
+                        src = [t for w in lw
+                               for t in list(w.tasks.values())
+                               if t.task_id.split(".")[1]
+                               == str(source_fid)]
+                        if src and all(t.state == "FINISHED"
+                                       for t in src):
+                            break
+                        time.sleep(0.05)
+                    else:
+                        raise AssertionError(
+                            "source stage never committed before "
+                            "the wipe")
+                    for w in lw:
+                        kill_worker(w)
+                    add_worker()
+                    add_worker()
+                    wiped.set()
+
+            FAILPOINTS.configure(
+                "exchange.pull", action="callback", callback=wipe,
+                times=None,
+                match=rf"/v1/task/[^/]*\.{source_fid}\.\d+$")
+            _assert_rows_equal(runner.execute(query).rows, want,
+                               "scale_to_zero")
+            FAILPOINTS.clear()
+            assert wiped.is_set(), \
+                "the wipe callback never fired"
+            replays = _metric_sql(
+                runner, "spool_replayed_task_total") - before_replay
+            retries = _metric_sql(runner, "task_retry_total") - before
+            puts = _metric_sql(
+                runner, "spool_object_put_total") - before_put
+            gets = _metric_sql(
+                runner, "spool_object_get_total") - before_get
+            assert replays >= 1, \
+                "no source task was preserved across the wipe"
+            assert retries >= 1, \
+                "no downstream task was re-created on fresh workers"
+            assert puts >= 1 and gets >= 1, \
+                f"object-store spool never moved (puts={puts}, " \
+                f"gets={gets})"
+            # per-query GC held across the wipe: zero orphaned objects
+            obj_orphans = SPOOL.object_store.query_dirs()
+            assert not obj_orphans, \
+                f"orphaned object-spool queries: {obj_orphans}"
+            finish(spool_replays=replays, task_retries=retries,
+                   object_puts=puts, object_gets=gets)
+        finally:
+            FAILPOINTS.clear()
+            SPOOL.configure(backend="local")
+            _shutil.rmtree(obj_dir, ignore_errors=True)
+        while len(live_workers()) < 3:
+            add_worker()
+
         # the retry count is part of the query history record
         res = runner.local.execute(
             "select retries from system.runtime.completed_queries "
@@ -494,7 +688,8 @@ def run_chaos(sf: float = 0.01, query: str = QUERY,
         # --kind elastic (all *_ms => lower is better)
         elastic_scenarios = ("worker_death", "spool_replay",
                              "spool_corrupt", "worker_join",
-                             "drain_exit")
+                             "drain_exit", "preemption_storm",
+                             "scale_to_zero")
         summary["elastic"] = {
             "metric": "elastic_recovery_ms",
             "value": round(sum(
@@ -608,21 +803,35 @@ def run_fleet_chaos(sf: float = 0.01, coordinators: int = 3,
         errors: list = []
         fleet_clients = []
 
-        def killer() -> None:
-            while not killed.is_set():
-                with count_lock:
-                    n = done[0]
-                if n >= kill_after:
-                    killed.set()
-                    log(f"killing {victim_id} after {n} statements")
-                    servers[victim_idx].kill()
-                    return
-                time.sleep(0.01)
+        kill_gate = threading.Lock()
+
+        def ensure_killed() -> None:
+            # inline, checked by every client BEFORE each dispatch:
+            # once the statement count passes the threshold, the kill
+            # happens-before every remaining dispatch — and the ring
+            # rotation guarantees at least one of those dispatches
+            # lands on the victim's slot, so a failover is observed in
+            # EVERY interleaving. (A polling killer thread can lose
+            # the race outright on a loaded host: a handful of warm
+            # statements finish inside its sleep quantum and the kill
+            # arrives after the last query.)
+            if killed.is_set():
+                return
+            with count_lock:
+                due = done[0] >= kill_after
+            if due:
+                with kill_gate:
+                    if not killed.is_set():
+                        log(f"killing {victim_id} after {done[0]} "
+                            f"statements")
+                        servers[victim_idx].kill()
+                        killed.set()
 
         def client_run(ci: int) -> None:
             fc = FleetClient(urls, user="fleet-chaos")
             fleet_clients.append(fc)
             for _ in range(per_client):
+                ensure_killed()
                 try:
                     res = fc.execute(QUERY)
                     _assert_rows_equal(res.rows, want,
@@ -632,17 +841,28 @@ def run_fleet_chaos(sf: float = 0.01, coordinators: int = 3,
                 with count_lock:
                     done[0] += 1
 
-        kt = threading.Thread(target=killer, daemon=True)
-        kt.start()
         threads = [threading.Thread(target=client_run, args=(ci,))
                    for ci in range(clients)]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        killed.set()
-        kt.join(timeout=5)
+        assert killed.is_set(), "the kill threshold was never reached"
         assert not errors, f"queries failed across the kill: {errors}"
+
+        # deterministic failover probe: one more statement whose ring
+        # STARTS at the corpse. The concurrent phase proves zero
+        # failed queries, but its clients may all have drawn their
+        # victim-slot visit BEFORE the kill (the rotation is staggered
+        # per client, not per statement outcome) — this probe pins the
+        # re-dispatch-around-a-dead-coordinator path in every run.
+        probe = FleetClient(urls, user="fleet-chaos")
+        probe._rr = victim_idx
+        fleet_clients.append(probe)
+        _assert_rows_equal(probe.execute(QUERY).rows, want,
+                           "failover_probe")
+        probe.close()
+        total += 1
 
         # survivors absorb the loss: the dead coordinator ages out of
         # the federated admission view after the staleness grace and
@@ -694,6 +914,198 @@ def run_fleet_chaos(sf: float = 0.01, coordinators: int = 3,
             pass
 
 
+def run_elastic_ramp(sf: float = 0.02, phases=(1, 3, 1),
+                     phase_s: float = 8.0, clients: int = 4,
+                     device_floor_ms: float = 60.0,
+                     rows_per_batch: int = 16384,
+                     verbose: bool = False) -> dict:
+    """Load-ramp bench (ISSUE 20): sustained client load while the
+    worker pool scales 1 -> N -> 1 through the autoscaler's node
+    plane.
+
+    Workers are REAL subprocesses (``LocalProcessProvider`` — the same
+    provider the config-driven autoscaler boots), announcing to an
+    in-process coordinator over HTTP and sharing one spool directory;
+    scale-down is always the drain path (SHUTTING_DOWN -> spool commit
+    -> explicit deregister -> process exit), never a kill. The pinned
+    claims, gated by ``check_bench_regression --kind elastic``:
+
+    - throughput TRACKS the ramp: peak-N QPS >= 1.5x the 1-worker
+      floor (elasticity that doesn't move throughput is a no-op);
+    - ZERO failed queries across every transition, drains included;
+    - the pool really returns to 1 (the scale-down is exercised under
+      load, not just the scale-up).
+
+    ``device_floor_ms`` sets ``PRESTO_TPU_DEVICE_FLOOR_MS`` on the
+    WORKER processes: a fixed-throughput device model (each quantum —
+    and each SCANNED batch, ``taskexec.device_floor_pad`` — holds the
+    device at least that long), making per-worker capacity the
+    bottleneck. CI hosts offer a single core to the whole
+    multi-process cluster, so real compute cannot overlap across
+    workers there — the modeled floor is what makes "QPS tracks the
+    worker count" a property of the SYSTEM under test (scheduling,
+    drains, exchange) instead of the host's core count.
+    ``rows_per_batch`` is lowered so a query scans many batches and
+    the modeled work can actually spread across the pool; the query is
+    ``RAMP_QUERY`` (a selective scan) for the same reason."""
+    import shutil
+    import tempfile
+
+    from presto_tpu.client import StatementClient
+    from presto_tpu.exec.autoscale import LocalProcessProvider
+    from presto_tpu.exec.cluster import ClusterRunner
+    from presto_tpu.exec.discovery import DiscoveryNodeManager
+    from presto_tpu.exec.spool import SPOOL
+    from presto_tpu.server.protocol import PrestoTpuServer
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(msg, file=sys.stderr, flush=True)
+
+    assert phases and phases[0] == 1 and phases[-1] == 1 \
+        and max(phases) > 1, \
+        "ramp must go 1 -> N -> 1 (the scale-DOWN is part of the claim)"
+
+    groups = {
+        "rootGroups": [
+            {"name": "ramp", "hardConcurrencyLimit": 8,
+             "maxQueued": 10000}],
+        "selectors": [{"group": "ramp"}]}
+
+    # one shared spool dir: drained workers' committed output must be
+    # replayable by the survivors (and probeable by the coordinator's
+    # preservation check) across process boundaries
+    spool_dir = tempfile.mkdtemp(prefix="ramp-spool-")
+    SPOOL.configure(directory=spool_dir)
+    discovery = DiscoveryNodeManager(ttl_s=3600.0)
+    runner = ClusterRunner(tpch_sf=sf, heartbeat=False,
+                           discovery=discovery,
+                           rows_per_batch=rows_per_batch)
+    srv = PrestoTpuServer(runner, resource_groups=groups,
+                          discovery=discovery)
+    srv.start()
+    url = f"http://127.0.0.1:{srv.port}"
+    provider = LocalProcessProvider(
+        [url], tpch_sf=sf, spool_dir=spool_dir,
+        extra_env={"PRESTO_TPU_DEVICE_FLOOR_MS":
+                   str(device_floor_ms)} if device_floor_ms else None)
+
+    stop_evt = threading.Event()
+    count_lock = threading.Lock()
+    completed = [0]
+    errors: list = []
+    warm = None
+
+    def set_workers(target: int, timeout_s: float = 120.0) -> None:
+        """Converge the pool to ``target`` — launches for scale-up,
+        the drain path for scale-down — then wait until the
+        coordinator's discovery view agrees (drained workers leave by
+        explicit GONE deregistration, so membership is prompt)."""
+        while len(provider.nodes()) < target:
+            h = provider.launch()
+            log(f"ramp: launched {h.node_id}")
+        while len(provider.nodes()) > target:
+            h = provider.nodes()[-1]
+            log(f"ramp: draining {h.node_id}")
+            assert provider.drain(h, timeout_s=timeout_s), \
+                f"worker {h.node_id} did not drain out"
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if len(discovery.active_urls()) == target:
+                return
+            time.sleep(0.05)
+        raise AssertionError(
+            f"discovery never converged to {target} workers: "
+            f"{discovery.nodes()}")
+
+    def client_run(ci: int) -> None:
+        sc = StatementClient(url, user="ramp")
+        try:
+            while not stop_evt.is_set():
+                try:
+                    res = sc.execute(RAMP_QUERY)
+                    _assert_rows_equal(res.rows, want, "ramp")
+                except Exception as e:          # noqa: BLE001
+                    if stop_evt.is_set():
+                        return
+                    errors.append(f"client {ci}: {e!r}")
+                    return
+                with count_lock:
+                    completed[0] += 1
+        finally:
+            sc.close()
+
+    threads: list = []
+    try:
+        # floor worker + fault-free reference rows before any load
+        set_workers(1)
+        warm = StatementClient(url, user="ramp")
+        want = warm.execute(RAMP_QUERY).rows
+        log(f"ramp: reference {len(want)} rows via 1 worker")
+
+        threads = [threading.Thread(target=client_run, args=(ci,),
+                                    daemon=True)
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+
+        phase_rows = []
+        for target in phases:
+            set_workers(target)        # transition happens UNDER load
+            # absorb cold compile on freshly launched workers BEFORE
+            # the measurement window opens: a new worker's first query
+            # JIT-compiles for ~seconds, which is provisioning latency,
+            # not steady-state throughput — the claim under test
+            for _ in range(2):
+                _assert_rows_equal(warm.execute(RAMP_QUERY).rows,
+                                   want, "ramp-warmup")
+            with count_lock:
+                c0, e0 = completed[0], len(errors)
+            t0 = time.perf_counter()
+            time.sleep(phase_s)
+            with count_lock:
+                c1, e1 = completed[0], len(errors)
+            w = time.perf_counter() - t0
+            phase_rows.append({
+                "workers": target,
+                "queries": c1 - c0,
+                "failed": e1 - e0,
+                "qps": round((c1 - c0) / w, 2),
+                "window_s": round(w, 2)})
+            log(f"ramp: phase {phase_rows[-1]}")
+
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, f"queries failed across the ramp: {errors}"
+
+        floor = phase_rows[0]["qps"]
+        peak = max(r["qps"] for r in phase_rows
+                   if r["workers"] == max(phases))
+        ratio = round(peak / floor, 3) if floor > 0 else 0.0
+        ramp = {"sf": sf, "clients": clients,
+                "device_floor_ms": device_floor_ms,
+                "phases": phase_rows, "peak_over_floor": ratio}
+        assert ratio >= 1.5, \
+            (f"peak QPS {peak} is only {ratio}x the 1-worker floor "
+             f"{floor} (need >= 1.5x): {phase_rows}")
+        return ramp
+    finally:
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=10)
+        try:
+            warm.close()
+        except Exception:
+            pass
+        try:
+            srv.kill()
+        except Exception:
+            pass
+        provider.stop_all()
+        shutil.rmtree(spool_dir, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--sf", type=float, default=0.01,
@@ -702,6 +1114,10 @@ def main(argv=None) -> int:
                     help="run the coordinator-fleet death drill "
                          "instead of the worker chaos suite")
     ap.add_argument("-q", "--quiet", action="store_true")
+    ap.add_argument("--ramp", action="store_true",
+                    help="additionally run the 1 -> N -> 1 load-ramp "
+                         "bench (subprocess workers) and attach its "
+                         "block to the elastic summary")
     ap.add_argument("--elastic-out", default=os.environ.get(
         "ELASTIC_OUT"), metavar="PATH",
         help="write the elastic recovery-time summary (bench format) "
@@ -712,6 +1128,9 @@ def main(argv=None) -> int:
         print(json.dumps(summary, indent=2))
         return 0 if summary.get("ok") else 1
     summary = run_chaos(sf=args.sf, verbose=not args.quiet)
+    if args.ramp and summary.get("elastic"):
+        summary["elastic"]["ramp"] = run_elastic_ramp(
+            verbose=not args.quiet)
     print(json.dumps(summary, indent=2))
     if args.elastic_out and summary.get("elastic"):
         tmp = args.elastic_out + ".tmp"

@@ -51,6 +51,14 @@ a coordinator-kill drill with zero failed queries — through
 :func:`_fleet_gate`. Pins without a fleet block (r03 and older, or a
 single-coordinator rerun) pass that gate vacuously.
 
+Elastic rounds (r02 on, produced by ``tools/chaos_smoke.py --ramp
+--elastic-out``) carry a ``ramp`` block: the 1 -> N -> 1 load-ramp
+bench over real subprocess workers. ``--kind elastic`` validates it
+through :func:`_elastic_gate` — the ramp must really go 1 -> N -> 1,
+every phase must run with ZERO failed queries, and peak-N QPS must be
+>= 1.5x the 1-worker floor (elasticity that doesn't move throughput is
+a no-op). Pins without a ramp block (r01) pass vacuously.
+
 Usage:
     python tools/check_bench_regression.py --run bench_out.json
     python tools/check_bench_regression.py --run bench_out.json \
@@ -342,6 +350,67 @@ def _fleet_gate(flat: Dict[str, Dict]) -> Dict:
             "ok": not violations}
 
 
+def _elastic_gate(flat: Dict[str, Dict]) -> Dict:
+    """Invariant verdict for the ``ramp`` block an elastic summary
+    carries (ELASTIC_r02 on, ``tools/chaos_smoke.py --ramp``): the
+    worker pool must really ramp 1 -> N -> 1 (the scale-DOWN is part
+    of the claim), every phase window must complete with ZERO failed
+    queries, and peak-N QPS must be >= 1.5x the 1-worker floor —
+    elasticity that doesn't move throughput is a no-op. Pins without
+    a ramp block (r01) pass vacuously."""
+    violations: List[Dict] = []
+    blocks = 0
+    for metric in sorted(flat):
+        ramp = flat[metric].get("ramp")
+        if ramp is None:
+            continue
+        blocks += 1
+
+        def bad(kind: str, detail: str, _m=metric) -> None:
+            violations.append({"metric": _m, "kind": kind,
+                               "detail": detail})
+
+        if not isinstance(ramp, dict):
+            bad("schema", "ramp is not an object")
+            continue
+        phases = ramp.get("phases")
+        if not isinstance(phases, list) or len(phases) < 3:
+            bad("schema", "phases must be a list of >= 3 windows "
+                          "(1 -> N -> 1)")
+            continue
+        rows_ok = all(isinstance(p, dict) for p in phases)
+        if not rows_ok:
+            bad("schema", "every phase must be an object")
+            continue
+        workers = [p.get("workers") for p in phases]
+        if workers[0] != 1 or workers[-1] != 1:
+            bad("shape", f"ramp must start and end at 1 worker, got "
+                         f"{workers} — the scale-down is part of the "
+                         "claim")
+        if not any(isinstance(w, int) and w > 1 for w in workers):
+            bad("shape", f"ramp never scaled above 1 worker: {workers}")
+        failed = [p.get("failed") for p in phases]
+        if any(f != 0 for f in failed):
+            bad("failures", f"phases reported failed queries {failed} "
+                            "(every window must be 0 — transitions "
+                            "included)")
+        for p in phases:
+            q = p.get("qps")
+            if not isinstance(q, (int, float)) or isinstance(q, bool) \
+                    or q <= 0:
+                bad("schema", f"phase {p.get('workers')!r} has "
+                              "non-positive qps")
+        ratio = ramp.get("peak_over_floor")
+        if not isinstance(ratio, (int, float)) or isinstance(ratio, bool):
+            bad("schema", "peak_over_floor missing")
+        elif ratio < 1.5:
+            bad("throughput", f"peak QPS is only {ratio}x the 1-worker "
+                              "floor (need >= 1.5x) — the pool grew "
+                              "but throughput didn't track it")
+    return {"blocks": blocks, "violations": violations,
+            "ok": not violations}
+
+
 def smoke(baseline_path: str) -> Dict:
     """Self-consistency: the pinned round must pass against itself,
     and a halved copy must fail. Proves discovery, parsing, tolerance
@@ -479,6 +548,20 @@ def main(argv=None) -> int:
                 {"metric": "*", "kind": "io", "detail": str(e)}]}
         verdict["fleet"] = fleet
         if not fleet["ok"]:
+            verdict["verdict"] = "fail"
+
+    if args.kind == "elastic":
+        # ramp gate (r02 on): smoke mode gates the pinned round (a bad
+        # re-pin cannot be committed), run mode the candidate; pins
+        # without a ramp block pass vacuously
+        target = baseline_path if args.smoke else args.run
+        try:
+            ramp = _elastic_gate(load_summary(target))
+        except (OSError, ValueError) as e:
+            ramp = {"blocks": 0, "ok": False, "violations": [
+                {"metric": "*", "kind": "io", "detail": str(e)}]}
+        verdict["ramp"] = ramp
+        if not ramp["ok"]:
             verdict["verdict"] = "fail"
 
     text = json.dumps(verdict, indent=2)

@@ -45,6 +45,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -197,10 +198,15 @@ class FleetHandle:
     scrape and chaos surface the bench and tests drive."""
 
     def __init__(self, coordinators: List[dict], workers: List[dict],
-                 sqlite_path: Optional[str]):
+                 sqlite_path: Optional[str],
+                 spawn_cfg: Optional[dict] = None):
         self.coordinators = coordinators   # {proc, url, node_id, port}
         self.workers = workers
         self.sqlite_path = sqlite_path
+        #: launch parameters, kept so the coordinator tier can scale
+        #: up after launch (autoscaler scale_coordinator decisions)
+        self.spawn_cfg = dict(spawn_cfg or {})
+        self._coord_seq = len(coordinators)
 
     @property
     def urls(self) -> List[str]:
@@ -234,6 +240,88 @@ class FleetHandle:
 
     def fleet_status(self, i: int) -> dict:
         return _get_json(self.coordinators[i]["url"] + "/v1/fleet")
+
+    def add_coordinator(self) -> dict:
+        """Scale the coordinator tier UP: spawn one more fleet member
+        peered with the current live coordinators. Its first heartbeat
+        teaches every incumbent its url (dynamic peering,
+        serving/fleet.fold_heartbeat), so the newcomer joins the
+        broadcast/federation fabric without restarting anyone."""
+        cfg = self.spawn_cfg
+        node_id = f"coord-{self._coord_seq}"
+        self._coord_seq += 1
+        (port,) = _free_ports(1)
+        argv = ["--serve-coordinator", "--port", str(port),
+                "--node-id", node_id,
+                "--peers", ",".join(self.live_urls()),
+                "--sf", str(cfg.get("sf", 0.01)),
+                "--heartbeat-s", str(cfg.get("heartbeat_s", 0.5))]
+        if self.sqlite_path:
+            argv += ["--sqlite", self.sqlite_path]
+        if cfg.get("staleness_grace_s"):
+            argv += ["--staleness-grace-s",
+                     str(cfg["staleness_grace_s"])]
+        if cfg.get("groups"):
+            argv += ["--groups-json", json.dumps(cfg["groups"])]
+        rec = {"proc": _spawn(argv), "node_id": node_id, "port": port,
+               "url": f"http://127.0.0.1:{port}"}
+        _await_ready(rec, cfg.get("ready_timeout_s", 300.0))
+        self.coordinators.append(rec)
+        return rec
+
+    def drain_coordinator(self, i: int, timeout_s: float = 60.0) -> bool:
+        """Scale the coordinator tier DOWN the polite way:
+        ``PUT /v1/info/state SHUTTING_DOWN`` — the member sends its
+        ``leaving`` farewell (peers drop its federated counts AND its
+        peer-list entry immediately: explicit deregister, not the
+        staleness grace), running queries page out, then the process
+        exits. Never a kill."""
+        rec = self.coordinators[i]
+        p = rec["proc"]
+        if p.poll() is not None:
+            return False
+        req = urllib.request.Request(
+            rec["url"] + "/v1/info/state", data=b'"SHUTTING_DOWN"',
+            method="PUT",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                r.read()
+        except OSError:
+            return False
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                _get_json(rec["url"] + "/v1/info", timeout=2)
+            except urllib.error.HTTPError:
+                pass
+            except OSError:
+                break                  # socket refused: drained
+            time.sleep(0.1)
+        if p.stdin:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        try:
+            p.wait(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            return False
+        return True
+
+    # -- coordinator_scaler duck (exec/autoscale.AutoscaleController) --------
+    def scale_up(self, reason: str = "") -> bool:
+        """Admission-bound: one more coordinator = one more set of
+        hard-concurrency slots over the same shared worker pool."""
+        self.add_coordinator()
+        return True
+
+    def scale_down(self, reason: str = "") -> bool:
+        live = [i for i, c in enumerate(self.coordinators)
+                if c["proc"].poll() is None]
+        if len(live) <= 2:             # a fleet needs >= 2 members
+            return False
+        return self.drain_coordinator(live[-1])
 
     def kill_coordinator(self, i: int) -> None:
         """SIGKILL — the real chaos primitive: no drain, no farewell
@@ -343,7 +431,12 @@ def launch_fleet(n_coordinators: int = 3, sf: float = 0.01,
             argv += ["--sqlite", sqlite_path]
         wrecs.append({"proc": _spawn(argv), "node_id": node_id,
                       "port": port, "url": f"http://127.0.0.1:{port}"})
-    handle = FleetHandle(coords, wrecs, sqlite_path)
+    handle = FleetHandle(
+        coords, wrecs, sqlite_path,
+        spawn_cfg={"sf": sf, "heartbeat_s": heartbeat_s,
+                   "staleness_grace_s": staleness_grace_s,
+                   "groups": groups,
+                   "ready_timeout_s": ready_timeout_s})
     try:
         for rec in coords + wrecs:
             _await_ready(rec, ready_timeout_s)
